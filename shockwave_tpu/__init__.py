@@ -1,0 +1,23 @@
+"""shockwave_tpu: a TPU-native cluster scheduler for dynamic-adaptation ML training.
+
+A ground-up reimplementation of the capabilities of uw-mad-dash/shockwave
+(NSDI '23; itself a fork of Gavel, OSDI '20) targeting TPU pods:
+
+- workers register TPU chips instead of CUDA devices,
+- training workloads are JAX/Flax programs jit-compiled for the MXU,
+- multi-chip jobs shard over a `jax.sharding.Mesh` with XLA collectives on
+  ICI (replacing the reference's PyTorch DDP/NCCL data plane),
+- the market solver (dynamic Eisenberg-Gale MILP) runs on scipy's HiGHS
+  instead of cvxpy/Gurobi, with the same model and fallback chain.
+
+Layer map (mirrors SURVEY.md §1):
+  core/      Job model, traces, throughput oracles, adaptation oracles
+  solver/    Gavel policy suite (LP/MILP over scipy HiGHS)
+  shockwave/ JobMetaData + dynamic EG MILP planner
+  sched/     round-based scheduler core + discrete-event simulator
+  runtime/   gRPC control plane, worker daemon, dispatcher, lease iterator
+  models/    JAX/Flax workload suite (static / accordion / GNS variants)
+  parallel/  mesh + sharding helpers, DP/TP/SP train steps, ring attention
+"""
+
+__version__ = "0.1.0"

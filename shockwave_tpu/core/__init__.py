@@ -1,0 +1,18 @@
+from .job import Job, JobIdPair
+from .trace import parse_trace, job_to_trace_line
+from .oracle import read_throughputs, parse_job_type_tuple
+from .constants import DATASET_SIZES, MODEL_DATASET, MAX_BS, steps_per_epoch, num_epochs_for
+
+__all__ = [
+    "Job",
+    "JobIdPair",
+    "parse_trace",
+    "job_to_trace_line",
+    "read_throughputs",
+    "parse_job_type_tuple",
+    "DATASET_SIZES",
+    "MODEL_DATASET",
+    "MAX_BS",
+    "steps_per_epoch",
+    "num_epochs_for",
+]

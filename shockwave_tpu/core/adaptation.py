@@ -1,0 +1,127 @@
+"""Batch-size adaptation oracles for Accordion and GNS workloads.
+
+These produce, for a job, the per-epoch batch-size schedule the adaptive
+training algorithm would emit, used both by the simulator and by the
+Shockwave profile generator. Semantics match the reference's measured
+tables (reference: scheduler/utils.py:741-1328) but are expressed as data
+rather than branching code.
+
+Accordion (Agarwal et al.): trains at the small batch size inside
+"critical regimes" (high gradient-norm phases) and at the family's max
+batch size outside them; the first 30% of training is forced critical.
+
+GNS (McCandlish et al., gradient noise scale): batch size doubles at
+measured epochs; the doubling points were profiled per (model, bs,
+scale_factor) and are captured in `_GNS_SEGMENTS`.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .constants import MAX_BS
+
+# Models whose adaptive variants never rescale.
+_NON_ADAPTIVE = ("Transformer", "CycleGAN", "A3C")
+
+
+def _critical_regime(model: str, initial_bs: int) -> Optional[set]:
+    """Epochs inside the gradient-critical regime, or None if no adaptation."""
+    if model == "ResNet-18":
+        head = 20 if initial_bs == 256 else 10
+        return set(range(head)) | set(range(150, 160)) | set(range(250, 260))
+    if model == "ResNet-50":
+        return {e for e in range(600) if e % 30 < 10}
+    if model == "LM":
+        return set(range(10))
+    if model == "Recommendation":
+        head = {512: 30, 1024: 30, 2048: 40, 4096: 10, 8192: 10}[initial_bs]
+        return set(range(head)) | set(range(60, 70)) | set(range(80, 90))
+    return None
+
+
+def accordion_bs_schedule(model: str, initial_bs: int, num_epochs: int) -> List[int]:
+    """Per-epoch batch sizes under Accordion adaptation."""
+    schedule = [initial_bs] * num_epochs
+    if model in _NON_ADAPTIVE:
+        return schedule
+    critical = _critical_regime(model, initial_bs)
+    if critical is None:
+        return schedule
+    big = MAX_BS.get(model, initial_bs)
+    warmup = num_epochs * 0.3  # first 30% forced critical to preserve accuracy
+    for epoch in range(num_epochs):
+        if epoch not in critical and epoch > warmup:
+            schedule[epoch] = big
+    return schedule
+
+
+# (model, initial_bs, scale_factor) -> (min_epochs_to_adapt, segments).
+# Each segment (start, end, multiplier) multiplies epochs in [start, end);
+# end None means "to the last epoch". The profiled doubling points below
+# correspond to the reference's measured GNS runs (utils.py:801-1328).
+_Seg = Tuple[int, Optional[int], int]
+_GNS_SEGMENTS: Dict[Tuple[str, int, int], Tuple[int, List[_Seg]]] = {
+    ("ResNet-18", 16, 1): (31, [(31, 41, 2), (41, 51, 4), (51, 71, 8), (71, None, 16)]),
+    ("ResNet-18", 32, 1): (21, [(21, 31, 2), (31, 51, 4), (51, None, 8)]),
+    ("ResNet-18", 64, 1): (11, [(11, 31, 2), (31, None, 4)]),
+    ("ResNet-18", 128, 1): (11, [(11, None, 2)]),
+    ("ResNet-18", 16, 2): (21, [(21, 31, 2), (31, 91, 4), (91, 111, 8), (111, None, 16)]),
+    ("ResNet-18", 32, 2): (11, [(11, 21, 2), (21, 41, 4), (41, None, 8)]),
+    ("ResNet-18", 64, 2): (21, [(21, 41, 2), (41, None, 4)]),
+    ("ResNet-18", 128, 2): (41, [(41, None, 2)]),
+    ("ResNet-18", 16, 4): (11, [(11, 21, 2), (21, 81, 4), (81, 91, 8), (91, None, 16)]),
+    ("ResNet-18", 32, 4): (21, [(21, 31, 2), (31, 61, 4), (61, None, 8)]),
+    ("ResNet-18", 64, 4): (11, [(11, 61, 2), (61, None, 4)]),
+    ("ResNet-18", 128, 4): (11, [(11, None, 2)]),
+    ("ResNet-50", 64, 1): (101, [(101, None, 2)]),
+    ("ResNet-50", 32, 2): (101, [(101, 111, 2), (111, None, 4)]),
+    ("ResNet-50", 64, 2): (81, [(81, None, 2)]),
+    ("ResNet-50", 32, 4): (131, [(131, 221, 2), (221, None, 4)]),
+    ("ResNet-50", 64, 4): (191, [(191, None, 2)]),
+    ("LM", 5, 1): (31, [(31, 41, 2), (41, 61, 4), (61, 71, 8), (71, None, 16)]),
+    ("LM", 10, 1): (11, [(11, 21, 2), (21, 41, 4), (41, None, 8)]),
+    ("LM", 20, 1): (11, [(11, 41, 2), (41, None, 4)]),
+    ("LM", 40, 1): (11, [(11, None, 2)]),
+    ("LM", 5, 2): (31, [(31, 51, 2), (51, 61, 4), (61, 71, 8), (71, None, 16)]),
+    ("LM", 10, 2): (11, [(11, 31, 2), (31, 41, 4), (41, None, 8)]),
+    ("LM", 20, 2): (31, [(31, 41, 2), (41, None, 4)]),
+    ("LM", 40, 2): (11, [(11, None, 2)]),
+    ("LM", 5, 4): (11, [(11, 31, 2), (31, 71, 4), (71, 91, 8), (91, None, 16)]),
+    ("LM", 10, 4): (11, [(11, 31, 2), (31, 61, 4), (61, None, 8)]),
+    ("LM", 20, 4): (11, [(11, 61, 2), (61, None, 4)]),
+    ("LM", 40, 4): (61, [(61, None, 2)]),
+    ("Recommendation", 512, 1): (21, [(21, 41, 2), (41, 71, 4), (71, 91, 8), (91, None, 16)]),
+    ("Recommendation", 1024, 1): (21, [(21, 51, 2), (51, 91, 4), (91, None, 8)]),
+    ("Recommendation", 2048, 1): (21, [(21, 41, 2), (41, None, 4)]),
+    ("Recommendation", 4096, 1): (41, [(41, None, 2)]),
+}
+
+
+def gns_bs_schedule(model: str, initial_bs: int, num_epochs: int, scale_factor: int) -> List[int]:
+    """Per-epoch batch sizes under GNS adaptation."""
+    schedule = [initial_bs] * num_epochs
+    if model in _NON_ADAPTIVE:
+        return schedule
+    entry = _GNS_SEGMENTS.get((model, initial_bs, scale_factor))
+    if entry is not None:
+        min_epochs, segments = entry
+        if num_epochs > min_epochs:
+            for i, (start, end, mult) in enumerate(segments):
+                # The final epoch of the run is only rescaled when it falls in
+                # the first segment (matches the reference loop structure).
+                stop = num_epochs if i == 0 else num_epochs - 1
+                if end is not None:
+                    stop = min(stop, end)
+                for epoch in range(start, stop):
+                    schedule[epoch] *= mult
+    cap = MAX_BS[model]
+    return [min(bs, cap) for bs in schedule]
+
+
+def bs_schedule_for_mode(mode: str, model: str, initial_bs: int, num_epochs: int,
+                         scale_factor: int) -> List[int]:
+    if mode == "accordion":
+        return accordion_bs_schedule(model, initial_bs, num_epochs)
+    if mode == "gns":
+        return gns_bs_schedule(model, initial_bs, num_epochs, scale_factor)
+    return [initial_bs] * num_epochs

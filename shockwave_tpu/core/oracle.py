@@ -1,0 +1,61 @@
+"""Throughput oracle files.
+
+Format (reference: scheduler/utils.py:575-594 and *_throughputs.json):
+
+    {worker_type: {"('<job_type>', <scale_factor>)":
+        {"null": isolated_tput,
+         "('<other_job_type>', <sf>)": [tput_self, tput_other]}}}
+
+Keys are stringified (job_type, scale_factor) tuples; "null" holds the
+isolated throughput in steps/sec.
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, Optional, Tuple
+
+JobTypeKey = Tuple[str, int]
+
+_KEY_RE = re.compile(r"\('(.*)', (\d+)\)")
+
+
+def parse_job_type_tuple(s: str) -> Optional[JobTypeKey]:
+    m = _KEY_RE.match(s)
+    if m is None:
+        return None
+    return (m.group(1), int(m.group(2)))
+
+
+def read_throughputs(path: str) -> Dict[str, Dict[JobTypeKey, dict]]:
+    """Load an oracle file, parsing stringified keys into tuples."""
+    with open(path) as f:
+        raw = json.load(f)
+    out: Dict[str, Dict[JobTypeKey, dict]] = {}
+    for worker_type, per_type in raw.items():
+        parsed = {}
+        for job_type_str, entry in per_type.items():
+            key = parse_job_type_tuple(job_type_str)
+            if key is None:
+                raise ValueError(f"bad job type key {job_type_str!r}")
+            parsed_entry = {}
+            for other, tput in entry.items():
+                parsed_entry["null" if other == "null" else parse_job_type_tuple(other)] = tput
+            parsed[key] = parsed_entry
+        out[worker_type] = parsed
+    return out
+
+
+def write_throughputs(path: str, throughputs: Dict[str, Dict[JobTypeKey, dict]]) -> None:
+    raw = {
+        worker_type: {
+            str(key): {
+                ("null" if other == "null" else str(other)): tput
+                for other, tput in entry.items()
+            }
+            for key, entry in per_type.items()
+        }
+        for worker_type, per_type in throughputs.items()
+    }
+    with open(path, "w") as f:
+        json.dump(raw, f, indent=2)

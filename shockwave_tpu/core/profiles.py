@@ -1,0 +1,89 @@
+"""Per-epoch job profiles: the Shockwave solver's input.
+
+For each job we derive a per-epoch batch-size schedule (via the adaptation
+oracles) and attach per-epoch duration / memory / accelerator-utilization
+estimates. Durations come from the isolated throughput oracle; memory and
+utilization from profiled tables (reference: scheduler/utils.py:706-738,
+1331-1443). Profiles are plain dicts so they pickle/json cleanly.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+from .adaptation import bs_schedule_for_mode
+from .constants import MODEL_DATASET, dataset_size, steps_per_epoch
+from .job import Job
+
+# Profiled per-(model, batch size) device memory footprint in MB.
+MEM_MB = {
+    "ResNet-18": {16: 1771, 32: 1857, 64: 2925, 128: 4137, 256: 3581},
+    "ResNet-50": {16: 3279, 32: 4597, 64: 4949, 128: 10289},
+    "Transformer": {16: 3145, 32: 4219, 64: 7199, 128: 12197},
+    "LM": {5: 1687, 10: 1789, 20: 1983, 40: 2415, 80: 3337},
+    "Recommendation": {512: 1751, 1024: 2373, 2048: 3559, 4096: 6565, 8192: 7699},
+    "CycleGAN": {1: 7901, 2: 8435, 4: 12291},
+    "A3C": {4: 5880},
+}
+
+# Profiled per-(model, batch size) accelerator utilization percentage.
+UTIL_PCT = {
+    "ResNet-18": {16: 76.8, 32: 87.6, 64: 95.5, 128: 98.0, 256: 98.8},
+    "ResNet-50": {16: 96.0, 32: 96.4, 64: 98.8, 128: 99.2},
+    "Transformer": {16: 76.7, 32: 82.0, 64: 88.8, 128: 93.8},
+    "LM": {5: 71.5, 10: 67.6, 20: 60.8, 40: 58.9, 80: 60.0},
+    "Recommendation": {512: 12.3, 1024: 8.9, 2048: 12.2, 4096: 10.9, 8192: 15.3},
+    "CycleGAN": {1: 96.0, 2: 98.0, 4: 98.0},
+    "A3C": {4: 88.0},
+}
+
+
+def epoch_duration(model: str, batch_size: int, scale_factor: int,
+                   throughputs: dict, worker_type: str = "v100") -> float:
+    """Seconds per epoch from the isolated oracle throughput.
+
+    Uses fractional steps-per-epoch (dataset_size / batch_size without
+    rounding) to match the reference profiler (utils.py:700-704).
+    """
+    job_type = f"{model} (batch size {batch_size})"
+    tput = throughputs[worker_type][(job_type, scale_factor)]["null"]
+    return (dataset_size(model) / batch_size) / tput
+
+
+def build_job_profile(job: Job, throughputs: dict, worker_type: str = "v100") -> dict:
+    """Profile one job: per-epoch bs/duration/mem/util lists plus metadata."""
+    model = job.model
+    bs0 = job.batch_size
+    n_epochs = math.ceil(job.total_steps / steps_per_epoch(model, bs0))
+    bs_every_epoch = bs_schedule_for_mode(job.mode, model, bs0, n_epochs, job.scale_factor)
+    return {
+        "model": model,
+        "dataset": MODEL_DATASET[model],
+        "num_epochs": n_epochs,
+        "num_samples_per_epoch": dataset_size(model),
+        "bs_every_epoch": bs_every_epoch,
+        "mem_every_epoch": [MEM_MB[model][bs] for bs in bs_every_epoch],
+        "util_every_epoch": [UTIL_PCT[model][bs] for bs in bs_every_epoch],
+        "duration_every_epoch": [
+            epoch_duration(model, bs, job.scale_factor, throughputs, worker_type)
+            for bs in bs_every_epoch
+        ],
+        "scale_factor": job.scale_factor,
+        "duration": job.duration,
+    }
+
+
+def build_profiles(jobs: Sequence[Job], throughputs: dict,
+                   worker_type: str = "v100") -> List[dict]:
+    return [build_job_profile(job, throughputs, worker_type) for job in jobs]
+
+
+def save_profiles(profiles: List[dict], path: str) -> None:
+    with open(path, "wb") as f:
+        pickle.dump(profiles, f)
+
+
+def load_profiles(path: str) -> List[dict]:
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,57 @@
+"""Trace file IO.
+
+A trace is a TSV with one job per line and 12 fields:
+job_type, command, working_directory, num_steps_arg, needs_data_dir,
+total_steps, scale_factor, mode, priority_weight, SLO, duration,
+arrival_time (reference: scheduler/utils.py:1446-1497). SLO < 0 means none.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .job import Job
+
+
+def parse_trace(trace_file: str) -> Tuple[List[Job], List[float]]:
+    jobs: List[Job] = []
+    arrival_times: List[float] = []
+    with open(trace_file) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            fields = line.split("\t")
+            if len(fields) != 12:
+                raise ValueError(f"expected 12 trace fields, got {len(fields)}: {line!r}")
+            (job_type, command, working_directory, num_steps_arg, needs_data_dir,
+             total_steps, scale_factor, mode, priority_weight, slo, duration,
+             arrival_time) = fields
+            if int(scale_factor) < 1:
+                raise ValueError(f"scale_factor must be >= 1: {line!r}")
+            jobs.append(Job(
+                job_id=None,
+                job_type=job_type,
+                command=command,
+                working_directory=working_directory,
+                num_steps_arg=num_steps_arg,
+                needs_data_dir=bool(int(needs_data_dir)),
+                total_steps=int(total_steps),
+                duration=duration,
+                scale_factor=int(scale_factor),
+                mode=mode,
+                priority_weight=float(priority_weight),
+                SLO=float(slo),
+            ))
+            arrival_times.append(float(arrival_time))
+    return jobs, arrival_times
+
+
+def job_to_trace_line(job: Job, arrival_time: float) -> str:
+    slo = -1.0 if job.SLO is None else job.SLO
+    fields = [
+        job.job_type, job.command, job.working_directory, job.num_steps_arg,
+        str(int(job.needs_data_dir)), str(job.total_steps),
+        str(job.scale_factor), job.mode, str(int(job.priority_weight)),
+        f"{slo:f}", str(job.duration), f"{arrival_time:f}",
+    ]
+    return "\t".join(fields)

@@ -1,0 +1,60 @@
+"""Test configuration.
+
+- Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding tests
+  run anywhere (real TPU tests live behind the `tpu` marker).
+- Provides a loader for the read-only reference implementation so parity
+  tests can cross-check behavior without depending on its solver stack.
+"""
+import importlib.util
+import os
+import sys
+import types
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+
+import pytest
+
+REFERENCE_DIR = "/root/reference/scheduler"
+
+
+def _install_stub(name, **attrs):
+    """Install a minimal fake module so reference files import without solvers."""
+    if name in sys.modules:
+        return sys.modules[name]
+    mod = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    sys.modules[name] = mod
+    return mod
+
+
+@pytest.fixture(scope="session")
+def reference_utils():
+    """Import the reference's utils module (pure-python parts only)."""
+    if not os.path.isdir(REFERENCE_DIR):
+        pytest.skip("reference implementation not available")
+    cvxpy = _install_stub(
+        "cvxpy",
+        Variable=object, Problem=object, Maximize=object, Minimize=object,
+        installed_solvers=lambda: [],
+    )
+    _install_stub("cvxpy.error", DCPError=Exception)
+    cvxpy.error = sys.modules["cvxpy.error"]
+    _install_stub("gurobipy")
+    _install_stub("mosek")
+    try:
+        import psutil  # noqa: F401
+    except ImportError:
+        _install_stub("psutil")
+    if REFERENCE_DIR not in sys.path:
+        sys.path.insert(0, REFERENCE_DIR)
+    spec = importlib.util.spec_from_file_location(
+        "reference_utils", os.path.join(REFERENCE_DIR, "utils.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

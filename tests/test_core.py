@@ -1,0 +1,155 @@
+"""Core data model tests: JobIdPair, Job, traces, oracles, adaptation parity."""
+import os
+
+import pytest
+
+from shockwave_tpu.core import (
+    Job, JobIdPair, parse_trace, read_throughputs, num_epochs_for,
+)
+from shockwave_tpu.core.adaptation import accordion_bs_schedule, gns_bs_schedule
+from shockwave_tpu.core.profiles import build_profiles
+
+DATA = os.path.join(os.path.dirname(__file__), "..", "data")
+TRACE = os.path.join(DATA, "canonical_120job.trace")
+THROUGHPUTS = os.path.join(DATA, "tacc_throughputs.json")
+
+
+class TestJobIdPair:
+    def test_single(self):
+        j = JobIdPair(3)
+        assert not j.is_pair()
+        assert j.integer_job_id() == 3
+        assert j == 3
+        assert j.singletons() == (j,)
+
+    def test_pair_normalizes_order(self):
+        assert JobIdPair(5, 2) == JobIdPair(2, 5)
+        assert hash(JobIdPair(5, 2)) == hash(JobIdPair(2, 5))
+        assert JobIdPair(2, 5).as_tuple() == (2, 5)
+
+    def test_mixed_keys_in_dict(self):
+        d = {}
+        for i in range(50):
+            d[JobIdPair(i)] = ("single", i)
+        for i in range(20):
+            for j in range(i + 1, 20):
+                d[JobIdPair(i, j)] = ("pair", i, j)
+        assert d[JobIdPair(7)] == ("single", 7)
+        assert d[JobIdPair(12, 3)] == ("pair", 3, 12)
+        assert len(d) == 50 + 190
+
+    def test_ordering_singles_before_pairs(self):
+        assert JobIdPair(9) < JobIdPair(0, 1)
+        assert sorted([JobIdPair(1, 2), JobIdPair(3), JobIdPair(0)]) == [
+            JobIdPair(0), JobIdPair(3), JobIdPair(1, 2)]
+
+    def test_overlaps(self):
+        assert JobIdPair(1).overlaps_with(JobIdPair(1, 7))
+        assert not JobIdPair(2).overlaps_with(JobIdPair(1, 7))
+
+
+class TestJob:
+    def test_model_and_bs_parsing(self):
+        j = Job(None, "ResNet-18 (batch size 32)", "python3 main.py --batch_size 32")
+        assert j.model == "ResNet-18"
+        assert j.batch_size == 32
+
+    def test_update_bs_rewrites_last_token(self):
+        j = Job(None, "ResNet-18 (batch size 32)",
+                "python3 main.py --data_dir=%s/cifar10 --batch_size 32")
+        j.update_bs(64)
+        assert j.batch_size == 64
+        assert j.command.endswith("--batch_size 64")
+
+    def test_update_bs_translation_second_to_last(self):
+        j = Job(None, "ResNet-50 (batch size 64)",
+                "python3 main.py -j 4 -a resnet50 -b 64 %s/imagenet/")
+        j.update_bs(128)
+        assert j.command == "python3 main.py -j 4 -a resnet50 -b 128 %s/imagenet/"
+        assert j.batch_size == 128
+
+
+class TestTrace:
+    def test_parse_canonical(self):
+        jobs, arrivals = parse_trace(TRACE)
+        assert len(jobs) == 120
+        assert arrivals == sorted(arrivals)
+        assert all(j.scale_factor >= 1 for j in jobs)
+        modes = {j.mode for j in jobs}
+        assert modes <= {"static", "accordion", "gns"}
+
+    def test_oracle_lookup(self):
+        tp = read_throughputs(THROUGHPUTS)
+        v = tp["v100"][("ResNet-18 (batch size 16)", 1)]["null"]
+        assert v == pytest.approx(57.68, abs=0.5)
+
+
+class TestAdaptationParity:
+    """Cross-check the data-driven schedules against the reference code."""
+
+    CASES = [
+        ("ResNet-18", bs, sf, n)
+        for bs in (16, 32, 64, 128, 256)
+        for sf in (1, 2, 4, 8)
+        for n in (5, 12, 40, 80, 200, 400)
+    ] + [
+        ("ResNet-50", bs, sf, n)
+        for bs in (16, 32, 64, 128) for sf in (1, 2, 4) for n in (50, 120, 250)
+    ] + [
+        ("LM", bs, sf, n)
+        for bs in (5, 10, 20, 40, 80) for sf in (1, 2, 4) for n in (10, 35, 90)
+    ] + [
+        ("Recommendation", bs, 1, n)
+        for bs in (512, 1024, 2048, 4096, 8192) for n in (15, 45, 100)
+    ] + [("Transformer", 64, 1, 60)]
+
+    def test_gns_matches_reference(self, reference_utils):
+        for model, bs, sf, n in self.CASES:
+            job_type = f"{model} (batch size {bs})"
+            expected = reference_utils.get_gns_bs_pattern(job_type, bs, n, sf)
+            got = gns_bs_schedule(model, bs, n, sf)
+            assert got == expected, (model, bs, sf, n)
+
+    def test_accordion_matches_reference(self, reference_utils):
+        for model, bs, sf, n in self.CASES:
+            job_type = f"{model} (batch size {bs})"
+            expected = reference_utils.get_accordion_bs_pattern(job_type, bs, n, 0)
+            got = accordion_bs_schedule(model, bs, n)
+            assert got == expected, (model, bs, n)
+
+
+class TestProfiles:
+    def test_profiles_match_reference_generator(self, reference_utils, tmp_path):
+        """Exact parity with the reference's Shockwave profile pickles."""
+        import pickle as pkl
+        import shutil
+        trace_copy = tmp_path / "canonical.trace"
+        shutil.copy(TRACE, trace_copy)
+        reference_utils.generate_pickle_file(str(trace_copy), THROUGHPUTS)
+        with open(tmp_path / "canonical.pickle", "rb") as f:
+            expected = pkl.load(f)
+
+        jobs, _ = parse_trace(TRACE)
+        got = build_profiles(jobs, read_throughputs(THROUGHPUTS))
+        assert len(got) == len(expected)
+        for g, e in zip(got, expected):
+            assert g["model"] == e["model"]
+            assert g["num_epochs"] == e["num_epochs"]
+            assert g["bs_every_epoch"] == e["bs_every_epoch"]
+            assert g["mem_every_epoch"] == e["mem_every_epoch"]
+            assert g["util_every_epoch"] == e["util_every_epoch"]
+            assert g["duration_every_epoch"] == pytest.approx(e["duration_every_epoch"])
+            assert int(g["scale_factor"]) == int(e["scale_factor"])
+
+    def test_build_canonical_profiles(self):
+        jobs, _ = parse_trace(TRACE)
+        tp = read_throughputs(THROUGHPUTS)
+        profiles = build_profiles(jobs, tp)
+        assert len(profiles) == 120
+        for job, p in zip(jobs, profiles):
+            n = p["num_epochs"]
+            assert n == num_epochs_for(job.model, job.batch_size, job.total_steps)
+            for key in ("bs_every_epoch", "mem_every_epoch", "util_every_epoch",
+                        "duration_every_epoch"):
+                assert len(p[key]) == n
+            assert all(d > 0 for d in p["duration_every_epoch"])
