@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Trace-driven simulation driver.
+
+Replays a trace against a simulated cluster and dumps the end-of-run
+metrics (reference: scheduler/scripts/drivers/simulate_scheduler_with_trace.py).
+
+Example:
+    python scripts/drivers/simulate.py \
+        --trace data/canonical_120job.trace \
+        --policy max_min_fairness \
+        --throughputs data/tacc_throughputs.json \
+        --cluster_spec v100:32 --round_duration 120
+"""
+import argparse
+import json
+import logging
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.core.oracle import read_throughputs
+from shockwave_tpu.core.profiles import build_profiles
+from shockwave_tpu.core.trace import parse_trace
+from shockwave_tpu.sched import Scheduler, SchedulerConfig
+from shockwave_tpu.solver import get_policy
+
+
+def parse_cluster_spec(spec: str):
+    cluster = {}
+    for part in spec.split(","):
+        worker_type, count = part.split(":")
+        cluster[worker_type] = int(count)
+    return cluster
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--trace", required=True)
+    p.add_argument("--policy", default="max_min_fairness")
+    p.add_argument("--throughputs", required=True)
+    p.add_argument("--cluster_spec", default="v100:32",
+                   help="worker_type:count[,worker_type:count...]")
+    p.add_argument("--round_duration", type=float, default=360.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max_rounds", type=int, default=None)
+    p.add_argument("--config", default=None,
+                   help="JSON file of shockwave hyperparameters")
+    p.add_argument("--output", default=None, help="metrics pickle path")
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(name)s:%(levelname)s %(message)s")
+
+    jobs, arrival_times = parse_trace(args.trace)
+    throughputs = read_throughputs(args.throughputs)
+    profiles = build_profiles(jobs, throughputs)
+    cluster_spec = parse_cluster_spec(args.cluster_spec)
+
+    shockwave_config = None
+    if args.config:
+        with open(args.config) as f:
+            shockwave_config = json.load(f)
+    elif args.policy == "shockwave":
+        shockwave_config = {}  # planner defaults
+    if shockwave_config is not None:
+        shockwave_config["num_gpus"] = sum(cluster_spec.values())
+        shockwave_config["time_per_iteration"] = args.round_duration
+
+    policy = get_policy(args.policy, seed=args.seed)
+    sched = Scheduler(
+        policy, simulate=True, throughputs_file=args.throughputs,
+        profiles=profiles,
+        config=SchedulerConfig(
+            time_per_iteration=args.round_duration, seed=args.seed,
+            max_rounds=args.max_rounds, shockwave=shockwave_config))
+
+    makespan = sched.simulate(cluster_spec, arrival_times, jobs)
+
+    jct = sched.get_average_jct()
+    ftf_static, ftf_themis = sched.get_finish_time_fairness()
+    util, util_list = sched.get_cluster_utilization()
+    ext_pct, ext, opp = sched.get_num_lease_extensions()
+    envy_ratios, envy_pairwise = sched.get_envy_ratios()
+
+    metrics = {
+        "trace_file": args.trace,
+        "policy": args.policy,
+        "makespan": makespan,
+        "avg_jct": jct[0] if jct else None,
+        "geometric_mean_jct": jct[1] if jct else None,
+        "harmonic_mean_jct": jct[2] if jct else None,
+        "jct_list": jct[3] if jct else [],
+        "finish_time_fairness_list": ftf_static,
+        "finish_time_fairness_themis_list": ftf_themis,
+        "cluster_util": util,
+        "utilization_list": util_list,
+        "envy_ratios": envy_ratios,
+        "envy_list": envy_pairwise,
+        "extension_percentage": ext_pct,
+        "num_lease_extensions": ext,
+        "num_lease_extension_opportunities": opp,
+        "per_round_schedule": sched.rounds.per_round_schedule,
+        "time_per_iteration": args.round_duration,
+        "throughput_timeline": sched.get_makespan() and None,
+    }
+
+    unfair = (sum(1 for r in ftf_static if r > 1.1) / len(ftf_static)
+              if ftf_static else 0.0)
+    print(json.dumps({
+        "policy": args.policy,
+        "makespan": round(makespan, 2),
+        "avg_jct": round(metrics["avg_jct"], 2) if metrics["avg_jct"] else None,
+        "unfair_fraction": round(unfair, 4),
+        "cluster_util": round(util, 4),
+        "lease_extension_pct": round(ext_pct, 2),
+        "rounds": sched.rounds.num_completed_rounds,
+    }))
+
+    if args.output:
+        with open(args.output, "wb") as f:
+            pickle.dump(metrics, f)
+
+
+if __name__ == "__main__":
+    main()

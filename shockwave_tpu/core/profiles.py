@@ -8,12 +8,11 @@ utilization from profiled tables (reference: scheduler/utils.py:706-738,
 """
 from __future__ import annotations
 
-import math
 import pickle
 from typing import Dict, List, Optional, Sequence
 
 from .adaptation import bs_schedule_for_mode
-from .constants import MODEL_DATASET, dataset_size, steps_per_epoch
+from .constants import MODEL_DATASET, dataset_size, num_epochs_for, steps_per_epoch
 from .job import Job
 
 # Profiled per-(model, batch size) device memory footprint in MB.
@@ -55,7 +54,7 @@ def build_job_profile(job: Job, throughputs: dict, worker_type: str = "v100") ->
     """Profile one job: per-epoch bs/duration/mem/util lists plus metadata."""
     model = job.model
     bs0 = job.batch_size
-    n_epochs = math.ceil(job.total_steps / steps_per_epoch(model, bs0))
+    n_epochs = num_epochs_for(model, bs0, job.total_steps)
     bs_every_epoch = bs_schedule_for_mode(job.mode, model, bs0, n_epochs, job.scale_factor)
     return {
         "model": model,

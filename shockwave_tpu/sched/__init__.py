@@ -1,0 +1,3 @@
+from .scheduler import Scheduler, SchedulerConfig
+
+__all__ = ["Scheduler", "SchedulerConfig"]

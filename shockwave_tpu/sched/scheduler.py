@@ -1,0 +1,1007 @@
+"""Round-based scheduler core + discrete-event simulator.
+
+One scheduling core serves two execution modes (the reference's fidelity
+claim, EXPERIMENTS.md:24):
+
+- **Simulation**: `simulate()` replaces workers with an oracle-throughput
+  event loop (reference: scheduler.py:1728-2268).
+- **Physical**: a round loop drives real workers over gRPC; jobs hold
+  leases and report via done callbacks (wired up in runtime/).
+
+The round mechanism: every `time_per_iteration` seconds each scheduled job
+runs a micro-task; the policy's allocation is turned into per-round worker
+assignments greedily by (priority, deficit, allocation), with sticky
+placement so unchanged assignments can become lease extensions.
+"""
+from __future__ import annotations
+
+import collections
+import copy
+import heapq
+import logging
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core import constants
+from ..core.job import Job, JobIdPair
+from ..core.oracle import read_throughputs
+from .state import JobAccounting, RoundState, WorkerState
+
+logger = logging.getLogger("shockwave_tpu.sched")
+
+INFINITY = int(1e9)
+DEFAULT_THROUGHPUT = 1.0
+EMA_ALPHA = 0.5
+MAX_FAILED_ATTEMPTS = 5
+# Checkpoint + restore overhead injected when a simulated job was preempted
+# in the previous round (reference: scheduler.py:1936-1968).
+PREEMPTION_OVERHEAD_S = 20.0
+# A job running over 1.5x its expected duration is force-completed.
+DEADLINE_SLACK = 1.5
+REOPT_ROUNDS = 8
+
+
+@dataclass
+class SchedulerConfig:
+    time_per_iteration: float = 360.0
+    seed: int = 0
+    minimum_time_between_allocation_resets: float = 1000.0
+    max_rounds: Optional[int] = None
+    # Shockwave planner hyperparameters (configs/*.json).
+    shockwave: Optional[dict] = None
+
+
+class Scheduler:
+    """The scheduling core. Construct with a policy, then either call
+    `simulate(...)` or drive it with worker callbacks (physical mode)."""
+
+    def __init__(self, policy, simulate: bool = False,
+                 throughputs_file: Optional[str] = None,
+                 profiles: Optional[List[dict]] = None,
+                 config: Optional[SchedulerConfig] = None):
+        self._policy = policy
+        self._simulate = simulate
+        self._config = config or SchedulerConfig()
+        self._time_per_iteration = self._config.time_per_iteration
+
+        self._current_timestamp: float = 0.0
+        self._job_id_counter = 0
+
+        self.workers = WorkerState()
+        self.acct = JobAccounting()
+        self.rounds = RoundState()
+
+        # Allocation machinery.
+        self._allocation: Dict[JobIdPair, Dict[str, float]] = {}
+        self._priorities: Dict[str, Dict[JobIdPair, float]] = {}
+        self._deficits: Dict[str, Dict[JobIdPair, float]] = {}
+        self._need_to_update_allocation = False
+        self._last_reset_time = 0.0
+
+        # Throughputs: measured/estimated per job, plus the offline oracle.
+        self._throughputs: Dict[JobIdPair, Dict[str, float]] = {}
+        self._oracle_throughputs = (
+            read_throughputs(throughputs_file) if throughputs_file else None)
+        self._throughput_timeline: Dict[int, "collections.OrderedDict"] = {}
+
+        self._completed_jobs: Set[JobIdPair] = set()
+        self._running_jobs: Set[JobIdPair] = set()
+        self._in_progress_updates: Dict[JobIdPair, list] = {}
+        self._steps_run_in_current_lease: Dict[JobIdPair, int] = {}
+        self._num_jobs_in_trace = 0
+
+        # Dynamic adaptation (accordion/GNS) request flags.
+        self._bs_flags: Dict[JobIdPair, Dict[str, bool]] = {}
+
+        # Profiles indexed by integer job id (Shockwave solver input).
+        self._profiles = profiles
+
+        self._rng = np.random.RandomState(self._config.seed)
+        import random as _random
+        self._worker_type_shuffler = _random.Random(self._config.seed + 5)
+
+        # Shockwave planner.
+        self._shockwave_planner = None
+        if policy.name == "shockwave":
+            from ..shockwave.planner import ShockwavePlanner
+            sw = dict(self._config.shockwave or {})
+            sw.setdefault("time_per_iteration", self._time_per_iteration)
+            self._shockwave_planner = ShockwavePlanner.from_config(sw)
+        self._scheduled_jobs_in_current_round: Optional[List[int]] = None
+        self._scheduled_jobs_in_prev_round: Optional[List[int]] = None
+        self._shockwave_job_completed = False
+        self._rounds_since_reopt = 0
+
+    # ------------------------------------------------------------------
+    # Time
+    # ------------------------------------------------------------------
+
+    def get_current_timestamp(self) -> float:
+        return self._current_timestamp
+
+    # ------------------------------------------------------------------
+    # Job lifecycle
+    # ------------------------------------------------------------------
+
+    def add_job(self, job: Job, timestamp: Optional[float] = None) -> JobIdPair:
+        job_id = JobIdPair(self._job_id_counter)
+        self._job_id_counter += 1
+        job.job_id = job_id
+        a = self.acct
+        a.jobs[job_id] = job
+        a.steps_run[job_id] = {wt: 0 for wt in self.workers.worker_types}
+        a.total_steps_run[job_id] = 0
+        a.run_time_per_worker[job_id] = {}
+        a.job_time[job_id] = {
+            wt: self._time_per_iteration / 2.0 for wt in self.workers.worker_types}
+        a.failures[job_id] = 0
+        a.original_bs[job_id] = job.batch_size
+        a.original_num_steps[job_id] = job.total_steps
+        a.original_job_type[job_id] = job.job_type
+        self._num_jobs_in_trace += 1
+
+        self._throughputs[job_id] = {}
+        for wt in self.workers.worker_types:
+            self._set_initial_throughput(job_id, wt)
+
+        ts = timestamp if timestamp is not None else self.get_current_timestamp()
+        a.start_timestamps[job_id] = ts
+        a.latest_timestamps[job_id] = None
+        self._add_to_priorities(job_id)
+        self._need_to_update_allocation = True
+        self._bs_flags[job_id] = {"big_bs": False, "small_bs": False}
+        self._steps_run_in_current_lease[job_id] = 0
+
+        int_id = job_id.integer_job_id()
+        self.rounds.num_scheduled_rounds[int_id] = 0
+        self.rounds.num_queued_rounds[int_id] = 0
+        self.rounds.job_start_round[int_id] = self.rounds.num_completed_rounds
+
+        if self._shockwave_planner is not None:
+            from ..shockwave.metadata import JobMetadata
+            profile = self._profiles[int_id]
+            meta = JobMetadata(int_id, profile)
+            meta.register_submit(ts)
+            self._throughput_timeline[int_id] = collections.OrderedDict()
+            meta.attach_throughput_measurements(
+                self._throughput_timeline[int_id], self._time_per_iteration)
+            self._shockwave_planner.add_job(int_id, meta)
+        else:
+            self._throughput_timeline[job_id.integer_job_id()] = collections.OrderedDict()
+
+        logger.info("[Job dispatched] job %s (%s, sf=%d, mode=%s)",
+                    job_id, job.job_type, job.scale_factor, job.mode)
+        return job_id
+
+    def _remove_job(self, job_id: JobIdPair) -> None:
+        a = self.acct
+        self._completed_jobs.add(job_id)
+        duration = a.latest_timestamps[job_id] - a.start_timestamps[job_id]
+        a.completion_times[job_id] = duration
+        a.priority_weights_archive[job_id] = a.jobs[job_id].priority_weight
+        int_id = job_id.integer_job_id()
+        self.rounds.job_end_round[int_id] = self.rounds.num_completed_rounds
+        del a.jobs[job_id]
+        del a.steps_run[job_id]
+        del a.job_time[job_id]
+        del self._throughputs[job_id]
+        del a.failures[job_id]
+        self._in_progress_updates.pop(job_id, None)
+        self._steps_run_in_current_lease.pop(job_id, None)
+        self.rounds.extended_leases.discard(job_id)
+        if self._shockwave_planner is not None:
+            planner = self._shockwave_planner
+            if int_id in planner.metadata:
+                planner.mark_progress(int_id, planner.metadata[int_id].epochs)
+                planner.remove_job(int_id)
+            self._shockwave_job_completed = True
+        self._remove_from_priorities(job_id)
+        self._need_to_update_allocation = True
+        logger.info("[Job completed] job %s after %.1fs (%d active)",
+                    job_id, duration, len(a.jobs))
+
+    # ------------------------------------------------------------------
+    # Workers
+    # ------------------------------------------------------------------
+
+    def register_worker(self, worker_type: str, num_chips: int = 1):
+        """Register one worker host exposing `num_chips` accelerator chips."""
+        w = self.workers
+        if worker_type not in w.type_to_server_ids:
+            w.type_to_server_ids[worker_type] = []
+            self._priorities[worker_type] = {}
+            self._deficits[worker_type] = {}
+            self.acct.worker_type_time.setdefault(worker_type, 0.0)
+            for job_id in self.acct.jobs:
+                self.acct.steps_run[job_id][worker_type] = 0
+                self.acct.job_time[job_id][worker_type] = self._time_per_iteration / 2.0
+                self._set_initial_throughput(job_id, worker_type)
+                self._add_to_priorities(job_id, worker_type)
+        server_ids = []
+        for _ in range(num_chips):
+            worker_id = w.next_worker_id
+            w.next_worker_id += 1
+            server_ids.append(worker_id)
+            w.worker_ids.append(worker_id)
+            w.worker_types.add(worker_type)
+            w.id_to_type[worker_id] = worker_type
+            w.cumulative_time[worker_id] = 0.0
+            w.start_times[worker_id] = self.get_current_timestamp()
+            w.cluster_spec[worker_type] = w.cluster_spec.get(worker_type, 0) + 1
+        w.type_to_server_ids[worker_type].append(server_ids)
+        self._need_to_update_allocation = True
+        return server_ids, self._time_per_iteration
+
+    # ------------------------------------------------------------------
+    # Throughputs
+    # ------------------------------------------------------------------
+
+    def _set_initial_throughput(self, job_id: JobIdPair, worker_type: str):
+        job = self.acct.jobs[job_id]
+        if self._oracle_throughputs is not None:
+            key = (job.job_type, job.scale_factor)
+            self._throughputs[job_id][worker_type] = (
+                self._oracle_throughputs[worker_type][key]["null"])
+        else:
+            self._throughputs[job_id][worker_type] = DEFAULT_THROUGHPUT
+
+    def _update_throughput(self, job_id: JobIdPair, worker_type: str,
+                           num_steps: int, execution_time: float):
+        if job_id not in self._throughputs:
+            return
+        int_id = job_id.integer_job_id()
+        timeline = self._throughput_timeline.setdefault(
+            int_id, collections.OrderedDict())
+        new_tput = 0.0 if execution_time <= 0 else num_steps / execution_time
+        timeline[self.rounds.num_completed_rounds] = (
+            new_tput, self.acct.jobs[job_id].batch_size)
+        if not self._simulate and execution_time > 0:
+            old = self._throughputs[job_id][worker_type]
+            if old != INFINITY:
+                new_tput = EMA_ALPHA * new_tput + (1 - EMA_ALPHA) * old
+            self._throughputs[job_id][worker_type] = new_tput
+
+    # ------------------------------------------------------------------
+    # Priorities / deficits (Gavel machinery)
+    # ------------------------------------------------------------------
+
+    def _add_to_priorities(self, job_id: JobIdPair, worker_type: Optional[str] = None):
+        for wt in ([worker_type] if worker_type else self.workers.worker_types):
+            self._priorities[wt][job_id] = 0.0
+            self._deficits[wt][job_id] = 0.0
+
+    def _remove_from_priorities(self, job_id: JobIdPair):
+        for wt in self.workers.worker_types:
+            for other in list(self._priorities[wt]):
+                if job_id.overlaps_with(other) if not job_id.is_pair() else job_id == other:
+                    del self._priorities[wt][other]
+                    del self._deficits[wt][other]
+
+    def _reset_time_run_so_far(self):
+        current_time = self.get_current_timestamp()
+        elapsed = current_time - self._last_reset_time
+        for wt in self.workers.worker_types:
+            self.acct.worker_type_time[wt] = 0.0
+            for job_id in self.acct.job_time:
+                received = self.acct.job_time[job_id].get(wt, 0.0) - (
+                    self._time_per_iteration / 2.0)
+                if job_id in self._allocation:
+                    owed = self._allocation[job_id][wt] * elapsed
+                else:
+                    owed = 0.0
+                self._deficits[wt].setdefault(job_id, 0.0)
+                self._deficits[wt][job_id] += owed - received
+                self.acct.job_time[job_id][wt] = self._time_per_iteration / 2.0
+                self.acct.worker_type_time[wt] += self.acct.job_time[job_id][wt]
+        self._last_reset_time = current_time
+
+    def _update_priorities(self):
+        current_time = self.get_current_timestamp()
+        reset_elapsed = (current_time - self._last_reset_time
+                         >= self._config.minimum_time_between_allocation_resets)
+        need_reset = (reset_elapsed or self._last_reset_time == 0)
+        if self._simulate:
+            need_reset = self._need_to_update_allocation and need_reset
+        if need_reset:
+            self._reset_time_run_so_far()
+            if self._simulate:
+                self._allocation = self._compute_allocation()
+                self._need_to_update_allocation = False
+
+        for wt in self.workers.worker_types:
+            worker_time = self.acct.worker_type_time.get(wt, 0.0)
+            for job_id in self._priorities[wt]:
+                if job_id not in self._allocation:
+                    self._priorities[wt][job_id] = 0.0
+                    continue
+                alloc = self._allocation[job_id][wt]
+                if alloc == 0.0 or self._throughputs[job_id][wt] == 0:
+                    self._priorities[wt][job_id] = 0.0
+                    continue
+                if worker_time > 0 and wt in self.acct.job_time.get(job_id, {}):
+                    fraction = self.acct.job_time[job_id][wt] / worker_time
+                else:
+                    fraction = 0.0
+                if fraction > 0.0:
+                    self._priorities[wt][job_id] = alloc / fraction
+                else:
+                    # Newly added job: run it according to its allocation.
+                    self._priorities[wt][job_id] = alloc * 1e9
+
+    # ------------------------------------------------------------------
+    # Allocation
+    # ------------------------------------------------------------------
+
+    def _allocation_state(self) -> dict:
+        a = self.acct
+        now = self.get_current_timestamp()
+        num_steps_remaining = {}
+        for job_id in a.jobs:
+            remaining = self._get_remaining_steps(job_id)
+            remaining -= self._steps_run_in_current_lease[job_id]
+            num_steps_remaining[job_id] = remaining
+        return {
+            "scale_factors": {j: a.jobs[j].scale_factor for j in a.jobs},
+            "priority_weights": {j: a.jobs[j].priority_weight for j in a.jobs},
+            "num_steps_remaining": num_steps_remaining,
+            "times_since_start": {
+                j: now - a.start_timestamps[j] for j in a.jobs},
+            "throughputs": copy.deepcopy(self._throughputs),
+            "per_round_schedule": list(self.rounds.per_round_schedule),
+            "cluster_spec": dict(self.workers.cluster_spec),
+        }
+
+    def _compute_allocation(self, state: Optional[dict] = None) -> dict:
+        if state is None:
+            state = self._allocation_state()
+        name = self._policy.name
+        throughputs = state["throughputs"]
+        sf = state["scale_factors"]
+        cluster = state["cluster_spec"]
+        if name == "shockwave":
+            return {}
+        if name == "AlloX_Perf":
+            allocation = self._policy.get_allocation(
+                throughputs, sf, state["times_since_start"],
+                state["num_steps_remaining"], state["per_round_schedule"], cluster)
+        elif name.startswith("FinishTimeFairness"):
+            allocation = self._policy.get_allocation(
+                throughputs, sf, state["priority_weights"],
+                state["times_since_start"], state["num_steps_remaining"], cluster)
+        elif name.startswith("Isolated"):
+            allocation = self._policy.get_allocation(throughputs, sf, cluster)
+        elif name.startswith("MaxMinFairness"):
+            allocation = self._policy.get_allocation(
+                throughputs, sf, state["priority_weights"], cluster)
+        elif name.startswith("MinTotalDuration"):
+            allocation = self._policy.get_allocation(
+                throughputs, sf, state["num_steps_remaining"], cluster)
+        else:
+            allocation = self._policy.get_allocation(throughputs, sf, cluster)
+        return allocation or {}
+
+    # ------------------------------------------------------------------
+    # Round scheduling
+    # ------------------------------------------------------------------
+
+    def _get_remaining_steps(self, job_id: JobIdPair) -> int:
+        return self.acct.jobs[job_id].total_steps - self.acct.total_steps_run[job_id]
+
+    def _select_jobs_for_round(self, worker_types: List[str]) -> dict:
+        """Pick (job_id, scale_factor) lists per worker type for next round."""
+        if self._policy.name == "shockwave":
+            job_ids = self._shockwave_planner.round_schedule()
+            self._scheduled_jobs_in_prev_round = self._scheduled_jobs_in_current_round
+            self._scheduled_jobs_in_current_round = job_ids
+            scheduled = {wt: [] for wt in worker_types}
+            target = worker_types[0]
+            for int_id in job_ids:
+                job_id = JobIdPair(int_id)
+                if job_id not in self.acct.jobs:
+                    logger.warning("job %s in round schedule but completed", int_id)
+                    continue
+                scheduled[target].append((job_id, self.acct.jobs[job_id].scale_factor))
+            return scheduled
+
+        scheduled = {wt: [] for wt in worker_types}
+        workers_left = {wt: self.workers.cluster_spec[wt] for wt in worker_types}
+        already: Set[JobIdPair] = set()
+
+        queue = []
+        for wt in worker_types:
+            entries = [
+                (job_id, wt, self._priorities[wt][job_id],
+                 self._deficits[wt][job_id],
+                 self._allocation.get(job_id, {}).get(wt, 0.0))
+                for job_id in self._priorities[wt]
+            ]
+            queue += sorted(entries, key=lambda e: (e[2], e[3], e[4]), reverse=True)
+
+        for job_id, wt, priority, _, _ in queue:
+            if workers_left[wt] == 0:
+                continue
+            members = job_id.singletons()
+            if any(m in already for m in members):
+                continue
+            tput = self._throughputs[job_id][wt]
+            if (job_id.is_pair() and (tput[0] <= 0 or tput[1] <= 0)) or (
+                    not job_id.is_pair() and tput <= 0):
+                continue
+            if self._policy.name.startswith("FIFO") and priority <= 0.0:
+                continue
+            sfs = {self.acct.jobs[m].scale_factor for m in members}
+            if len(sfs) != 1:
+                continue
+            scale_factor = sfs.pop()
+            if scale_factor > workers_left[wt]:
+                if self._policy.name == "Isolated_plus":
+                    break  # strict priority order
+                continue
+            workers_left[wt] -= scale_factor
+            already.update(members)
+            scheduled[wt].append((job_id, scale_factor))
+        return scheduled
+
+    def _assign_workers(self, scheduled: dict, worker_types: List[str]) -> "collections.OrderedDict":
+        """Map selected jobs to concrete chip ids, sticky where possible."""
+        new_assignments: "collections.OrderedDict[JobIdPair, Tuple[int, ...]]" = (
+            collections.OrderedDict())
+        prev_types = {
+            job_id: self.workers.id_to_type[ids[0]]
+            for job_id, ids in self.rounds.current_assignments.items()}
+
+        for wt in worker_types:
+            scheduled[wt].sort(key=lambda x: x[1], reverse=True)
+            state = {
+                "servers": copy.deepcopy(self.workers.type_to_server_ids[wt]),
+                "assigned": set(),
+                "ptr": 0,
+            }
+            scale_factors = sorted({sf for _, sf in scheduled[wt]}, reverse=True)
+            for current_sf in scale_factors:
+                # Sticky pass: keep jobs on their previous workers.
+                for job_id, sf in scheduled[wt]:
+                    if sf != current_sf or prev_types.get(job_id) != wt:
+                        continue
+                    prev_ids = self.rounds.current_assignments[job_id]
+                    if all(w not in state["assigned"] for w in prev_ids):
+                        new_assignments[job_id] = prev_ids
+                        state["assigned"].update(prev_ids)
+                # Fill pass.
+                for job_id, sf in scheduled[wt]:
+                    if sf != current_sf or job_id in new_assignments:
+                        continue
+                    if (self._policy.name != "shockwave"
+                            and job_id not in self._allocation):
+                        continue
+                    ids = self._take_workers(state, sf)
+                    if ids is None:
+                        raise RuntimeError(f"could not assign workers to {job_id}")
+                    new_assignments[job_id] = tuple(ids)
+                    if self._policy.name == "shockwave":
+                        self._allocation.setdefault(job_id, {})[wt] = -1.0
+
+        # Invariant: each chip assigned at most once.
+        seen: Dict[int, int] = {}
+        for ids in new_assignments.values():
+            for w in ids:
+                seen[w] = seen.get(w, 0) + 1
+                if seen[w] > 1:
+                    raise RuntimeError(f"worker {w} multiply assigned")
+
+        for job_id in new_assignments:
+            for m in job_id.singletons():
+                if self._simulate:
+                    self.acct.latest_timestamps[m] = self.get_current_timestamp()
+                    self._running_jobs.add(m)
+        return new_assignments
+
+    @staticmethod
+    def _take_workers(state, count: int):
+        """Strided assignment walking server lists to minimize spread."""
+        taken = []
+        servers = state["servers"]
+        while len(taken) < count and state["ptr"] < len(servers):
+            server = servers[state["ptr"]]
+            if not server:
+                state["ptr"] += 1
+                continue
+            w = server.pop(0)
+            if w not in state["assigned"]:
+                taken.append(w)
+                state["assigned"].add(w)
+        return taken if len(taken) == count else None
+
+    def _schedule_jobs_on_workers(self) -> "collections.OrderedDict":
+        if self._policy.name != "shockwave":
+            self._update_priorities()
+        worker_types = [wt for wt in ("v100", "p100", "k80")
+                        if wt in self.workers.type_to_server_ids]
+        if not worker_types:
+            worker_types = sorted(self.workers.type_to_server_ids)
+        if "Perf" not in self._policy.name and "Packing" not in self._policy.name:
+            self._worker_type_shuffler.shuffle(worker_types)
+
+        scheduled = self._select_jobs_for_round(worker_types)
+        assignments = self._assign_workers(scheduled, worker_types)
+
+        int_assignments = {
+            job_id.integer_job_id(): ids for job_id, ids in assignments.items()
+            if not job_id.is_pair()}
+        self.rounds.per_round_schedule.append(int_assignments)
+        self.rounds.jobs_in_round.append(len(self.acct.jobs))
+        for job_id in self.acct.jobs:
+            int_id = job_id.integer_job_id()
+            if int_id in int_assignments:
+                self.rounds.num_scheduled_rounds[int_id] += 1
+            else:
+                self.rounds.num_queued_rounds[int_id] += 1
+        return assignments
+
+    # ------------------------------------------------------------------
+    # Dynamic adaptation (Accordion / GNS)
+    # ------------------------------------------------------------------
+
+    def _current_epoch(self, job_id: JobIdPair) -> int:
+        job = self.acct.jobs[job_id]
+        return constants.num_epochs_for(
+            job.model, job.batch_size, self.acct.total_steps_run[job_id])
+
+    def _at_max_bs(self, model: str, bs: int) -> bool:
+        return constants.MAX_BS.get(model) == bs
+
+    def _simulate_accordion(self, job_id: JobIdPair):
+        """Oracle for the accordion workload's critical-regime detector
+        (reference: scheduler.py:1658-1726)."""
+        job = self.acct.jobs[job_id]
+        model, bs, bs0 = job.model, job.batch_size, self.acct.original_bs[job_id]
+        epoch = self._current_epoch(job_id)
+        if model == "Transformer":
+            return
+        if model == "LM":
+            critical = epoch < 10
+        elif model == "Recommendation":
+            head = {512: 30, 1024: 30, 2048: 40, 4096: 10, 8192: 10}[bs0]
+            critical = epoch < head
+        elif model == "ResNet-50":
+            critical = (epoch % 30) < 10
+        elif model == "ResNet-18":
+            head = 20 if bs0 == 256 else 10
+            critical = (epoch < head or 150 <= epoch < 160 or 250 <= epoch < 260)
+        else:
+            return
+        min_bs = {"ResNet-18": 16, "ResNet-50": 16, "Transformer": 16,
+                  "LM": 5, "Recommendation": 512}
+        if bs == bs0 and not critical:
+            if not self._at_max_bs(model, bs):
+                self._bs_flags[job_id]["big_bs"] = True
+        elif bs != bs0 and critical:
+            if bs != min_bs.get(model):
+                self._bs_flags[job_id]["small_bs"] = True
+
+    def _simulate_gns(self, job_id: JobIdPair):
+        """Oracle for the GNS workload's noise-scale batch doubling
+        (reference: scheduler.py:1604-1656)."""
+        from ..core.adaptation import gns_bs_schedule
+        job = self.acct.jobs[job_id]
+        model, bs = job.model, job.batch_size
+        bs0 = self.acct.original_bs[job_id]
+        epoch = self._current_epoch(job_id)
+        schedule = gns_bs_schedule(model, bs0, max(760, epoch + 2), job.scale_factor)
+        if schedule[epoch + 1] > bs or schedule[epoch] > bs:
+            if not self._at_max_bs(model, bs):
+                self._bs_flags[job_id]["big_bs"] = True
+
+    def _scale_bs_and_iters(self, job_id: JobIdPair):
+        """Apply a pending batch-size change: rewrite command, swap oracle
+        throughput, and rescale step counts preserving epoch progress
+        (reference: scheduler.py:4731-4931)."""
+        flags = self._bs_flags.get(job_id)
+        if not flags or not (flags["big_bs"] or flags["small_bs"]):
+            return
+        job = self.acct.jobs.get(job_id)
+        if job is None:
+            return
+        model, mode = job.model, job.mode
+        old_bs = job.batch_size
+        bs0 = self.acct.original_bs[job_id]
+        if self._at_max_bs(model, bs0) or model not in constants.MAX_BS:
+            flags["big_bs"] = flags["small_bs"] = False
+            return
+        if mode == "gns":
+            new_bs = 2 * old_bs
+        elif mode == "accordion":
+            new_bs = constants.MAX_BS[model] if flags["big_bs"] else bs0
+        else:
+            new_bs = old_bs
+        job.update_bs(new_bs)
+
+        key = (job.job_type, job.scale_factor)
+        for wt in self.workers.worker_types:
+            if (self._oracle_throughputs is None
+                    or key not in self._oracle_throughputs[wt]):
+                logger.error("job %s requested unprofiled bs %s; reverting",
+                             job_id, key)
+                job.update_bs(old_bs)
+                flags["big_bs"] = flags["small_bs"] = False
+                return
+        for wt in self.workers.worker_types:
+            self._throughputs[job_id][wt] = self._oracle_throughputs[wt][key]["null"]
+
+        # Rescale the step budget so total *epochs* are preserved.
+        spe_old = constants.steps_per_epoch(model, old_bs)
+        spe_new = constants.steps_per_epoch(model, new_bs)
+        total_epochs = math.ceil(job.total_steps / spe_old)
+        new_total_steps = math.ceil(job.total_steps * old_bs / new_bs)
+        if math.ceil(new_total_steps / spe_new) != total_epochs:
+            new_total_steps = spe_new * total_epochs
+        job.total_steps = new_total_steps
+
+        completed_epochs = math.ceil(self.acct.total_steps_run[job_id] / spe_old)
+        new_steps_run = completed_epochs * spe_new
+        self.acct.total_steps_run[job_id] = new_steps_run
+        for wt in self.acct.steps_run[job_id]:
+            self.acct.steps_run[job_id][wt] = new_steps_run
+        logger.info("[BS rescale] job %s: bs %d->%d, steps -> %d",
+                    job_id, old_bs, new_bs, new_total_steps)
+        flags["big_bs"] = flags["small_bs"] = False
+
+    # ------------------------------------------------------------------
+    # Done callback
+    # ------------------------------------------------------------------
+
+    def done_callback(self, job_id: JobIdPair, worker_id: int,
+                      all_num_steps: Sequence[int],
+                      all_execution_times: Sequence[float]):
+        """Handle completion of one worker's micro-task for a job round."""
+        a = self.acct
+        to_remove: List[JobIdPair] = []
+        a.run_time_per_worker[job_id].setdefault(worker_id, 0.0)
+        a.run_time_per_worker[job_id][worker_id] += float(np.max(all_execution_times))
+
+        if job_id in a.jobs:
+            run_time_so_far = (sum(a.run_time_per_worker[job_id].values())
+                               / a.jobs[job_id].scale_factor)
+            is_over_deadline = run_time_so_far > int(
+                a.jobs[job_id].duration * DEADLINE_SLACK)
+        else:
+            is_over_deadline = True
+
+        members = job_id.singletons()
+        is_active = {m: m in a.jobs for m in members}
+        if not any(is_active.values()):
+            return
+
+        worker_type = self.workers.id_to_type[worker_id]
+        scale_factor = len(self.rounds.current_assignments.get(job_id, (worker_id,)))
+        self._in_progress_updates.setdefault(job_id, []).append(
+            (worker_id, list(all_num_steps), list(all_execution_times)))
+        if len(self._in_progress_updates[job_id]) < scale_factor:
+            return
+
+        updates = sorted(self._in_progress_updates[job_id], key=lambda u: u[0])
+        self._in_progress_updates[job_id] = []
+        self.rounds.completed_in_round.add(job_id)
+
+        micro_task_succeeded = True
+        agg_steps = [0] * len(members)
+        agg_times = [0.0] * len(members)
+        all_worker_ids = sorted(u[0] for u in updates)
+        for _, num_steps_u, times_u in updates:
+            for j, m in enumerate(members):
+                if not is_active[m]:
+                    continue
+                if num_steps_u[j] <= 0 and times_u[j] <= 0:
+                    micro_task_succeeded = False
+            for j in range(len(members)):
+                agg_steps[j] += num_steps_u[j]
+                agg_times[j] = max(agg_times[j], times_u[j])
+
+        if not micro_task_succeeded:
+            logger.info("[Micro-task failed] job %s", job_id)
+            if not job_id.is_pair() and is_active[job_id]:
+                a.failures[job_id] += 1
+                if a.failures[job_id] >= MAX_FAILED_ATTEMPTS:
+                    logger.info("[Job failed] job %s dropped after %d attempts",
+                                job_id, a.failures[job_id])
+                    to_remove.append(job_id)
+            self._need_to_update_allocation = True
+        else:
+            if not job_id.is_pair():
+                a.failures[job_id] = 0
+            for m, steps, exec_time in zip(members, agg_steps, agg_times):
+                if not is_active[m]:
+                    continue
+                if m in self._running_jobs:
+                    self._running_jobs.remove(m)
+                    a.steps_run[m][worker_type] += steps
+                    a.total_steps_run[m] += steps
+                    self._steps_run_in_current_lease[m] = 0
+                    if self._get_remaining_steps(m) <= 0 or is_over_deadline:
+                        to_remove.append(m)
+            max_time = max(agg_times)
+            if job_id in a.job_time:
+                a.job_time[job_id][worker_type] += max_time
+                a.worker_type_time[worker_type] += max_time
+            for w in all_worker_ids:
+                self.workers.cumulative_time[w] += max_time
+
+        self._update_throughput(job_id, worker_type, agg_steps[0], agg_times[0])
+
+        for m in members:
+            self._scale_bs_and_iters(m)
+        for m in to_remove:
+            self._remove_job(m)
+        for m in members:
+            flags = self._bs_flags.get(m)
+            if flags and (flags["big_bs"] or flags["small_bs"]):
+                self._need_to_update_allocation = True
+
+    # ------------------------------------------------------------------
+    # Shockwave planner sync
+    # ------------------------------------------------------------------
+
+    def _update_shockwave_planner(self):
+        """End-of-round epoch-progress + waiting-delay sync, and periodic
+        re-optimization trigger (reference: scheduler.py:2270-2374)."""
+        planner = self._shockwave_planner
+        scheduled = (self._scheduled_jobs_in_current_round if self._simulate
+                     else self._scheduled_jobs_in_prev_round) or []
+        for int_id in scheduled:
+            job_id = JobIdPair(int_id)
+            if job_id in self._completed_jobs:
+                if int_id in planner.metadata:
+                    planner.mark_progress(int_id, planner.metadata[int_id].epochs)
+                continue
+            steps = self.acct.steps_run.get(job_id, {}).get("v100", 0)
+            job = self.acct.jobs[job_id]
+            epoch = math.floor(
+                steps / constants.steps_per_epoch(job.model, job.batch_size))
+            planner.mark_progress(int_id, epoch)
+        active = {j.integer_job_id() for j in self.acct.jobs}
+        for int_id in active - set(scheduled):
+            planner.add_waiting_delay(int_id, self._time_per_iteration)
+        planner.increment_round()
+        self._rounds_since_reopt += 1
+        if self._shockwave_job_completed or self._rounds_since_reopt >= REOPT_ROUNDS:
+            self._shockwave_job_completed = False
+            self._rounds_since_reopt = 0
+            planner.request_resolve()
+
+    # ------------------------------------------------------------------
+    # Simulation
+    # ------------------------------------------------------------------
+
+    def simulate(self, cluster_spec: Dict[str, int],
+                 arrival_times: Sequence[float], jobs: Sequence[Job],
+                 num_chips_per_server: Optional[Dict[str, int]] = None) -> float:
+        """Discrete-event simulation of a trace. Returns the makespan."""
+        for worker_type in sorted(cluster_spec):
+            chips = (num_chips_per_server or {}).get(worker_type, 1)
+            for _ in range(cluster_spec[worker_type] // chips):
+                self.register_worker(worker_type, num_chips=chips)
+
+        queued = list(zip(arrival_times, jobs))
+        remaining_jobs = len(jobs)
+        running: List[tuple] = []  # heap of (-finish_time, job_id, worker_ids, steps)
+        self._current_timestamp = arrival_times[0] if len(arrival_times) else 0.0
+        current_round = 0
+        current_round_start = 0.0
+        current_round_end: Optional[float] = None
+
+        while remaining_jobs > 0:
+            next_arrival = queued[0][0] if queued else None
+
+            # Advance the clock to the next event.
+            max_ts = 0.0
+            if running and -running[0][0] > max_ts:
+                max_ts = -running[0][0]
+                if current_round_end is not None:
+                    current_round_start = current_round_end
+                current_round_end = max_ts
+            if max_ts > 0:
+                self._current_timestamp = max_ts
+            elif next_arrival is not None:
+                self._current_timestamp = next_arrival
+            else:
+                logger.warning("no running jobs and no arrivals; stopping")
+                break
+
+            # Drain jobs finishing this round.
+            while running:
+                neg_finish, job_id, worker_ids, all_num_steps = running[0]
+                finish_time = -neg_finish
+                if finish_time > self._current_timestamp:
+                    break
+                slowdown = 1.0
+                execution_time = finish_time - current_round_start
+                if current_round >= 2:
+                    prev_sched = self.rounds.per_round_schedule[current_round - 2]
+                    for m in job_id.singletons():
+                        if m.integer_job_id() not in prev_sched:
+                            # Preempted last round: charge checkpoint/restore.
+                            if (execution_time != 0 and
+                                    self._time_per_iteration - 5 < execution_time):
+                                slowdown = ((execution_time - PREEMPTION_OVERHEAD_S)
+                                            / execution_time)
+                                execution_time -= PREEMPTION_OVERHEAD_S
+                            break
+                all_execution_times = []
+                for m in job_id.singletons():
+                    all_execution_times.append(execution_time)
+                    self.acct.latest_timestamps[m] = finish_time
+                self._in_progress_updates[job_id] = []
+                scale_factor = len(worker_ids)
+                adj_steps = [int(s * slowdown) for s in all_num_steps]
+                assigned = [0] * len(adj_steps)
+                for i, worker_id in enumerate(worker_ids):
+                    if i == scale_factor - 1:
+                        per_worker = [adj_steps[j] - assigned[j]
+                                      for j in range(len(adj_steps))]
+                    else:
+                        per_worker = [s // scale_factor for s in adj_steps]
+                    for j in range(len(per_worker)):
+                        assigned[j] += per_worker[j]
+                    self.done_callback(job_id, worker_id, per_worker,
+                                       all_execution_times)
+                for m in job_id.singletons():
+                    if m not in self.acct.jobs:
+                        remaining_jobs -= 1
+                heapq.heappop(running)
+
+            # Adaptation oracles run between rounds.
+            for job_id in list(self.acct.jobs):
+                mode = self.acct.jobs[job_id].mode
+                if mode == "accordion":
+                    self._simulate_accordion(job_id)
+                elif mode == "gns":
+                    self._simulate_gns(job_id)
+
+            if (self._shockwave_planner is not None
+                    and self._current_timestamp != 0.0
+                    and self._scheduled_jobs_in_current_round is not None):
+                self._update_shockwave_planner()
+
+            assert not running
+
+            # Admit arrivals.
+            while queued and queued[0][0] <= self._current_timestamp:
+                arrival_time, job = queued.pop(0)
+                self.add_job(job, timestamp=arrival_time)
+
+            if not self.acct.jobs:
+                if not queued:
+                    break
+                continue
+
+            # Schedule the next round.
+            assignments = self._schedule_jobs_on_workers()
+            for job_id in self.rounds.current_assignments:
+                if any(m in self.acct.jobs for m in job_id.singletons()):
+                    self.rounds.num_lease_opportunities += 1
+            for job_id in assignments:
+                if job_id in self.rounds.current_assignments:
+                    if set(self.rounds.current_assignments[job_id]) == set(
+                            assignments[job_id]):
+                        self.rounds.num_lease_extensions += 1
+            self.rounds.current_assignments = assignments
+
+            for job_id, worker_ids in assignments.items():
+                worker_type = self.workers.id_to_type[worker_ids[0]]
+                all_num_steps, finish_time = self._steps_and_finish_time(
+                    job_id, worker_type)
+                heapq.heappush(running,
+                               (-finish_time, job_id, worker_ids, all_num_steps))
+
+            current_round += 1
+            self.rounds.num_completed_rounds += 1
+            if (self._config.max_rounds is not None
+                    and self.rounds.num_completed_rounds >= self._config.max_rounds):
+                break
+
+        logger.info("Simulation done: makespan %.1fs (%.2fh)",
+                    self._current_timestamp, self._current_timestamp / 3600)
+        return self._current_timestamp
+
+    def _steps_and_finish_time(self, job_id: JobIdPair, worker_type: str):
+        """Oracle-throughput step count and finish time for the next round."""
+        now = self.get_current_timestamp()
+        max_finish = now
+        all_num_steps = []
+        for m in job_id.singletons():
+            tput = self._oracle_step_throughput(job_id, worker_type, m)
+            num_steps = min(int(tput * self._time_per_iteration),
+                            self._get_remaining_steps(m))
+            all_num_steps.append(num_steps)
+            if tput <= 0:
+                raise RuntimeError(f"zero throughput for {m} on {worker_type}")
+            max_finish = max(max_finish, now + num_steps / tput)
+            self._running_jobs.add(m)
+        return all_num_steps, max_finish
+
+    def _oracle_step_throughput(self, job_id, worker_type, member):
+        if job_id.is_pair():
+            idx = job_id.as_tuple().index(member[0])
+            job_types = [
+                (self.acct.jobs[m].job_type, self.acct.jobs[m].scale_factor)
+                for m in job_id.singletons()]
+            return self._oracle_throughputs[worker_type][job_types[0]][job_types[1]][idx]
+        return self._throughputs[job_id][worker_type]
+
+    # ------------------------------------------------------------------
+    # Metrics
+    # ------------------------------------------------------------------
+
+    def get_average_jct(self, job_ids=None):
+        ct = self.acct.completion_times
+        if not ct:
+            return None
+        job_ids = sorted(job_ids if job_ids is not None else ct.keys())
+        times = [ct[j] for j in job_ids if ct[j] is not None]
+        if not times:
+            return None
+        import scipy.stats
+        return (float(np.mean(times)),
+                float(scipy.stats.mstats.gmean(times)),
+                float(scipy.stats.hmean(times)),
+                times)
+
+    def get_finish_time_fairness(self, job_ids=None):
+        """Per-job rho = JCT / (isolated runtime * contention factor), with
+        both static and Themis-style contention factors
+        (reference: scheduler.py:2865-2964)."""
+        ct = self.acct.completion_times
+        if not ct:
+            return [], []
+        num_chips = len(self.workers.worker_ids)
+        job_ids = sorted(job_ids if job_ids is not None else ct.keys())
+        static_list, themis_list = [], []
+        for job_id in job_ids:
+            completion_time = ct[job_id]
+            if completion_time is None:
+                continue
+            int_id = job_id.integer_job_id()
+            exclusive = sum(self._profiles[int_id]["duration_every_epoch"]) \
+                if self._profiles else None
+            if exclusive is None:
+                continue
+            static_cf = max(1.0, self._num_jobs_in_trace / num_chips)
+            static_list.append(round(completion_time / (exclusive * static_cf), 5))
+            start_r = self.rounds.job_start_round.get(int_id, 0)
+            end_r = self.rounds.job_end_round.get(int_id, start_r)
+            window = self.rounds.jobs_in_round[start_r:end_r]
+            themis_cf = max(1.0, float(np.mean(window)) / num_chips) if window else 1.0
+            themis_list.append(round(completion_time / (exclusive * themis_cf), 5))
+        return static_list, themis_list
+
+    def get_cluster_utilization(self):
+        utils = []
+        now = self.get_current_timestamp()
+        for worker_id, busy in self.workers.cumulative_time.items():
+            total = now - self.workers.start_times[worker_id]
+            if total > 0:
+                utils.append(round(busy / total, 5))
+        return (float(np.mean(utils)) if utils else 0.0), utils
+
+    def get_envy_ratios(self):
+        ratios = {}
+        for int_id in range(self._job_id_counter):
+            s = self.rounds.num_scheduled_rounds.get(int_id, 0)
+            q = self.rounds.num_queued_rounds.get(int_id, 0)
+            if s + q > 0:
+                ratios[int_id] = s / (s + q)
+        values = list(ratios.values())
+        pairwise = [abs(a - b) for i, a in enumerate(values)
+                    for b in values[:i]]
+        return ratios, pairwise
+
+    def get_num_lease_extensions(self):
+        opp = self.rounds.num_lease_opportunities
+        ext = self.rounds.num_lease_extensions
+        return ((100.0 * ext / opp) if opp else 0.0, ext, opp)
+
+    def get_makespan(self) -> float:
+        return self._current_timestamp

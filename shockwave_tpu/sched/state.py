@@ -1,0 +1,74 @@
+"""Scheduler bookkeeping state, grouped by concern.
+
+The reference keeps ~60 ad-hoc dicts on one object
+(scheduler.py:84-484); here the per-job accounting lives in one dataclass
+per concern so invariants are visible.
+"""
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core.job import Job, JobIdPair
+
+
+@dataclass
+class WorkerState:
+    """Registry of workers (one entry per accelerator chip)."""
+
+    worker_ids: List[int] = field(default_factory=list)
+    worker_types: Set[str] = field(default_factory=set)
+    id_to_type: Dict[int, str] = field(default_factory=dict)
+    # worker_type -> list of per-server lists of chip ids (for strided
+    # assignment that minimizes the number of servers a job spans).
+    type_to_server_ids: Dict[str, List[List[int]]] = field(default_factory=dict)
+    cluster_spec: Dict[str, int] = field(default_factory=dict)
+    start_times: Dict[int, float] = field(default_factory=dict)
+    cumulative_time: Dict[int, float] = field(default_factory=dict)
+    next_worker_id: int = 0
+
+
+@dataclass
+class JobAccounting:
+    """Per-job progress and fair-share accounting."""
+
+    jobs: Dict[JobIdPair, Job] = field(default_factory=dict)
+    # steps run per worker type and in total (adaptation rescales these).
+    steps_run: Dict[JobIdPair, Dict[str, int]] = field(default_factory=dict)
+    total_steps_run: Dict[JobIdPair, int] = field(default_factory=dict)
+    # wall-clock run time per job per worker id (for deadline enforcement).
+    run_time_per_worker: Dict[JobIdPair, Dict[int, float]] = field(default_factory=dict)
+    # time accounting since the last fair-share reset.
+    job_time: Dict[JobIdPair, Dict[str, float]] = field(default_factory=dict)
+    worker_type_time: Dict[str, float] = field(default_factory=dict)
+    # lifecycle timestamps and outcomes.
+    start_timestamps: Dict[JobIdPair, float] = field(default_factory=dict)
+    latest_timestamps: Dict[JobIdPair, Optional[float]] = field(default_factory=dict)
+    completion_times: Dict[JobIdPair, Optional[float]] = field(default_factory=dict)
+    priority_weights_archive: Dict[JobIdPair, float] = field(default_factory=dict)
+    failures: Dict[JobIdPair, int] = field(default_factory=dict)
+    # original (pre-adaptation) shape of each job.
+    original_bs: Dict[JobIdPair, int] = field(default_factory=dict)
+    original_num_steps: Dict[JobIdPair, int] = field(default_factory=dict)
+    original_job_type: Dict[JobIdPair, str] = field(default_factory=dict)
+
+
+@dataclass
+class RoundState:
+    """State of the round-based mechanism."""
+
+    current_assignments: "collections.OrderedDict[JobIdPair, Tuple[int, ...]]" = field(
+        default_factory=collections.OrderedDict)
+    next_assignments: Optional[dict] = None
+    completed_in_round: Set[JobIdPair] = field(default_factory=set)
+    extended_leases: Set[JobIdPair] = field(default_factory=set)
+    num_lease_extensions: int = 0
+    num_lease_opportunities: int = 0
+    num_completed_rounds: int = 0
+    per_round_schedule: List[dict] = field(default_factory=list)
+    jobs_in_round: List[int] = field(default_factory=list)
+    job_start_round: Dict[int, int] = field(default_factory=dict)
+    job_end_round: Dict[int, int] = field(default_factory=dict)
+    num_scheduled_rounds: Dict[int, int] = field(default_factory=dict)
+    num_queued_rounds: Dict[int, int] = field(default_factory=dict)

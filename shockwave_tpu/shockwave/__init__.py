@@ -1,0 +1,4 @@
+from .metadata import JobMetadata
+from .planner import ShockwavePlanner
+
+__all__ = ["JobMetadata", "ShockwavePlanner"]

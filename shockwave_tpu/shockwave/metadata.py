@@ -1,0 +1,164 @@
+"""Per-job epoch-granular metadata for the Shockwave planner.
+
+Tracks profiled per-epoch durations and batch-size schedules, calibrates
+the profile online against measured throughput, and provides the Bayesian
+(Dirichlet) remaining-runtime estimate the market solver plans with
+(reference: scheduler/JobMetaData.py).
+
+The Dirichlet predictor treats the distinct batch sizes a job has used as
+modes of a categorical distribution; observing the realized schedule up to
+the current epoch sharpens the posterior over how many future epochs run
+at each batch size, and the expected remaining runtime is the posterior-
+weighted sum of per-mode epoch durations.
+"""
+from __future__ import annotations
+
+import copy
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+INFINITY = 1e9
+
+
+class JobMetadata:
+    def __init__(self, job_id: int, profile: dict, overclock: float = 1.0):
+        self.jobid = job_id
+        self.model = profile["model"]
+        self.dataset = profile["dataset"]
+        self.jobname = f"ID_{job_id}_{self.model}_{self.dataset}"
+        self.nworkers = int(profile.get("scale_factor", 1))
+        self.epochs = int(profile["num_epochs"])
+        assert self.epochs > 0
+        self.epoch_nsamples = profile["num_samples_per_epoch"]
+        self.epoch_gpu_req = list(profile["util_every_epoch"])
+        self.epoch_gram_req = [round(mb / 1024.0, 1) for mb in profile["mem_every_epoch"]]
+        self.epoch_duration = [
+            max(1.0, round(d)) / overclock for d in profile["duration_every_epoch"]]
+        self.epoch_duration = [max(1.0, d) for d in self.epoch_duration]
+        self.epoch_duration_preprofiled = list(self.epoch_duration)
+        self.bs_schedule = list(profile["bs_every_epoch"])
+        assert len(self.bs_schedule) == self.epochs == len(self.epoch_duration)
+
+        self.bs_modes = sorted(set(self.bs_schedule))
+        self.bs_dirichlet_prior = {
+            bs: self.epochs / len(self.bs_modes) for bs in self.bs_modes}
+
+        self.epoch_progress = 0
+        self.waiting_delay = 0.0
+        self.timestamp_submit: Optional[float] = None
+        self.timestamp_completion: Optional[float] = None
+
+        self._throughput_measurements: Optional[OrderedDict] = None
+        self._round_duration: Optional[float] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def register_submit(self, time: float) -> None:
+        if self.timestamp_submit is None:
+            self.timestamp_submit = time
+
+    def register_completion(self, time: float) -> None:
+        if self.timestamp_completion is None:
+            self.timestamp_completion = time
+
+    def set_epoch_progress(self, progress: int) -> None:
+        assert 0 <= progress <= self.epochs
+        self.epoch_progress = progress
+
+    def add_waiting_delay(self, delay: float) -> None:
+        self.waiting_delay += delay
+
+    def reset_waiting_delay(self) -> None:
+        self.waiting_delay = 0.0
+
+    def attach_throughput_measurements(self, measurements: OrderedDict,
+                                       round_duration: float) -> None:
+        """Share the scheduler's per-round (throughput, bs) timeline."""
+        self._throughput_measurements = measurements
+        self._round_duration = round_duration
+
+    # -- calibration -------------------------------------------------------
+
+    def calibrate_profiled_epoch_duration(self) -> None:
+        """Rescale the profiled epoch durations when the measured sample
+        rate deviates >40% from the profile (reference: JobMetaData.py:225-288)."""
+        if not self._throughput_measurements:
+            return
+        timeline = sorted(self._throughput_measurements.keys())
+        prev_round = 0
+        measured_nsamples = 0.0
+        for cur_round in timeline:
+            tput, bs = self._throughput_measurements[cur_round]
+            measured_nsamples += bs * tput * self._round_duration * (cur_round - prev_round)
+            prev_round = cur_round
+        measured_time_range = self._round_duration * max(timeline)
+
+        preprofiled_time = 0.0
+        preprofiled_nsamples = 0.0
+        iepoch = 0
+        for iepoch, duration in enumerate(self.epoch_duration_preprofiled):
+            if preprofiled_time + duration > measured_time_range:
+                break
+            preprofiled_time += duration
+            preprofiled_nsamples += self.epoch_nsamples
+        deficit = measured_time_range - preprofiled_time
+        if deficit > 0:
+            preprofiled_nsamples += (
+                self.epoch_nsamples * deficit / self.epoch_duration[iepoch])
+
+        if (measured_nsamples <= 0 or preprofiled_nsamples <= 0
+                or abs(measured_nsamples - preprofiled_nsamples)
+                / preprofiled_nsamples <= 0.4):
+            return
+        amp = preprofiled_nsamples / measured_nsamples
+        self.epoch_duration = [
+            d * amp for d in self.epoch_duration_preprofiled]
+
+    # -- prediction --------------------------------------------------------
+
+    def bs_epoch_duration_map(self) -> Dict[int, float]:
+        self.calibrate_profiled_epoch_duration()
+        buckets: Dict[int, List[float]] = {}
+        for bs, duration in zip(self.bs_schedule, self.epoch_duration):
+            buckets.setdefault(bs, []).append(duration)
+        out = {}
+        for bs, durations in buckets.items():
+            mean = float(np.mean(durations))
+            assert 0 < mean < INFINITY
+            out[bs] = mean
+        return out
+
+    def dirichlet_posterior_remaining_runtime(self, progress: Optional[int] = None,
+                                              oracle: bool = False) -> float:
+        if progress is None:
+            progress = self.epoch_progress
+        assert 0 <= progress <= self.epochs
+        if oracle:
+            return sum(self.epoch_duration[self.epoch_progress:])
+
+        observed = self.bs_schedule[:progress + 1]
+        posterior = copy.deepcopy(self.bs_dirichlet_prior)
+        for bs in observed:
+            posterior[bs] += 1
+        total = sum(posterior.values())
+        rebased = {bs: self.epochs * c / total for bs, c in posterior.items()}
+        for bs in observed:
+            if rebased[bs] >= 1:
+                rebased[bs] -= 1
+        if not rebased:
+            return 1.0
+        inflated = int(sum(rebased.values()) + 1)
+        remaining = self.epochs - self.epoch_progress
+        inflated = max(inflated, remaining)
+        if inflated <= 0 or remaining <= 0:
+            return 1.0
+        durations = self.bs_epoch_duration_map()
+        runtime = sum(rebased[bs] * durations[bs] for bs in rebased)
+        return runtime * remaining / inflated
+
+    def interpolated_epoch_duration(self) -> float:
+        """Mean profiled duration of the epochs seen so far (+1)."""
+        self.calibrate_profiled_epoch_duration()
+        return float(np.mean(self.epoch_duration[:self.epoch_progress + 1]))

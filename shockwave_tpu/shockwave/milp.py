@@ -1,0 +1,309 @@
+"""Dynamic Eisenberg-Gale scheduling MILP on scipy/HiGHS.
+
+Plans a boolean job x round schedule over a future horizon maximizing
+approximate Nash social welfare over per-job training *progress*, with a
+makespan regularizer and finish-time-fairness (FTF) constraints
+(reference: scheduler/shockwave.py:288-711). The reference encodes this
+in cvxpy and solves with Gurobi; here the model is assembled as sparse
+matrices for scipy.optimize.milp (HiGHS), with the same infeasibility
+fallback chain: drop FTF constraints, boost utilities of rho-violating
+jobs by ratio**lambda, re-solve, then re-rank rounds to front-load
+high-priority jobs.
+
+Model per job j (horizon R rounds, log-approximation bases B):
+  x[j,r] in {0,1}   job scheduled in round r
+  p[j] >= 0         planned progress in epochs
+  w[j,b] >= 0       SOS2-ish cursor weights over the log bases
+  z[j,b] in {0,1}   which (at most 2, adjacent) bases are active
+  s[j] >= 0         remaining runtime after the plan
+
+  p[j] * dur[j] <= round_duration * sum_r x[j,r]
+  sum_b w[j,b] * base[b] = (progress[j] + p[j]) / epochs[j]
+  sum_b w[j,b] = 1;  w[j,b] <= z[j,b];  sum_b z[j,b] <= 2
+  z[j,l] + z[j,r] <= 1 for |l-r| >= 2           (adjacency)
+  s[j] >= D[j] - p[j] * dur[j]                  (D = Dirichlet remaining)
+  s[j] <= (rhomax * runavg[j] - T_next) * share (FTF; first attempt only)
+  sum_j nworkers[j] * x[j,r] <= ngpus           (capacity per round)
+
+  maximize sum_j prio[j] * (sum_b w[j,b]*log(base[b])) / (njobs*R) - k*max_j s[j]
+"""
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+from scipy import sparse
+from scipy.optimize import Bounds, LinearConstraint, milp
+
+logger = logging.getLogger("shockwave_tpu.shockwave")
+
+
+@dataclass
+class MilpOptions:
+    rel_gap: float = 1e-3
+    timeout: float = 15.0
+    rhomax: float = 1.0
+    k: float = 1e-3
+    lam: float = 12.0
+    logapx_bases: Sequence[float] = (0.0, 0.2, 0.4, 0.6, 0.8, 1.0)
+    logapx_origin: float = 1e-6
+
+
+def finish_time_momentumed_average(series, round_index, momentum=0.9) -> float:
+    """Running average of finish-time estimates weighted by how long each
+    estimate was current, blended with the latest estimate
+    (reference: shockwave.py:480-501)."""
+    assert len(series) > 0
+    rounds = [r for r, _ in series] + [round_index]
+    windows = np.diff(rounds)
+    if windows.max(initial=0) == 0:
+        probs = [1.0]
+    else:
+        probs = (windows / windows.sum()).tolist()
+    values = [v for _, v in series]
+    running = sum(p * v for p, v in zip(probs, values))
+    return momentum * running + (1.0 - momentum) * values[-1]
+
+
+class _Layout:
+    """Variable indexing for the MILP."""
+
+    def __init__(self, njobs: int, nrounds: int, nbases: int):
+        self.R, self.B = nrounds, nbases
+        self.stride = nrounds + 1 + 2 * nbases + 1
+        self.njobs = njobs
+        self.n = njobs * self.stride + 1  # + global t
+
+    def x(self, j, r): return j * self.stride + r
+    def p(self, j): return j * self.stride + self.R
+    def w(self, j, b): return j * self.stride + self.R + 1 + b
+    def z(self, j, b): return j * self.stride + self.R + 1 + self.B + b
+    def s(self, j): return j * self.stride + self.R + 1 + 2 * self.B
+    @property
+    def t(self): return self.n - 1
+
+
+def _solve(c, A_ub, b_ub, A_eq, b_eq, integrality, ub, opts: MilpOptions,
+           timeout_scale: float = 1.0):
+    constraints = []
+    if len(b_ub):
+        constraints.append(LinearConstraint(A_ub, -np.inf, b_ub))
+    if len(b_eq):
+        constraints.append(LinearConstraint(A_eq, b_eq, b_eq))
+    res = milp(
+        c, constraints=constraints, integrality=integrality,
+        bounds=Bounds(np.zeros_like(ub), ub),
+        options={"time_limit": opts.timeout * timeout_scale,
+                 "mip_rel_gap": opts.rel_gap, "presolve": True},
+    )
+    return res
+
+
+def plan_schedule(jobs, round_index: int, future_nrounds: int,
+                  round_duration: float, ngpus: int, share_series: List[list],
+                  opts: MilpOptions) -> np.ndarray:
+    """Returns a boolean (njobs x future_nrounds) schedule matrix."""
+    njobs = len(jobs)
+    bases = list(opts.logapx_bases)
+    assert bases[0] == 0.0
+    base_logs = [math.log(opts.logapx_origin)] + [math.log(b) for b in bases[1:]]
+    L = _Layout(njobs, future_nrounds, len(bases))
+
+    nworkers = [job.nworkers for job in jobs]
+    durations = [job.interpolated_epoch_duration() for job in jobs]
+    dirichlet = [job.dirichlet_posterior_remaining_runtime() for job in jobs]
+    progress = [job.epoch_progress for job in jobs]
+    epochs = [job.epochs for job in jobs]
+
+    future_share = min(1.0, ngpus / njobs)
+    next_sched_time = round_duration * (round_index + future_nrounds)
+    runavg = [finish_time_momentumed_average(share_series[j], round_index)
+              for j in range(njobs)]
+    ftf_caps = [(opts.rhomax * runavg[j] - next_sched_time) * future_share
+                for j in range(njobs)]
+
+    def assemble(priorities, with_ftf: bool):
+        rows_ub, cols_ub, vals_ub, b_ub = [], [], [], []
+        rows_eq, cols_eq, vals_eq, b_eq = [], [], [], []
+
+        def add_ub(entries, rhs):
+            r = len(b_ub)
+            for col, val in entries:
+                rows_ub.append(r); cols_ub.append(col); vals_ub.append(val)
+            b_ub.append(rhs)
+
+        def add_eq(entries, rhs):
+            r = len(b_eq)
+            for col, val in entries:
+                rows_eq.append(r); cols_eq.append(col); vals_eq.append(val)
+            b_eq.append(rhs)
+
+        # Capacity per round.
+        for r in range(future_nrounds):
+            add_ub([(L.x(j, r), nworkers[j]) for j in range(njobs)], ngpus)
+
+        for j in range(njobs):
+            # Planned runtime bounded by scheduled rounds.
+            add_ub([(L.p(j), durations[j])]
+                   + [(L.x(j, r), -round_duration) for r in range(future_nrounds)], 0.0)
+            # Log approximation cursor.
+            add_eq([(L.w(j, b), bases[b]) for b in range(L.B)]
+                   + [(L.p(j), -1.0 / epochs[j])], progress[j] / epochs[j])
+            add_eq([(L.w(j, b), 1.0) for b in range(L.B)], 1.0)
+            for b in range(L.B):
+                add_ub([(L.w(j, b), 1.0), (L.z(j, b), -1.0)], 0.0)
+            add_ub([(L.z(j, b), 1.0) for b in range(L.B)], 2.0)
+            for lo in range(L.B - 2):
+                for hi in range(lo + 2, L.B):
+                    add_ub([(L.z(j, lo), 1.0), (L.z(j, hi), 1.0)], 1.0)
+            # Remaining runtime after plan.
+            add_ub([(L.s(j), -1.0), (L.p(j), -durations[j])], -dirichlet[j])
+            # Makespan regularizer linkage.
+            add_ub([(L.s(j), 1.0), (L.t, -1.0)], 0.0)
+            if with_ftf:
+                if ftf_caps[j] < 0:
+                    return None  # provably infeasible
+                add_ub([(L.s(j), 1.0)], ftf_caps[j])
+
+        A_ub = sparse.coo_matrix((vals_ub, (rows_ub, cols_ub)),
+                                 shape=(len(b_ub), L.n)).tocsr()
+        A_eq = sparse.coo_matrix((vals_eq, (rows_eq, cols_eq)),
+                                 shape=(len(b_eq), L.n)).tocsr()
+
+        c = np.zeros(L.n)
+        for j in range(njobs):
+            for b in range(L.B):
+                c[L.w(j, b)] = -priorities[j] * base_logs[b] / (njobs * future_nrounds)
+        c[L.t] = opts.k
+
+        integrality = np.zeros(L.n)
+        ub = np.full(L.n, np.inf)
+        for j in range(njobs):
+            for r in range(future_nrounds):
+                integrality[L.x(j, r)] = 1
+                ub[L.x(j, r)] = 1
+            for b in range(L.B):
+                integrality[L.z(j, b)] = 1
+                ub[L.z(j, b)] = 1
+                ub[L.w(j, b)] = 1
+        return c, A_ub, np.array(b_ub), A_eq, np.array(b_eq), integrality, ub
+
+    # -- first attempt: with FTF constraints ------------------------------
+    ones = [1.0] * njobs
+    model = assemble(ones, with_ftf=True)
+    res = None
+    if model is not None:
+        res = _solve(*model, opts)
+    if model is not None and res.x is not None and res.status in (0, 1):
+        x = _extract(res.x, L, njobs, future_nrounds)
+        return x
+
+    # -- fallback: relax FTF, boost violating jobs' utilities -------------
+    logger.info("FTF constraints infeasible at round %d; relaxing", round_index)
+    priorities = _relaxation_priorities(
+        jobs, dirichlet, runavg, round_index, round_duration, future_share,
+        opts.rhomax, opts.lam)
+    model = assemble(priorities, with_ftf=False)
+    res = _solve(*model, opts)
+    if res.x is None:
+        logger.warning("relaxed MILP failed (%s); greedy fallback", res.status)
+        return _greedy_fallback(jobs, future_nrounds, ngpus, dirichlet)
+    x = _extract(res.x, L, njobs, future_nrounds)
+    return _rank_in_schedule(x, priorities, nworkers, ngpus, opts)
+
+
+def _extract(xvec, L, njobs, nrounds) -> np.ndarray:
+    out = np.zeros((njobs, nrounds), dtype=bool)
+    for j in range(njobs):
+        for r in range(nrounds):
+            out[j, r] = round(xvec[L.x(j, r)]) == 1
+    return out
+
+
+def _relaxation_priorities(jobs, dirichlet, runavg, round_index,
+                           round_duration, future_share, rhomax, lam):
+    """Priority = projected-rho**lambda for jobs violating rhomax
+    (reference: shockwave.py:830-911)."""
+    PRIORITY_M = 1e2
+    priorities = []
+    round_time = round_duration * round_index
+    for j, job in enumerate(jobs):
+        job.calibrate_profiled_epoch_duration()
+        remaining = dirichlet[j]
+        projected_finish = round_time + remaining / future_share
+        ratio = projected_finish / runavg[j]
+        if ratio > rhomax:
+            power = PRIORITY_M if remaining < round_duration else lam
+            priorities.append(ratio ** power)
+        else:
+            priorities.append(1.0)
+    return priorities
+
+
+def _rank_in_schedule(x: np.ndarray, priorities, nworkers, ngpus,
+                      opts: MilpOptions) -> np.ndarray:
+    """Second MILP: keep each job's number of scheduled rounds but permute
+    rounds so high-priority jobs run earlier (reference: shockwave.py:714-793)."""
+    njobs, nrounds = x.shape
+    counts = x.sum(axis=1)
+    if not np.any(counts > 0):
+        return x
+
+    n = njobs * nrounds
+    rows_ub, cols_ub, vals_ub, b_ub = [], [], [], []
+    rows_eq, cols_eq, vals_eq, b_eq = [], [], [], []
+    for r in range(nrounds):
+        row = len(b_ub)
+        for j in range(njobs):
+            rows_ub.append(row); cols_ub.append(j * nrounds + r)
+            vals_ub.append(nworkers[j])
+        b_ub.append(ngpus)
+    for j in range(njobs):
+        row = len(b_eq)
+        for r in range(nrounds):
+            rows_eq.append(row); cols_eq.append(j * nrounds + r); vals_eq.append(1.0)
+        b_eq.append(float(counts[j]))
+
+    c = np.zeros(n)
+    for j in range(njobs):
+        if counts[j] > 0:
+            for r in range(nrounds):
+                c[j * nrounds + r] = priorities[j] * r / counts[j]
+
+    res = milp(
+        c,
+        constraints=[
+            LinearConstraint(
+                sparse.coo_matrix((vals_ub, (rows_ub, cols_ub)), shape=(len(b_ub), n)).tocsr(),
+                -np.inf, np.array(b_ub)),
+            LinearConstraint(
+                sparse.coo_matrix((vals_eq, (rows_eq, cols_eq)), shape=(len(b_eq), n)).tocsr(),
+                np.array(b_eq), np.array(b_eq)),
+        ],
+        integrality=np.ones(n),
+        bounds=Bounds(np.zeros(n), np.ones(n)),
+        options={"time_limit": opts.timeout, "mip_rel_gap": opts.rel_gap,
+                 "presolve": True},
+    )
+    if res.x is None:
+        return x
+    return np.round(res.x.reshape((njobs, nrounds))).astype(bool)
+
+
+def _greedy_fallback(jobs, nrounds, ngpus, dirichlet) -> np.ndarray:
+    """Last-resort heuristic: longest remaining runtime first, every round."""
+    njobs = len(jobs)
+    order = sorted(range(njobs), key=lambda j: -dirichlet[j])
+    x = np.zeros((njobs, nrounds), dtype=bool)
+    for r in range(nrounds):
+        free = ngpus
+        for j in order:
+            if jobs[j].nworkers <= free:
+                x[j, r] = True
+                free -= jobs[j].nworkers
+            if free <= 0:
+                break
+    return x

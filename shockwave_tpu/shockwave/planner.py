@@ -1,0 +1,151 @@
+"""Shockwave planner: owns job metadata, solve cadence, and round schedules.
+
+Wraps the EG MILP (milp.py) with: uniform-share finish-time estimation,
+schedule caching between re-solves, and work-conserving backfill of idle
+chips (reference: scheduler/shockwave.py:20-285).
+"""
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from .metadata import JobMetadata
+from .milp import MilpOptions, plan_schedule
+
+logger = logging.getLogger("shockwave_tpu.shockwave")
+
+
+class ShockwavePlanner:
+    def __init__(self, ngpus: int, future_nrounds: int, round_duration: float,
+                 opts: Optional[MilpOptions] = None):
+        assert ngpus > 0 and future_nrounds > 0 and round_duration > 0
+        self.ngpus = ngpus
+        self.future_nrounds = future_nrounds
+        self.round_duration = round_duration
+        self.opts = opts or MilpOptions()
+
+        self.metadata: "OrderedDict[int, JobMetadata]" = OrderedDict()
+        self.completed: "OrderedDict[int, JobMetadata]" = OrderedDict()
+        self.schedules: "OrderedDict[int, List[int]]" = OrderedDict()
+        self.round_ptr = 0
+        self._resolve = True
+        self._reestimate_share = True
+        self.share_series: Dict[int, list] = {}
+
+    @classmethod
+    def from_config(cls, config: dict) -> "ShockwavePlanner":
+        opts = MilpOptions(
+            rel_gap=config.get("solver_rel_gap", 1e-3),
+            timeout=config.get("solver_timeout", 15),
+            rhomax=config.get("rhomax", 1.0),
+            k=config.get("k", 1e-3),
+            lam=config.get("lambda", 12.0),
+            logapx_bases=tuple(config.get(
+                "log_approximation_bases", (0.0, 0.2, 0.4, 0.6, 0.8, 1.0))),
+        )
+        return cls(
+            ngpus=config["num_gpus"],
+            future_nrounds=config.get("future_rounds", 20),
+            round_duration=config["time_per_iteration"],
+            opts=opts,
+        )
+
+    # -- job lifecycle -----------------------------------------------------
+
+    def add_job(self, job_id: int, meta: JobMetadata) -> None:
+        assert job_id not in self.metadata
+        self.metadata[job_id] = meta
+        self.request_resolve()
+        self._reestimate_share = True
+
+    def remove_job(self, job_id: int) -> None:
+        assert job_id in self.metadata and job_id not in self.completed
+        self.completed[job_id] = self.metadata.pop(job_id)
+        self.request_resolve()
+        self._reestimate_share = True
+
+    def mark_progress(self, job_id: int, epoch_progress: int) -> None:
+        meta = self.metadata.get(job_id) or self.completed.get(job_id)
+        if meta is None:
+            return
+        meta.set_epoch_progress(min(epoch_progress, meta.epochs))
+        meta.reset_waiting_delay()
+
+    def add_waiting_delay(self, job_id: int, delay: float) -> None:
+        if job_id in self.metadata:
+            self.metadata[job_id].add_waiting_delay(delay)
+
+    def increment_round(self) -> None:
+        self.round_ptr += 1
+
+    def request_resolve(self) -> None:
+        self._resolve = True
+
+    # -- share estimation --------------------------------------------------
+
+    def _estimate_uniform_share_finish_times(self) -> None:
+        """Record each job's finish-time estimate under a uniform 1/n share;
+        the momentumed average of these is the FTF target
+        (reference: shockwave.py:88-120)."""
+        if not self._reestimate_share:
+            return
+        njobs = len(self.metadata)
+        for job_id, job in self.metadata.items():
+            share = min(1.0, self.ngpus / njobs)
+            job.calibrate_profiled_epoch_duration()
+            estimate = job.timestamp_submit + (
+                sum(job.epoch_duration[:job.epoch_progress])
+                + job.dirichlet_posterior_remaining_runtime(job.epoch_progress)
+            ) / share
+            self.share_series.setdefault(job_id, []).append(
+                (self.round_ptr, estimate))
+        self._reestimate_share = False
+
+    # -- scheduling --------------------------------------------------------
+
+    def round_schedule(self) -> List[int]:
+        """Job ids to run this round, re-solving the MILP if requested."""
+        if not self._resolve and self.round_ptr in self.schedules:
+            return self.schedules[self.round_ptr]
+
+        job_ids = list(self.metadata.keys())
+        jobs = list(self.metadata.values())
+        if not jobs:
+            return []
+
+        self._estimate_uniform_share_finish_times()
+        share_series = [self.share_series[j] for j in job_ids]
+
+        x = plan_schedule(jobs, self.round_ptr, self.future_nrounds,
+                          self.round_duration, self.ngpus, share_series,
+                          self.opts)
+        self.schedules = self._construct_schedules(x, job_ids, jobs)
+        self._resolve = False
+        return self.schedules[self.round_ptr]
+
+    def _construct_schedules(self, x, job_ids, jobs) -> "OrderedDict[int, List[int]]":
+        """Solution matrix -> per-round job lists, with work-conserving
+        backfill of idle chips by longest remaining runtime
+        (reference: shockwave.py:213-285)."""
+        schedules: "OrderedDict[int, List[int]]" = OrderedDict()
+        for r in range(self.future_nrounds):
+            round_index = self.round_ptr + r
+            selected = [job_ids[j] for j in range(len(job_ids)) if x[j, r]]
+            if not selected:
+                logger.warning("no jobs scheduled in round %d", round_index)
+            used = sum(self.metadata[j].nworkers for j in selected)
+            idle = self.ngpus - used
+            if idle > 0:
+                others = [j for j in range(len(job_ids))
+                          if job_ids[j] not in selected]
+                others.sort(key=lambda j: jobs[j].dirichlet_posterior_remaining_runtime(),
+                            reverse=True)
+                for j in others:
+                    if jobs[j].nworkers <= idle:
+                        idle -= jobs[j].nworkers
+                        selected.append(job_ids[j])
+                    if idle <= 0:
+                        break
+            schedules[round_index] = selected
+        return schedules

@@ -1,0 +1,4 @@
+from .policy import Policy, PolicyWithPacking
+from .registry import ShockwavePolicy, get_policy
+
+__all__ = ["Policy", "PolicyWithPacking", "ShockwavePolicy", "get_policy"]

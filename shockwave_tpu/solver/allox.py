@@ -1,0 +1,87 @@
+"""AlloX policy: min-cost bipartite matching of jobs to workers.
+
+Builds the AlloX cost matrix q[i, j*k] = k * processing_time(i, j) +
+wait_time(i) (a job assigned k-th from the end on a worker delays k jobs)
+and solves the assignment with scipy's Hungarian method. Non-preemptive:
+previously placed jobs keep their allocation
+(reference: scheduler/policies/allox.py).
+"""
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+from scipy.optimize import linear_sum_assignment
+
+from .policy import Policy
+
+
+class AlloXPolicy(Policy):
+    name = "AlloX_Perf"
+
+    def __init__(self, alpha: float = 1.0):
+        super().__init__()
+        self._alpha = alpha
+        self._prev_allocation = {}
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       times_since_start, num_steps_remaining,
+                       per_round_schedule, cluster_spec):
+        throughputs, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if throughputs is None:
+            return None
+        job_ids, worker_types = index
+
+        # Jobs holding a full allocation keep it; the rest queue for matching.
+        unallocated, held = [], []
+        for job_id in unflattened_throughputs:
+            prev = self._prev_allocation.get(job_id)
+            if prev is not None and sum(prev.values()) == 1.0:
+                held.append(job_id)
+            else:
+                unallocated.append(job_id)
+
+        # Free worker slots (workers not pinned by held jobs).
+        slot_types = []
+        for wt in worker_types:
+            free = cluster_spec[wt] - sum(
+                1 for j in held if self._prev_allocation[j][wt] == 1.0)
+            slot_types.extend([wt] * free)
+        n = len(slot_types)
+
+        unallocated.sort(key=lambda j: -times_since_start[j])
+        unallocated = unallocated[:max(int(self._alpha * len(unallocated)), n)]
+        m = len(unallocated)
+
+        allocation = {j: {wt: 0.0 for wt in cluster_spec} for j in job_ids}
+        for job_id in job_ids:
+            if job_id in self._prev_allocation:
+                allocation[job_id] = copy.copy(self._prev_allocation[job_id])
+
+        if m > 0 and n > 0:
+            proc = np.zeros((m, n))
+            for i, job_id in enumerate(unallocated):
+                for j, wt in enumerate(slot_types):
+                    tput = unflattened_throughputs[job_id][wt] or 1e-10
+                    proc[i, j] = num_steps_remaining[job_id] / tput
+            # Tile: position k from the end multiplies processing time by k.
+            q = np.concatenate([k * proc for k in range(1, m + 1)], axis=1)
+            wait = np.tile(
+                np.array([[times_since_start[j]] for j in unallocated]), (1, n * m))
+            q = q + wait
+
+            rows, cols = linear_sum_assignment(q)
+            per_slot = {j: [] for j in range(n)}
+            for r, c in zip(rows, cols):
+                per_slot[c % n].append((unallocated[r], c // n))
+            for slot, entries in per_slot.items():
+                if not entries:
+                    continue
+                # Highest order index = runs first on this slot.
+                entries = [(job, len(entries) - 1 - order) for job, order in entries]
+                entries.sort(key=lambda e: e[1])
+                job_id = entries[0][0]
+                allocation[job_id][slot_types[slot]] = 1.0 / scale_factors[job_id]
+
+        self._prev_allocation = copy.copy(allocation)
+        return allocation

@@ -1,0 +1,129 @@
+"""FIFO policies: queue-order placement, optionally perf-aware or packing.
+
+Stateful: the base variant remembers placements across rounds and only
+fills freed workers; `perf` mode re-places every round on the fastest
+worker type; `packing` mode additionally co-locates queued jobs with
+running ones when the combined normalized throughput clears a threshold
+(reference: scheduler/policies/fifo.py).
+"""
+from __future__ import annotations
+
+import copy
+import random
+from typing import Dict, Optional
+
+from ..core.job import JobIdPair
+from .policy import Policy, PolicyWithPacking
+
+
+class FIFOPolicy(Policy):
+    name = "FIFO"
+
+    def __init__(self, mode: str = "base", seed: Optional[int] = None,
+                 packing_threshold: float = 1.5):
+        super().__init__()
+        self._mode = mode
+        self._allocation: Dict[JobIdPair, str] = {}
+        self._rng = random.Random(seed)
+        self._packing_threshold = packing_threshold
+
+    def _pack(self, queue, throughputs, scale_factors):
+        """Greedily co-locate the queue head with its best running partner."""
+        while queue:
+            candidate = queue.pop(0)
+            best_gain = self._packing_threshold
+            partner = None
+            for scheduled in self._allocation:
+                if scheduled.is_pair():
+                    continue
+                if scale_factors[scheduled] != scale_factors[candidate]:
+                    continue
+                worker_type = self._allocation[scheduled]
+                merged = JobIdPair(scheduled[0], candidate[0])
+                packed = throughputs[merged][worker_type]
+                gain = 0.0
+                for i, member in enumerate(merged.singletons()):
+                    if packed[i] <= 0.0:
+                        continue
+                    gain += packed[i] / throughputs[member][worker_type]
+                if gain > best_gain:
+                    best_gain, partner = gain, scheduled
+            if partner is None:
+                break  # preserve FIFO: no queue-jumping past an unpackable head
+            worker_type = self._allocation.pop(partner)
+            self._allocation[JobIdPair(partner[0], candidate[0])] = worker_type
+
+    def get_allocation(self, throughputs, scale_factors, cluster_spec):
+        available = copy.deepcopy(cluster_spec)
+        if self._mode != "base":
+            self._allocation = {}
+
+        queue = [j for j in sorted(throughputs)
+                 if j not in self._allocation and not j.is_pair()]
+
+        # Release workers of completed jobs; backfill from the queue head.
+        for scheduled in sorted(self._allocation):
+            worker_type = self._allocation[scheduled]
+            if scheduled not in throughputs:
+                for member in scheduled.singletons():
+                    if member in throughputs:
+                        queue.append(member)
+                        queue.sort()
+                if queue:
+                    head = queue[0]
+                    if (scale_factors[head] <= available[worker_type]
+                            and throughputs[head][worker_type] > 0.0):
+                        queue.pop(0)
+                        self._allocation[head] = worker_type
+                        available[worker_type] -= scale_factors[head]
+                del self._allocation[scheduled]
+            else:
+                available[worker_type] -= scale_factors[scheduled]
+
+        # Place remaining queue on free workers.
+        free_types = sorted(wt for wt in available if available[wt] > 0)
+        while queue and free_types:
+            job_id = queue.pop(0)
+            fitting = [wt for wt in free_types
+                       if available[wt] >= scale_factors[job_id]]
+            if not fitting:
+                break
+            if self._mode == "base":
+                worker_type = self._rng.choice(fitting)
+            else:
+                worker_type = max(fitting, key=lambda wt: throughputs[job_id][wt])
+            if throughputs[job_id][worker_type] > 0.0:
+                self._allocation[job_id] = worker_type
+                available[worker_type] -= scale_factors[job_id]
+                if available[worker_type] == 0:
+                    free_types.remove(worker_type)
+
+        if self._mode == "packing":
+            self._pack(queue, throughputs, scale_factors)
+
+        allocation = {j: {wt: 0.0 for wt in cluster_spec} for j in throughputs}
+        for job_id, worker_type in self._allocation.items():
+            allocation[job_id][worker_type] = 1.0
+        return allocation
+
+
+class FIFOPolicyWithPerf(Policy):
+    name = "FIFO_Perf"
+
+    def __init__(self, solver=None):
+        super().__init__()
+        self._policy = FIFOPolicy(mode="perf")
+
+    def get_allocation(self, throughputs, scale_factors, cluster_spec):
+        return self._policy.get_allocation(throughputs, scale_factors, cluster_spec)
+
+
+class FIFOPolicyWithPacking(PolicyWithPacking):
+    name = "FIFO_Packing"
+
+    def __init__(self, packing_threshold: float = 1.5):
+        super().__init__()
+        self._policy = FIFOPolicy(mode="packing", packing_threshold=packing_threshold)
+
+    def get_allocation(self, throughputs, scale_factors, cluster_spec):
+        return self._policy.get_allocation(throughputs, scale_factors, cluster_spec)

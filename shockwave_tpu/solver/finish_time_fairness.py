@@ -1,0 +1,131 @@
+"""Finish-time fairness ("Themis") policy.
+
+Minimizes the maximum rho = expected-finish-time-shared /
+expected-finish-time-isolated across jobs (reference:
+scheduler/policies/finish_time_fairness.py:101-126).
+
+The reference solves a convex program with `inv_pos`; here we exploit that
+for a FIXED rho the feasibility region is linear:
+
+    rho >= (t_i + R_i / theta_i) / iso_i
+    <=>  theta_i >= R_i / (rho * iso_i - t_i)      (when rho*iso_i > t_i)
+
+where theta_i = sum_j tput_ij * x_ij, so we binary-search the smallest
+feasible rho with HiGHS feasibility LPs — same pattern the reference uses
+for makespan in min_total_duration.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .lp import LinearProgram, solve_feasibility
+from .policy import Policy
+from .simple import IsolatedPolicy
+
+
+class FinishTimeFairnessPolicyWithPerf(Policy):
+    name = "FinishTimeFairness_Perf"
+
+    def __init__(self, solver=None):
+        super().__init__(solver)
+        self._isolated = IsolatedPolicy()
+        self._cumulative_isolated_time = {}
+        self._prev_isolated_throughputs = {}
+        self._prev_steps_remaining = {}
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       unflattened_priority_weights, times_since_start,
+                       num_steps_remaining, cluster_spec):
+        throughputs, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if throughputs is None:
+            self._prev_isolated_throughputs = {}
+            self._prev_steps_remaining = {}
+            return None
+        m, n = throughputs.shape
+        job_ids, worker_types = index
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+
+        isolated_tputs = self._isolated.get_throughputs(
+            throughputs, index, scale_factors, cluster_spec)
+
+        # Track the isolated time each job has notionally accumulated so rho
+        # compares against a consistent baseline across rounds.
+        expected_isolated = np.zeros(m)
+        remaining = np.zeros(m)
+        elapsed = np.zeros(m)
+        for i, job_id in enumerate(job_ids):
+            self._cumulative_isolated_time.setdefault(job_id, 0.0)
+            if job_id in self._prev_steps_remaining:
+                steps_done = (self._prev_steps_remaining[job_id]
+                              - num_steps_remaining[job_id])
+                self._cumulative_isolated_time[job_id] += (
+                    steps_done / self._prev_isolated_throughputs[job_id])
+            remaining[i] = num_steps_remaining[job_id]
+            elapsed[i] = times_since_start[job_id]
+            expected_isolated[i] = (self._cumulative_isolated_time[job_id]
+                                    + remaining[i] / isolated_tputs[i, 0])
+
+        def feasible(rho: float):
+            lp = LinearProgram(m * n)
+            for i in range(m):
+                denom = rho * expected_isolated[i] - elapsed[i]
+                if denom <= 0:
+                    return None  # cannot meet rho for job i at any allocation
+                row = lp.row()
+                row[i * n:(i + 1) * n] = -throughputs[i]
+                lp.add_le(row, -remaining[i] / denom)
+            for row, rhs in zip(*self.cluster_capacity_rows(m, n, sf, self._num_workers)):
+                lp.add_le(row, rhs)
+            for row, rhs in zip(*self.job_time_rows(m, n)):
+                lp.add_le(row, rhs)
+            return solve_feasibility(lp)
+
+        lo, hi = 1e-3, 10.0
+        best = None
+        while feasible(hi) is None and hi < 1e7:
+            lo, hi = hi, hi * 10.0
+        if (x := feasible(hi)) is None:
+            # No rho achievable (e.g. throughput 0 rows): fall back to isolated.
+            result = self._isolated.get_allocation(
+                unflattened_throughputs, scale_factors, cluster_spec)
+        else:
+            best = x
+            while hi > lo * 1.01:
+                mid = (lo + hi) / 2.0
+                x = feasible(mid)
+                if x is not None:
+                    best, hi = x, mid
+                else:
+                    lo = mid
+            result = self.unflatten(best[:m * n].reshape((m, n)).clip(0.0, 1.0),
+                                    index)
+
+        self._prev_steps_remaining = dict(num_steps_remaining)
+        self._prev_isolated_throughputs = {
+            job_ids[i]: float(isolated_tputs[i, 0]) for i in range(m)}
+        return result
+
+
+class FinishTimeFairnessPolicy(Policy):
+    """Collapses all worker types to the reference type's throughput before
+    delegating (reference: finish_time_fairness.py:37-45)."""
+
+    name = "FinishTimeFairness"
+
+    def __init__(self, solver=None, reference_worker_type="v100"):
+        super().__init__(solver)
+        self._perf = FinishTimeFairnessPolicyWithPerf(solver)
+        self._reference_worker_type = reference_worker_type
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       priority_weights, times_since_start,
+                       num_steps_remaining, cluster_spec):
+        uniform = {
+            job_id: {wt: per_wt[self._reference_worker_type] for wt in per_wt}
+            for job_id, per_wt in unflattened_throughputs.items()
+        }
+        if not uniform:
+            return None
+        return self._perf.get_allocation(
+            uniform, scale_factors, priority_weights, times_since_start,
+            num_steps_remaining, cluster_spec)

@@ -1,0 +1,92 @@
+"""Gandiva policy: random exploratory packing with equal time split.
+
+When demand fits the cluster, behaves like isolated; under contention it
+randomly pairs jobs (same scale factor), drops pairs whose measured
+normalized throughput falls below 1.0, and splits time equally among the
+resulting combinations (reference: scheduler/policies/gandiva.py).
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.job import JobIdPair
+from .policy import PolicyWithPacking
+
+
+class GandivaPolicy(PolicyWithPacking):
+    name = "Gandiva_Packing"
+
+    def __init__(self, seed: Optional[int] = None):
+        super().__init__()
+        self._combinations: Dict[JobIdPair, Tuple[JobIdPair, Optional[JobIdPair]]] = {}
+        self._rng = random.Random(seed)
+
+    def _normalized_throughput(self, combo, throughputs, worker_types) -> float:
+        if not combo.is_pair():
+            return 0.0
+        total = 0.0
+        for wt in worker_types:
+            packed = throughputs[combo][wt]
+            for i, member in enumerate(combo.singletons()):
+                if packed[i] <= 0.0:
+                    return 0.0
+                total += packed[i] / throughputs[member][wt]
+        return total
+
+    def _equal_split(self, combos_to_schedule, index, scale_factors, cluster_spec):
+        job_ids, _, worker_types, _ = index
+        m = len(combos_to_schedule)
+        sf = self.scale_factors_array(scale_factors, job_ids,
+                                      len(job_ids), len(worker_types))
+        x = np.zeros((len(job_ids), len(worker_types)))
+        for combo in combos_to_schedule:
+            i = job_ids.index(combo)
+            x[i] = np.array([cluster_spec[wt] / m for wt in worker_types]) / sf[i]
+        row_sums = np.maximum(x.sum(axis=1), 1.0)
+        return x / row_sums[:, None]
+
+    def get_allocation(self, unflattened_throughputs, scale_factors, cluster_spec):
+        tensor, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if tensor is None or len(tensor) == 0:
+            return None
+        job_ids, single_job_ids, worker_types, _ = index
+
+        # Retire combinations whose members finished or that stopped paying off.
+        stale = []
+        for job_id, (combo, other) in list(self._combinations.items()):
+            if job_id not in job_ids or (other is not None and other not in job_ids):
+                stale.extend([job_id, other])
+            elif self._normalized_throughput(combo, unflattened_throughputs,
+                                             worker_types) < 1.0:
+                stale.extend([job_id, other])
+        for job_id in stale:
+            if job_id is not None:
+                self._combinations.pop(job_id, None)
+
+        demand = sum(scale_factors[s] for s in single_job_ids)
+        capacity = sum(cluster_spec[wt] for wt in worker_types)
+
+        if demand <= capacity:
+            x = self._equal_split(single_job_ids, index, scale_factors, cluster_spec)
+        else:
+            unassigned = [s for s in single_job_ids if s not in self._combinations]
+            attempts = len(unassigned)
+            while len(unassigned) > 1 and attempts > 0:
+                attempts -= 1
+                a, b = self._rng.sample(unassigned, 2)
+                if scale_factors[a] != scale_factors[b]:
+                    continue
+                unassigned.remove(a)
+                unassigned.remove(b)
+                combo = JobIdPair(a[0], b[0])
+                self._combinations[a] = (combo, b)
+                self._combinations[b] = (combo, a)
+            for s in unassigned:
+                self._combinations[s] = (s, None)
+            combos = list({self._combinations[s][0] for s in self._combinations})
+            x = self._equal_split(combos, index, scale_factors, cluster_spec)
+
+        return self.unflatten(x, index)

@@ -1,0 +1,67 @@
+"""Thin LP layer over scipy's HiGHS solver.
+
+The Gavel policy LPs are small (jobs x worker-types), so we build dense
+constraint matrices. This replaces the reference's cvxpy/ECOS/Gurobi stack
+(reference: scheduler/policies/*.py) with a dependency-free formulation.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+from scipy.optimize import linprog
+
+
+@dataclass
+class LinearProgram:
+    """Incrementally built LP: minimize c @ x subject to A_ub x <= b_ub, A_eq x = b_eq.
+
+    Variables are indexed by the caller; all variables default to bounds
+    [0, +inf) unless overridden via `bounds`.
+    """
+
+    num_vars: int
+    c: np.ndarray = field(init=False)
+    _A_ub: List[np.ndarray] = field(default_factory=list)
+    _b_ub: List[float] = field(default_factory=list)
+    _A_eq: List[np.ndarray] = field(default_factory=list)
+    _b_eq: List[float] = field(default_factory=list)
+    bounds: Optional[List] = None
+
+    def __post_init__(self):
+        self.c = np.zeros(self.num_vars)
+        self.bounds = [(0, None)] * self.num_vars
+
+    def row(self) -> np.ndarray:
+        return np.zeros(self.num_vars)
+
+    def add_le(self, coeffs: np.ndarray, rhs: float) -> None:
+        self._A_ub.append(coeffs)
+        self._b_ub.append(rhs)
+
+    def add_eq(self, coeffs: np.ndarray, rhs: float) -> None:
+        self._A_eq.append(coeffs)
+        self._b_eq.append(rhs)
+
+    def minimize(self, c: np.ndarray):
+        self.c = np.asarray(c, dtype=float)
+        return self
+
+    def solve(self):
+        res = linprog(
+            self.c,
+            A_ub=np.vstack(self._A_ub) if self._A_ub else None,
+            b_ub=np.array(self._b_ub) if self._b_ub else None,
+            A_eq=np.vstack(self._A_eq) if self._A_eq else None,
+            b_eq=np.array(self._b_eq) if self._b_eq else None,
+            bounds=self.bounds,
+            method="highs",
+        )
+        return res
+
+
+def solve_feasibility(lp: LinearProgram) -> Optional[np.ndarray]:
+    """Solve with a zero objective; return x if feasible else None."""
+    res = lp.minimize(np.zeros(lp.num_vars)).solve()
+    return res.x if res.success else None

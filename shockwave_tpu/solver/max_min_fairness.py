@@ -1,0 +1,143 @@
+"""Max-min fairness policies ("Gavel" in the paper).
+
+LP: maximize the minimum (priority- and proportional-share-normalized)
+effective throughput across jobs (reference:
+scheduler/policies/max_min_fairness.py:86-108). The `WithPerf` variant
+uses real throughputs; the base variant first replaces all throughputs
+with 1.0 so only time shares matter.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .lp import LinearProgram
+from .policy import Policy, PolicyWithPacking
+from .simple import ProportionalPolicy
+
+
+class MaxMinFairnessPolicyWithPerf(Policy):
+    name = "MaxMinFairness_Perf"
+
+    def __init__(self, solver=None):
+        super().__init__(solver)
+        self._proportional = ProportionalPolicy()
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       unflattened_priority_weights, cluster_spec):
+        throughputs, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if throughputs is None:
+            return None
+        m, n = throughputs.shape
+        job_ids, worker_types = index
+
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+        priority = np.array([1.0 / unflattened_priority_weights[j] for j in job_ids])
+        proportional = self._proportional.get_throughputs(throughputs, index, cluster_spec)
+        weights = priority.reshape((m, 1)) / proportional.reshape((m, 1))
+
+        # Effective rate coefficients: throughput * weight * scale_factor.
+        coeff = throughputs * weights * sf
+
+        # Variables: x (m*n) then t; maximize t s.t. coeff_i . x_i >= t.
+        lp = LinearProgram(m * n + 1)
+        t = m * n
+        lp.bounds[t] = (None, None)
+        for i in range(m):
+            row = lp.row()
+            row[i * n:(i + 1) * n] = -coeff[i]
+            row[t] = 1.0
+            lp.add_le(row, 0.0)
+        for row, rhs in zip(*self.cluster_capacity_rows(m, n, sf, self._num_workers, 1)):
+            lp.add_le(row, rhs)
+        for row, rhs in zip(*self.job_time_rows(m, n, 1)):
+            lp.add_le(row, rhs)
+        c = np.zeros(m * n + 1)
+        c[t] = -1.0
+        res = lp.minimize(c).solve()
+        if not res.success:
+            return None
+        x = res.x[:m * n].reshape((m, n)).clip(0.0, 1.0)
+        return self.unflatten(x, index)
+
+
+class MaxMinFairnessPolicy(Policy):
+    """Throughput-agnostic max-min: all throughputs forced to 1.0."""
+
+    name = "MaxMinFairness"
+
+    def __init__(self, solver=None):
+        super().__init__(solver)
+        self._perf = MaxMinFairnessPolicyWithPerf(solver)
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       priority_weights, cluster_spec):
+        ones = {
+            job_id: {wt: 1.0 for wt in per_wt}
+            for job_id, per_wt in unflattened_throughputs.items()
+        }
+        if not ones:
+            return None
+        return self._perf.get_allocation(ones, scale_factors, priority_weights,
+                                         cluster_spec)
+
+
+class MaxMinFairnessStrategyProofPolicy(MaxMinFairnessPolicy):
+    """Strategy-proof entry point: throughput-agnostic max-min, so a job
+    cannot gain by misreporting throughputs
+    (reference: policies/max_min_fairness_strategy_proof.py:13-46)."""
+
+    name = "MaxMinFairness"
+
+
+class MaxMinFairnessPolicyWithPacking(PolicyWithPacking):
+    name = "MaxMinFairness_Packing"
+
+    def __init__(self, solver=None):
+        super().__init__(solver)
+        self._proportional = ProportionalPolicy()
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       unflattened_priority_weights, cluster_spec):
+        tensor, index = self.flatten(unflattened_throughputs, cluster_spec,
+                                     unflattened_priority_weights)
+        if tensor is None or len(tensor) == 0:
+            return None
+        job_ids, single_job_ids, worker_types, relevant = index
+        num_singles, m, n = tensor.shape
+
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+
+        iso = np.array([
+            [unflattened_throughputs[s][wt] for wt in worker_types]
+            for s in single_job_ids
+        ])
+        proportional = self._proportional.get_throughputs(
+            iso, (single_job_ids, worker_types), cluster_spec)
+
+        lp = LinearProgram(m * n + 1)
+        t = m * n
+        lp.bounds[t] = (None, None)
+        for si in range(num_singles):
+            row = lp.row()
+            for ci in relevant[single_job_ids[si]]:
+                row[ci * n:(ci + 1) * n] -= (
+                    tensor[si, ci] * sf[ci] / proportional[si, 0])
+            row[t] = 1.0
+            lp.add_le(row, 0.0)
+        for row, rhs in zip(*self.cluster_capacity_rows(m, n, sf, self._num_workers, 1)):
+            lp.add_le(row, rhs)
+        for row, rhs in zip(*self.per_job_time_rows(job_ids, single_job_ids,
+                                                    relevant, n, 1)):
+            lp.add_le(row, rhs)
+        # Zero out combos with mismatched scale factors.
+        for i in range(m):
+            for j in range(n):
+                if sf[i, j] == 0:
+                    lp.bounds[i * n + j] = (0, 0)
+        c = np.zeros(m * n + 1)
+        c[t] = -1.0
+        res = lp.minimize(c).solve()
+        if not res.success:
+            return None
+        x = res.x[:m * n].reshape((m, n)).clip(0.0, 1.0)
+        return self.unflatten(x, index)

@@ -1,0 +1,80 @@
+"""Max-sum-throughput ("MST") policies.
+
+LP maximizing total (optionally cost-normalized) throughput, with optional
+per-job SLO rate constraints (reference:
+scheduler/policies/max_sum_throughput.py:44-108).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .lp import LinearProgram
+from .policy import Policy
+
+
+class ThroughputNormalizedByCostSumWithPerfSLOs(Policy):
+    name = "ThroughputNormalizedByCostSum_PerfSLOs"
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       cluster_spec, instance_costs=None, SLOs=None,
+                       num_steps_remaining=None):
+        SLOs = SLOs or {}
+        num_steps_remaining = num_steps_remaining or {}
+        throughputs, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if throughputs is None:
+            return None
+        m, n = throughputs.shape
+        job_ids, worker_types = index
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+
+        costs = np.ones(n)
+        if instance_costs is not None:
+            costs = np.array([instance_costs[wt] for wt in worker_types])
+
+        def build(include_slos: bool):
+            lp = LinearProgram(m * n)
+            for row, rhs in zip(*self.cluster_capacity_rows(m, n, sf, self._num_workers)):
+                lp.add_le(row, rhs)
+            for row, rhs in zip(*self.job_time_rows(m, n)):
+                lp.add_le(row, rhs)
+            if include_slos:
+                for job_id, slo in SLOs.items():
+                    i = job_ids.index(job_id)
+                    row = lp.row()
+                    row[i * n:(i + 1) * n] = -throughputs[i]
+                    lp.add_le(row, -num_steps_remaining[job_id] / slo)
+            c = -(throughputs / costs).reshape(m * n)
+            return lp.minimize(c).solve()
+
+        res = build(include_slos=bool(SLOs))
+        if not res.success and SLOs:
+            # SLOs unsatisfiable: drop them rather than fail the round.
+            res = build(include_slos=False)
+        if not res.success:
+            return None
+        return self.unflatten(res.x.reshape((m, n)).clip(0.0, 1.0), index)
+
+
+class ThroughputSumWithPerf(Policy):
+    name = "ThroughputSumWithPerf"
+
+    def __init__(self, solver=None):
+        super().__init__(solver)
+        self._policy = ThroughputNormalizedByCostSumWithPerfSLOs(solver)
+
+    def get_allocation(self, unflattened_throughputs, scale_factors, cluster_spec):
+        return self._policy.get_allocation(unflattened_throughputs,
+                                           scale_factors, cluster_spec)
+
+
+class ThroughputNormalizedByCostSumWithPerf(Policy):
+    name = "ThroughputNormalizedByCostSum_Perf"
+
+    def __init__(self, solver=None):
+        super().__init__(solver)
+        self._policy = ThroughputNormalizedByCostSumWithPerfSLOs(solver)
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       cluster_spec, instance_costs):
+        return self._policy.get_allocation(unflattened_throughputs, scale_factors,
+                                           cluster_spec, instance_costs=instance_costs)

@@ -1,0 +1,75 @@
+"""Min-total-duration ("OSSP") policy: minimize makespan of current jobs.
+
+Binary search on horizon T; for each T a feasibility LP checks whether
+every job can finish its remaining steps within T (reference:
+scheduler/policies/min_total_duration.py:55-135).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .lp import LinearProgram, solve_feasibility
+from .policy import Policy
+
+
+class MinTotalDurationPolicyWithPerf(Policy):
+    name = "MinTotalDuration_Perf"
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       num_steps_remaining, cluster_spec):
+        throughputs, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if throughputs is None:
+            return None
+        m, n = throughputs.shape
+        job_ids, _ = index
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+        remaining = np.array([num_steps_remaining[j] for j in job_ids], dtype=float)
+
+        def feasible(T: float):
+            lp = LinearProgram(m * n)
+            for i in range(m):
+                row = lp.row()
+                row[i * n:(i + 1) * n] = -throughputs[i]
+                lp.add_le(row, -remaining[i] / T)
+            for row, rhs in zip(*self.cluster_capacity_rows(m, n, sf, self._num_workers)):
+                lp.add_le(row, rhs)
+            for row, rhs in zip(*self.job_time_rows(m, n)):
+                lp.add_le(row, rhs)
+            return solve_feasibility(lp)
+
+        lo, hi = 100.0, 1e6
+        while feasible(hi) is None:
+            lo, hi = hi, hi * 10.0
+            if hi > 1e12:
+                return None
+        best = feasible(hi)
+        while hi > lo * 1.05:
+            mid = (lo + hi) / 2.0
+            x = feasible(mid)
+            if x is not None:
+                best, hi = x, mid
+            else:
+                lo = mid
+        return self.unflatten(best.reshape((m, n)).clip(0.0, 1.0), index)
+
+
+class MinTotalDurationPolicy(Policy):
+    """Collapses worker types to the reference type before delegating."""
+
+    name = "MinTotalDuration"
+
+    def __init__(self, solver=None, reference_worker_type="v100"):
+        super().__init__(solver)
+        self._perf = MinTotalDurationPolicyWithPerf(solver)
+        self._reference_worker_type = reference_worker_type
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       num_steps_remaining, cluster_spec):
+        uniform = {
+            job_id: {wt: per_wt[self._reference_worker_type] for wt in per_wt}
+            for job_id, per_wt in unflattened_throughputs.items()
+        }
+        if not uniform:
+            return None
+        return self._perf.get_allocation(uniform, scale_factors,
+                                         num_steps_remaining, cluster_spec)

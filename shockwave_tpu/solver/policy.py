@@ -1,0 +1,152 @@
+"""Policy base classes.
+
+Every policy maps scheduler state to a time-fraction allocation:
+`get_allocation(...) -> {job_id: {worker_type: fraction}}` where fractions
+are the share of wall-clock time each job (combination) should spend on
+each worker type (reference: scheduler/policies/policy.py).
+
+The flatten/unflatten helpers convert between the nested-dict form the
+scheduler uses and the dense matrices the LPs operate on. The packing base
+additionally handles JobIdPair combination keys whose throughput entries
+are per-member lists.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.job import JobIdPair
+
+
+class Policy:
+    name = "Policy"
+
+    def __init__(self, solver: Optional[str] = None):
+        # `solver` kept for interface compatibility; HiGHS is always used.
+        self._solver = solver
+        self._num_workers: Optional[List[int]] = None
+
+    def flatten(self, d: dict, cluster_spec: dict):
+        """2-level dict -> (m x n) matrix plus (job_ids, worker_types) index."""
+        job_ids = sorted(d.keys())
+        if not job_ids:
+            return None, None
+        worker_types = sorted(d[job_ids[0]].keys())
+        if not worker_types:
+            return None, None
+        self._num_workers = [cluster_spec[wt] for wt in worker_types]
+        m = np.array([[d[job_id][wt] for wt in worker_types] for job_id in job_ids],
+                     dtype=float)
+        return m, (job_ids, worker_types)
+
+    def unflatten(self, matrix, index) -> dict:
+        job_ids, worker_types = index
+        return {
+            job_id: {wt: float(matrix[i][j]) for j, wt in enumerate(worker_types)}
+            for i, job_id in enumerate(job_ids)
+        }
+
+    def scale_factors_array(self, scale_factors: dict, job_ids, m: int, n: int):
+        arr = np.zeros((m, n))
+        for i in range(m):
+            arr[i, :] = scale_factors[job_ids[i]]
+        return arr
+
+    # -- LP constraint helpers (dense rows over an m*n flattened x) --------
+
+    @staticmethod
+    def cluster_capacity_rows(m: int, n: int, scale_factors_array, num_workers,
+                              num_extra_vars: int = 0):
+        """Rows for: sum_i sf_i * x[i, j] <= num_workers[j], for each j."""
+        rows, rhs = [], []
+        for j in range(n):
+            row = np.zeros(m * n + num_extra_vars)
+            for i in range(m):
+                row[i * n + j] = scale_factors_array[i, j]
+            rows.append(row)
+            rhs.append(num_workers[j])
+        return rows, rhs
+
+    @staticmethod
+    def job_time_rows(m: int, n: int, num_extra_vars: int = 0):
+        """Rows for: sum_j x[i, j] <= 1, for each i."""
+        rows, rhs = [], []
+        for i in range(m):
+            row = np.zeros(m * n + num_extra_vars)
+            row[i * n:(i + 1) * n] = 1.0
+            rows.append(row)
+            rhs.append(1.0)
+        return rows, rhs
+
+
+class PolicyWithPacking(Policy):
+    """Base for policies over job combinations (pairs sharing one device)."""
+
+    name = "PolicyWithPacking"
+
+    def flatten(self, d: dict, cluster_spec: dict, priority_weights: Optional[dict] = None):
+        """Returns per-single-job throughput tensors.
+
+        d maps JobIdPair (single or pair) -> worker_type -> throughput
+        (scalar for singles, [tput_a, tput_b] for pairs). Result: tensor of
+        shape (num_singles, num_combinations, num_worker_types) where entry
+        [s, c, w] is single job s's throughput inside combination c.
+        """
+        job_ids = sorted(d.keys())
+        if not job_ids:
+            return None, None
+        worker_types = sorted(d[job_ids[0]].keys())
+        if not worker_types:
+            return None, None
+        self._num_workers = [cluster_spec[wt] for wt in worker_types]
+
+        single_job_ids = [j for j in job_ids if not j.is_pair()]
+        relevant: Dict[JobIdPair, List[int]] = {s: [] for s in single_job_ids}
+        for idx, job_id in enumerate(job_ids):
+            for s in job_id.singletons():
+                if s in relevant:
+                    relevant[s].append(idx)
+
+        tensor = np.zeros((len(single_job_ids), len(job_ids), len(worker_types)),
+                          dtype=np.float32)
+        for si, s in enumerate(single_job_ids):
+            for ci in relevant[s]:
+                combo = job_ids[ci]
+                for wi, wt in enumerate(worker_types):
+                    if combo.is_pair():
+                        member = combo.as_tuple().index(s[0])
+                        tensor[si, ci, wi] = d[combo][wt][member]
+                    elif combo == s:
+                        tensor[si, ci, wi] = d[combo][wt]
+            if priority_weights is not None:
+                tensor[si] /= priority_weights[s]
+        return tensor, (job_ids, single_job_ids, worker_types, relevant)
+
+    def unflatten(self, matrix, index) -> dict:
+        job_ids, _, worker_types, _ = index
+        return {
+            job_id: {wt: float(matrix[i][j]) for j, wt in enumerate(worker_types)}
+            for i, job_id in enumerate(job_ids)
+        }
+
+    def scale_factors_array(self, scale_factors: dict, job_ids, m: int, n: int):
+        arr = np.zeros((m, n))
+        for i, job_id in enumerate(job_ids):
+            sfs = {scale_factors[s] for s in job_id.singletons()}
+            arr[i, :] = sfs.pop() if len(sfs) == 1 else 0
+        return arr
+
+    @staticmethod
+    def per_job_time_rows(job_ids, single_job_ids, relevant, n: int,
+                          num_extra_vars: int = 0):
+        """Rows for: total share of each single job across combos <= 1."""
+        m = len(job_ids)
+        rows, rhs = [], []
+        for s in single_job_ids:
+            row = np.zeros(m * n + num_extra_vars)
+            for ci in relevant[s]:
+                row[ci * n:(ci + 1) * n] = 1.0
+            rows.append(row)
+            rhs.append(1.0)
+        return rows, rhs

@@ -1,0 +1,73 @@
+"""Policy factory: name -> policy instance
+(reference: scheduler/utils.py:603-685)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .allox import AlloXPolicy
+from .fifo import FIFOPolicy, FIFOPolicyWithPacking, FIFOPolicyWithPerf
+from .finish_time_fairness import (FinishTimeFairnessPolicy,
+                                   FinishTimeFairnessPolicyWithPerf)
+from .gandiva import GandivaPolicy
+from .max_min_fairness import (MaxMinFairnessPolicy,
+                               MaxMinFairnessPolicyWithPacking,
+                               MaxMinFairnessPolicyWithPerf,
+                               MaxMinFairnessStrategyProofPolicy)
+from .max_sum_throughput import (ThroughputNormalizedByCostSumWithPerf,
+                                 ThroughputNormalizedByCostSumWithPerfSLOs,
+                                 ThroughputSumWithPerf)
+from .min_total_duration import (MinTotalDurationPolicy,
+                                 MinTotalDurationPolicyWithPerf)
+from .simple import (GandivaFairPolicy, IsolatedPlusPolicy, IsolatedPolicy,
+                     ProportionalPolicy)
+from .water_filling import (MaxMinFairnessWaterFillingPolicy,
+                            MaxMinFairnessWaterFillingPolicyWithPerf)
+
+
+class ShockwavePolicy:
+    """Marker policy: scheduling decisions come from the Shockwave planner,
+    not a time-fraction LP (reference: policies/shockwave.py)."""
+
+    name = "shockwave"
+
+    def get_allocation(self, *args, **kwargs):
+        return None
+
+
+def get_policy(policy_name: str, solver: Optional[str] = None,
+               seed: Optional[int] = None,
+               priority_reweighting_policies=None):
+    if policy_name.startswith("allox"):
+        alpha = 0.2 if policy_name == "allox" else float(
+            policy_name.split("allox_alpha=")[1])
+        return AlloXPolicy(alpha=alpha)
+    factories = {
+        "fifo": lambda: FIFOPolicy(seed=seed),
+        "fifo_perf": FIFOPolicyWithPerf,
+        "fifo_packed": FIFOPolicyWithPacking,
+        "finish_time_fairness": FinishTimeFairnessPolicy,
+        "finish_time_fairness_perf": FinishTimeFairnessPolicyWithPerf,
+        "gandiva": lambda: GandivaPolicy(seed=seed),
+        "gandiva_fair": GandivaFairPolicy,
+        "isolated": IsolatedPolicy,
+        "isolated_plus": IsolatedPlusPolicy,
+        "max_min_fairness": MaxMinFairnessPolicy,
+        "max_min_fairness_perf": MaxMinFairnessPolicyWithPerf,
+        "max_min_fairness_packed": MaxMinFairnessPolicyWithPacking,
+        "max_min_fairness_strategy_proof": MaxMinFairnessStrategyProofPolicy,
+        "max_min_fairness_water_filling": lambda: MaxMinFairnessWaterFillingPolicy(
+            priority_reweighting_policies),
+        "max_min_fairness_water_filling_perf": lambda: MaxMinFairnessWaterFillingPolicyWithPerf(
+            priority_reweighting_policies),
+        "max_sum_throughput_perf": ThroughputSumWithPerf,
+        "max_sum_throughput_normalized_by_cost_perf": ThroughputNormalizedByCostSumWithPerf,
+        "max_sum_throughput_normalized_by_cost_perf_SLOs": ThroughputNormalizedByCostSumWithPerfSLOs,
+        "min_total_duration": MinTotalDurationPolicy,
+        "min_total_duration_perf": MinTotalDurationPolicyWithPerf,
+        "proportional": ProportionalPolicy,
+        "shockwave": ShockwavePolicy,
+    }
+    try:
+        return factories[policy_name]()
+    except KeyError:
+        raise ValueError(f"unknown policy {policy_name!r}") from None

@@ -1,0 +1,92 @@
+"""Closed-form policies: isolated, proportional, gandiva-fair.
+
+These split the cluster evenly and need no solver
+(reference: scheduler/policies/{isolated,proportional,gandiva_fair_proportional}.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .policy import Policy
+
+
+class IsolatedPolicy(Policy):
+    """Equal 1/m split, normalized by per-job scale factor."""
+
+    name = "Isolated"
+
+    def _allocation_matrix(self, m, n, worker_types, scale_factors_array, cluster_spec):
+        x = np.tile([cluster_spec[wt] / m for wt in worker_types], (m, 1))
+        x = x / scale_factors_array
+        row_sums = np.maximum(x.sum(axis=1), 1.0)
+        return x / row_sums[:, None]
+
+    def get_throughputs(self, throughputs, index, scale_factors, cluster_spec):
+        if throughputs is None:
+            return None
+        job_ids, worker_types = index
+        m, n = throughputs.shape
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+        x = self._allocation_matrix(m, n, worker_types, sf, cluster_spec)
+        return (throughputs * x).sum(axis=1).reshape((m, 1))
+
+    def get_allocation(self, unflattened_throughputs, scale_factors, cluster_spec):
+        throughputs, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if throughputs is None:
+            return None
+        job_ids, worker_types = index
+        m, n = throughputs.shape
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+        x = self._allocation_matrix(m, n, worker_types, sf, cluster_spec)
+        return self.unflatten(x, index)
+
+
+class IsolatedPlusPolicy(IsolatedPolicy):
+    """Isolated variant; round scheduler respects its priority order strictly."""
+
+    name = "Isolated_plus"
+
+
+class ProportionalPolicy(Policy):
+    """Equal split without scale-factor normalization; also provides the
+    normalizing throughputs used by the max-min policies."""
+
+    name = "Proportional"
+
+    def _allocation_matrix(self, m, worker_types, cluster_spec):
+        x = np.tile([cluster_spec[wt] / m for wt in worker_types], (m, 1))
+        return x / x.sum(axis=1).max()
+
+    def get_throughputs(self, throughputs, index, cluster_spec):
+        if throughputs is None:
+            return None
+        job_ids, worker_types = index
+        m, _ = throughputs.shape
+        x = self._allocation_matrix(m, worker_types, cluster_spec)
+        return (throughputs * x).sum(axis=1).reshape((m, 1))
+
+    def get_allocation(self, unflattened_throughputs, cluster_spec):
+        throughputs, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if throughputs is None:
+            return None
+        _, worker_types = index
+        m, _ = throughputs.shape
+        x = self._allocation_matrix(m, worker_types, cluster_spec)
+        return self.unflatten(x, index)
+
+
+class GandivaFairPolicy(Policy):
+    """Proportional share normalized so each row sums to at most 1
+    (the 'Gandiva-Fair' baseline of the paper)."""
+
+    name = "GandivaFairProportional"
+
+    def get_allocation(self, unflattened_throughputs, scale_factors, cluster_spec):
+        throughputs, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if throughputs is None:
+            return None
+        _, worker_types = index
+        m, _ = throughputs.shape
+        x = np.tile([cluster_spec[wt] / m for wt in worker_types], (m, 1))
+        row_sums = np.maximum(x.sum(axis=1), 1.0)
+        return self.unflatten(x / row_sums[:, None], index)

@@ -1,0 +1,188 @@
+"""Max-min fairness via water filling.
+
+Iteratively raises the minimum normalized effective throughput: solve the
+max-min LP, detect saturated jobs (those that cannot rise above the
+current water level), freeze them, and repeat with the rest. This yields
+the lexicographically max-min allocation the reference computes with a
+parameterized LP + MILP pair (reference:
+scheduler/policies/max_min_fairness_water_filling.py); here saturation is
+detected with per-job probe LPs, which is equivalent and solver-free.
+
+Supports entity-based priority reweighting ("fairness" and "fifo"
+policies) for multi-entity clusters.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .lp import LinearProgram
+from .policy import Policy
+from .simple import ProportionalPolicy
+
+_EPS = 1e-5
+
+
+class WaterFillingAlgorithm:
+    def __init__(self, priority_reweighting_policies=None):
+        self._priority_reweighting_policies = priority_reweighting_policies
+
+    def _reweight(self, entity_weights, priority_weights, entity_to_job_mapping,
+                  saturated, job_ids):
+        """Redistribute entity weights over that entity's unsaturated jobs."""
+        if self._priority_reweighting_policies is None:
+            return priority_weights
+        out = {}
+        for entity_id, entity_jobs in entity_to_job_mapping.items():
+            policy = self._priority_reweighting_policies[entity_id]
+            weight = entity_weights[entity_id]
+            if policy == "fairness":
+                active = [j for j in entity_jobs if j not in saturated]
+                total = sum(float(priority_weights[j]) for j in active)
+                for j in entity_jobs:
+                    out[j] = 0.0 if j in saturated else (
+                        weight * float(priority_weights[j]) / total)
+            elif policy == "fifo":
+                entity_jobs = sorted(entity_jobs)
+                granted = False
+                for j in entity_jobs:
+                    if j in saturated or granted:
+                        out[j] = 0.0
+                    else:
+                        out[j] = weight
+                        granted = True
+            else:
+                raise ValueError(f"unknown priority reweighting policy {policy!r}")
+        return out
+
+    def _solve_level(self, coeff, sf, num_workers, weights, saturated_levels, m, n,
+                     objective_job=None):
+        """Max water level t (or one job's throughput) s.t. frozen jobs keep
+        their levels and unsaturated jobs get >= w_i * t."""
+        lp = LinearProgram(m * n + 1)
+        t = m * n
+        lp.bounds[t] = (None, None)
+        for i in range(m):
+            row = lp.row()
+            row[i * n:(i + 1) * n] = -coeff[i]
+            if i in saturated_levels:
+                lp.add_le(row, -saturated_levels[i])
+            elif weights[i] > 0:
+                row[t] = weights[i]
+                lp.add_le(row, 0.0)
+        for row, rhs in zip(*Policy.cluster_capacity_rows(m, n, sf, num_workers, 1)):
+            lp.add_le(row, rhs)
+        for row, rhs in zip(*Policy.job_time_rows(m, n, 1)):
+            lp.add_le(row, rhs)
+        c = np.zeros(m * n + 1)
+        if objective_job is None:
+            c[t] = -1.0
+        else:
+            c[objective_job * n:(objective_job + 1) * n] = -coeff[objective_job]
+        res = lp.minimize(c).solve()
+        return res
+
+    def run(self, coeff, sf, num_workers, priority_weights, m, n,
+            entity_weights=None, entity_to_job_mapping=None, job_ids=None):
+        """coeff[i, j]: normalized effective throughput per unit time share."""
+        saturated_levels: Dict[int, float] = {}
+        saturated_ids = set()
+        x = None
+        for _ in range(m):
+            if len(saturated_levels) == m:
+                break
+            if entity_to_job_mapping is not None:
+                pw = self._reweight(entity_weights, priority_weights,
+                                    entity_to_job_mapping, saturated_ids, job_ids)
+                weights = np.array([float(pw[job_ids[i]]) for i in range(m)])
+            else:
+                weights = np.array([
+                    0.0 if i in saturated_levels else float(priority_weights[job_ids[i]])
+                    for i in range(m)])
+            if weights.sum() <= 0:
+                break
+            res = self._solve_level(coeff, sf, num_workers, weights,
+                                    saturated_levels, m, n)
+            if not res.success:
+                break
+            level = -res.fun
+            x = res.x[:m * n].reshape((m, n))
+            # Probe each unsaturated job: can it exceed its waterline?
+            newly = []
+            for i in range(m):
+                if i in saturated_levels or weights[i] <= 0:
+                    continue
+                trial = dict(saturated_levels)
+                for k in range(m):
+                    if k != i and k not in trial and weights[k] > 0:
+                        trial[k] = level * weights[k]
+                probe = self._solve_level(coeff, sf, num_workers, weights, trial,
+                                          m, n, objective_job=i)
+                best = -probe.fun if probe.success else level * weights[i]
+                if best <= level * weights[i] * (1 + _EPS) + _EPS:
+                    newly.append((i, level * weights[i]))
+            if not newly:
+                # Numerical fallback: freeze the argmin to guarantee progress.
+                rates = (coeff * x).sum(axis=1)
+                active = [i for i in range(m) if i not in saturated_levels
+                          and weights[i] > 0]
+                i = min(active, key=lambda k: rates[k] / weights[k])
+                newly = [(i, level * weights[i])]
+            for i, lvl in newly:
+                saturated_levels[i] = lvl
+                if job_ids is not None:
+                    saturated_ids.add(job_ids[i])
+        return x
+
+
+class MaxMinFairnessWaterFillingPolicyWithPerf(Policy):
+    name = "MaxMinFairnessWaterFilling_Perf"
+
+    def __init__(self, priority_reweighting_policies=None):
+        super().__init__()
+        self._algorithm = WaterFillingAlgorithm(priority_reweighting_policies)
+        self._proportional = ProportionalPolicy()
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       unflattened_priority_weights, cluster_spec,
+                       entity_weights=None, entity_to_job_mapping=None,
+                       verbose=False, return_effective_throughputs=False):
+        throughputs, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if throughputs is None:
+            return None
+        m, n = throughputs.shape
+        job_ids, worker_types = index
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+        proportional = self._proportional.get_throughputs(throughputs, index,
+                                                          cluster_spec)
+        coeff = throughputs * sf / proportional.reshape((m, 1))
+        x = self._algorithm.run(
+            coeff, sf, self._num_workers, unflattened_priority_weights, m, n,
+            entity_weights=entity_weights,
+            entity_to_job_mapping=entity_to_job_mapping, job_ids=job_ids)
+        if x is None:
+            return None
+        return self.unflatten(x.clip(0.0, 1.0), index)
+
+
+class MaxMinFairnessWaterFillingPolicy(Policy):
+    """Throughput-agnostic water filling (all throughputs forced to 1)."""
+
+    name = "MaxMinFairnessWaterFilling"
+
+    def __init__(self, priority_reweighting_policies=None):
+        super().__init__()
+        self._perf = MaxMinFairnessWaterFillingPolicyWithPerf(
+            priority_reweighting_policies)
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       priority_weights, cluster_spec, **kwargs):
+        ones = {
+            job_id: {wt: 1.0 for wt in per_wt}
+            for job_id, per_wt in unflattened_throughputs.items()
+        }
+        if not ones:
+            return None
+        return self._perf.get_allocation(ones, scale_factors, priority_weights,
+                                         cluster_spec, **kwargs)

@@ -1,0 +1,251 @@
+"""Policy layer tests on tiny, hand-checkable clusters."""
+import numpy as np
+import pytest
+
+from shockwave_tpu.core.job import JobIdPair
+from shockwave_tpu.solver import get_policy
+from shockwave_tpu.solver.max_min_fairness import MaxMinFairnessPolicyWithPacking
+
+
+def single_type_state(num_jobs, num_workers, tputs=None, sfs=None):
+    job_ids = [JobIdPair(i) for i in range(num_jobs)]
+    throughputs = {
+        j: {"v100": (tputs[i] if tputs else 1.0)} for i, j in enumerate(job_ids)}
+    scale_factors = {j: (sfs[i] if sfs else 1) for i, j in enumerate(job_ids)}
+    priorities = {j: 1.0 for j in job_ids}
+    cluster = {"v100": num_workers}
+    return job_ids, throughputs, scale_factors, priorities, cluster
+
+
+def total_workers_used(alloc, scale_factors):
+    return sum(alloc[j][wt] * scale_factors[j] for j in alloc for wt in alloc[j])
+
+
+class TestIsolated:
+    def test_even_split(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(4, 2)
+        alloc = get_policy("isolated").get_allocation(tputs, sfs, cluster)
+        for j in jobs:
+            assert alloc[j]["v100"] == pytest.approx(0.5)
+
+    def test_scale_factor_normalization(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(2, 4, sfs=[1, 4])
+        alloc = get_policy("isolated").get_allocation(tputs, sfs, cluster)
+        # Each job entitled to 2 workers; the sf=4 job runs 2/4 of the time.
+        assert alloc[jobs[0]]["v100"] == pytest.approx(1.0)
+        assert alloc[jobs[1]]["v100"] == pytest.approx(0.5)
+
+
+class TestMaxMinFairness:
+    def test_equal_jobs_get_equal_time(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(4, 2)
+        alloc = get_policy("max_min_fairness").get_allocation(tputs, sfs, prios, cluster)
+        shares = [alloc[j]["v100"] for j in jobs]
+        assert shares == pytest.approx([0.5] * 4, abs=1e-4)
+
+    def test_capacity_respected(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(
+            5, 4, tputs=[1, 2, 3, 4, 5], sfs=[1, 1, 2, 2, 4])
+        alloc = get_policy("max_min_fairness").get_allocation(tputs, sfs, prios, cluster)
+        assert total_workers_used(alloc, sfs) <= 4 + 1e-6
+        for j in jobs:
+            assert -1e-9 <= alloc[j]["v100"] <= 1 + 1e-9
+
+    def test_perf_prefers_fast_worker(self):
+        j0, j1 = JobIdPair(0), JobIdPair(1)
+        tputs = {j0: {"fast": 10.0, "slow": 1.0}, j1: {"fast": 10.0, "slow": 1.0}}
+        sfs = {j0: 1, j1: 1}
+        prios = {j0: 1.0, j1: 1.0}
+        cluster = {"fast": 1, "slow": 1}
+        alloc = get_policy("max_min_fairness_perf").get_allocation(
+            tputs, sfs, prios, cluster)
+        # Max-min over normalized rates: both jobs split the fast worker.
+        rates = {j: 10 * alloc[j]["fast"] + 1 * alloc[j]["slow"] for j in (j0, j1)}
+        assert rates[j0] == pytest.approx(rates[j1], rel=1e-3)
+
+    def test_priority_weights_scale_share(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(2, 1)
+        prios[jobs[0]] = 3.0
+        alloc = get_policy("max_min_fairness").get_allocation(tputs, sfs, prios, cluster)
+        assert alloc[jobs[0]]["v100"] == pytest.approx(0.75, abs=1e-3)
+        assert alloc[jobs[1]]["v100"] == pytest.approx(0.25, abs=1e-3)
+
+
+class TestWaterFilling:
+    def test_leftover_capacity_is_distributed(self):
+        # 3 jobs, 4 workers: plain max-min gives everyone 1.0; water filling
+        # must not leave the 4th worker idle either.
+        jobs, tputs, sfs, prios, cluster = single_type_state(3, 4)
+        alloc = get_policy("max_min_fairness_water_filling").get_allocation(
+            tputs, sfs, prios, cluster)
+        shares = sorted(alloc[j]["v100"] for j in jobs)
+        assert shares == pytest.approx([1.0, 1.0, 1.0], abs=1e-3)
+
+    def test_lexicographic_improvement(self):
+        # Job 0 capped by its own time budget (share <= 1); remaining capacity
+        # should flow to jobs 1 and 2 rather than being wasted.
+        jobs, tputs, sfs, prios, cluster = single_type_state(
+            3, 3, tputs=[1.0, 1.0, 1.0])
+        alloc = get_policy("max_min_fairness_water_filling").get_allocation(
+            tputs, sfs, prios, cluster)
+        assert total_workers_used(alloc, sfs) == pytest.approx(3.0, abs=1e-3)
+
+
+class TestFinishTimeFairness:
+    def test_balances_rho(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(
+            2, 1, tputs=[1.0, 1.0])
+        times = {j: 100.0 for j in jobs}
+        steps = {jobs[0]: 1000.0, jobs[1]: 1000.0}
+        alloc = get_policy("finish_time_fairness").get_allocation(
+            tputs, sfs, prios, times, steps, cluster)
+        assert alloc[jobs[0]]["v100"] == pytest.approx(0.5, abs=0.02)
+
+    def test_rho_equalized_across_unequal_jobs(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(2, 1)
+        times = {j: 100.0 for j in jobs}
+        steps = {jobs[0]: 3000.0, jobs[1]: 1000.0}
+        alloc = get_policy("finish_time_fairness").get_allocation(
+            tputs, sfs, prios, times, steps, cluster)
+        # Isolated share is 0.5 each -> isolated finish times 6000 and 2000.
+        rho0 = (times[jobs[0]] + steps[jobs[0]] / alloc[jobs[0]]["v100"]) / 6000.0
+        rho1 = (times[jobs[1]] + steps[jobs[1]] / alloc[jobs[1]]["v100"]) / 2000.0
+        assert rho0 == pytest.approx(rho1, rel=0.02)
+        assert alloc[jobs[0]]["v100"] + alloc[jobs[1]]["v100"] == pytest.approx(1.0, abs=0.02)
+
+
+class TestMinTotalDuration:
+    def test_feasible_makespan(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(
+            2, 2, tputs=[10.0, 1.0])
+        steps = {jobs[0]: 1000.0, jobs[1]: 500.0}
+        alloc = get_policy("min_total_duration").get_allocation(
+            tputs, sfs, steps, cluster)
+        # Makespan is bottlenecked by job 1 (500 s at full share); the LP only
+        # needs to give job 0 enough share to finish within that horizon.
+        assert alloc[jobs[1]]["v100"] == pytest.approx(1.0, abs=0.05)
+        t_job0 = steps[jobs[0]] / (tputs[jobs[0]]["v100"] * alloc[jobs[0]]["v100"])
+        assert t_job0 <= 500.0 * 1.1
+
+
+class TestMaxSumThroughput:
+    def test_prefers_fast_jobs(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(
+            3, 1, tputs=[5.0, 1.0, 0.5])
+        alloc = get_policy("max_sum_throughput_perf").get_allocation(
+            tputs, sfs, cluster)
+        assert alloc[jobs[0]]["v100"] == pytest.approx(1.0, abs=1e-3)
+        assert alloc[jobs[1]]["v100"] == pytest.approx(0.0, abs=1e-3)
+
+    def test_slo_constraint_forces_share(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(
+            2, 1, tputs=[5.0, 1.0])
+        policy = get_policy("max_sum_throughput_normalized_by_cost_perf_SLOs")
+        alloc = policy.get_allocation(
+            tputs, sfs, cluster, SLOs={jobs[1]: 1000.0},
+            num_steps_remaining={jobs[0]: 1e6, jobs[1]: 500.0})
+        assert alloc[jobs[1]]["v100"] >= 0.5 - 1e-3
+
+
+class TestFIFO:
+    def test_queue_order(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(3, 2)
+        alloc = get_policy("fifo", seed=0).get_allocation(tputs, sfs, cluster)
+        assert alloc[jobs[0]]["v100"] == 1.0
+        assert alloc[jobs[1]]["v100"] == 1.0
+        assert alloc[jobs[2]]["v100"] == 0.0
+
+    def test_backfills_after_completion(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(3, 2)
+        policy = get_policy("fifo", seed=0)
+        policy.get_allocation(tputs, sfs, cluster)
+        del tputs[jobs[0]]  # job 0 completes
+        alloc = policy.get_allocation(tputs, sfs, cluster)
+        assert alloc[jobs[2]]["v100"] == 1.0
+
+    def test_perf_picks_fast_type(self):
+        j0 = JobIdPair(0)
+        tputs = {j0: {"fast": 5.0, "slow": 1.0}}
+        alloc = get_policy("fifo_perf").get_allocation(
+            tputs, {j0: 1}, {"fast": 1, "slow": 1})
+        assert alloc[j0]["fast"] == 1.0
+
+
+class TestAllox:
+    def test_single_job_gets_worker(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(1, 1, tputs=[2.0])
+        alloc = get_policy("allox").get_allocation(
+            tputs, sfs, {jobs[0]: 0.0}, {jobs[0]: 100.0}, [], cluster)
+        assert alloc[jobs[0]]["v100"] == 1.0
+
+    def test_non_preemptive(self):
+        jobs, tputs, sfs, prios, cluster = single_type_state(2, 1)
+        policy = get_policy("allox_alpha=1.0")
+        times = {j: 10.0 for j in jobs}
+        steps = {j: 100.0 for j in jobs}
+        a1 = policy.get_allocation(tputs, sfs, times, steps, [], cluster)
+        placed = [j for j in jobs if a1[j]["v100"] == 1.0]
+        assert len(placed) == 1
+        a2 = policy.get_allocation(tputs, sfs, times, steps, [], cluster)
+        assert a2[placed[0]]["v100"] == 1.0
+
+
+class TestGandiva:
+    def test_no_packing_when_fits(self):
+        j0, j1 = JobIdPair(0), JobIdPair(1)
+        tputs = {j0: {"v100": 1.0}, j1: {"v100": 1.0},
+                 JobIdPair(0, 1): {"v100": [0.5, 0.5]}}
+        alloc = get_policy("gandiva", seed=0).get_allocation(
+            tputs, {j0: 1, j1: 1}, {"v100": 2})
+        assert alloc[j0]["v100"] == pytest.approx(1.0)
+        assert alloc[JobIdPair(0, 1)]["v100"] == pytest.approx(0.0)
+
+    def test_packs_under_contention(self):
+        singles = [JobIdPair(i) for i in range(4)]
+        tputs = {s: {"v100": 1.0} for s in singles}
+        for i in range(4):
+            for j in range(i + 1, 4):
+                tputs[JobIdPair(i, j)] = {"v100": [0.8, 0.8]}
+        alloc = get_policy("gandiva", seed=0).get_allocation(
+            tputs, {s: 1 for s in singles}, {"v100": 2})
+        packed_share = sum(alloc[k]["v100"] for k in alloc if k.is_pair())
+        assert packed_share > 0
+
+
+class TestPackedMaxMin:
+    def test_packing_lp_runs(self):
+        singles = [JobIdPair(i) for i in range(3)]
+        tputs = {s: {"v100": 2.0} for s in singles}
+        for i in range(3):
+            for j in range(i + 1, 3):
+                tputs[JobIdPair(i, j)] = {"v100": [1.5, 1.5]}
+        sfs = {s: 1 for s in singles}
+        prios = {s: 1.0 for s in singles}
+        alloc = MaxMinFairnessPolicyWithPacking().get_allocation(
+            tputs, sfs, prios, {"v100": 2})
+        assert alloc is not None
+        # Per-single-job total time share <= 1.
+        for s in singles:
+            used = sum(alloc[k]["v100"] for k in alloc
+                       if k == s or (k.is_pair() and s.overlaps_with(k)))
+            assert used <= 1 + 1e-4
+
+
+class TestRegistry:
+    def test_all_names_construct(self):
+        names = ["fifo", "fifo_perf", "fifo_packed", "finish_time_fairness",
+                 "finish_time_fairness_perf", "gandiva", "gandiva_fair",
+                 "isolated", "isolated_plus", "max_min_fairness",
+                 "max_min_fairness_perf", "max_min_fairness_packed",
+                 "max_min_fairness_strategy_proof",
+                 "max_min_fairness_water_filling",
+                 "max_min_fairness_water_filling_perf",
+                 "max_sum_throughput_perf", "min_total_duration",
+                 "min_total_duration_perf", "allox", "allox_alpha=0.5",
+                 "proportional", "shockwave"]
+        for name in names:
+            assert get_policy(name, seed=0) is not None
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            get_policy("nope")

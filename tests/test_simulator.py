@@ -1,0 +1,154 @@
+"""Discrete-event simulator tests: tiny synthetic traces + canonical parity."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.sched import Scheduler, SchedulerConfig
+from shockwave_tpu.solver import get_policy
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+DATA = os.path.join(REPO, "data")
+
+
+def make_job(job_type="ResNet-18 (batch size 32)", total_steps=10000,
+             duration=1000, scale_factor=1, mode="static"):
+    return Job(None, job_type, f"python3 main.py --batch_size 32",
+               "image_classification/cifar10", "--num_steps",
+               total_steps=total_steps, duration=duration,
+               scale_factor=scale_factor, mode=mode)
+
+
+def run_sim(jobs, arrivals, policy_name="max_min_fairness", num_workers=2,
+            round_duration=120.0, **cfg):
+    policy = get_policy(policy_name, seed=0)
+    sched = Scheduler(
+        policy, simulate=True,
+        throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+        config=SchedulerConfig(time_per_iteration=round_duration, **cfg))
+    makespan = sched.simulate({"v100": num_workers}, arrivals, jobs)
+    return sched, makespan
+
+
+class TestSingleJob:
+    def test_runs_to_completion(self):
+        # ResNet-18 bs32 on v100: oracle 42.97 steps/s -> 10000 steps ~ 233s.
+        sched, makespan = run_sim([make_job(total_steps=10000)], [0.0])
+        assert len(sched._completed_jobs) == 1
+        jct = sched.get_average_jct()
+        assert jct[0] == pytest.approx(makespan, rel=0.01)
+        assert makespan == pytest.approx(10000 / 42.97497938, rel=0.01)
+
+    def test_multi_round_job(self):
+        sched, makespan = run_sim([make_job(total_steps=50000)], [0.0])
+        # 42.97 steps/s -> ~1163s over ~10 rounds of 120 s, preemption-free.
+        assert makespan == pytest.approx(50000 / 42.97497938, rel=0.01)
+        assert sched.rounds.num_completed_rounds >= 9
+
+
+class TestContention:
+    def test_two_jobs_one_worker_share(self):
+        jobs = [make_job(total_steps=20000), make_job(total_steps=20000)]
+        sched, makespan = run_sim(jobs, [0.0, 0.0], num_workers=1)
+        assert len(sched._completed_jobs) == 2
+        # Serial execution of interleaved rounds: ~2x the isolated runtime.
+        assert makespan > 350
+
+    def test_deterministic(self):
+        jobs1 = [make_job(total_steps=20000), make_job(total_steps=30000)]
+        jobs2 = [make_job(total_steps=20000), make_job(total_steps=30000)]
+        _, m1 = run_sim(jobs1, [0.0, 100.0], num_workers=1)
+        _, m2 = run_sim(jobs2, [0.0, 100.0], num_workers=1)
+        assert m1 == m2
+
+
+class TestMultiChipJobs:
+    def test_gang_scheduled(self):
+        jobs = [make_job(job_type="ResNet-18 (batch size 32)", total_steps=20000,
+                         scale_factor=4)]
+        sched, makespan = run_sim(jobs, [0.0], num_workers=4)
+        assert len(sched._completed_jobs) == 1
+        # All four chips were assigned in round 0.
+        assert len(sched.rounds.per_round_schedule[0][0]) == 4
+
+    def test_cannot_fit_waits(self):
+        jobs = [make_job(total_steps=10000, scale_factor=4),
+                make_job(total_steps=10000, scale_factor=4)]
+        sched, _ = run_sim(jobs, [0.0, 0.0], num_workers=4)
+        # Only one sf=4 job fits per round on 4 workers.
+        for round_sched in sched.rounds.per_round_schedule:
+            assert len(round_sched) <= 1
+
+
+class TestAdaptation:
+    def test_gns_job_doubles_bs(self):
+        # ResNet-18 bs16 sf1 GNS doubles at epoch 31; give it enough epochs.
+        steps_per_epoch = 50000 // 16 + 1
+        jobs = [make_job(job_type="ResNet-18 (batch size 16)",
+                         total_steps=steps_per_epoch * 50, duration=10**6,
+                         mode="gns")]
+        jobs[0].command = "python3 main.py --batch_size 16"
+        sched, _ = run_sim(jobs, [0.0], num_workers=1)
+        # After completion the job's recorded type reflects a larger bs.
+        assert len(sched._completed_jobs) == 1
+
+    def test_accordion_job_scales_up(self):
+        steps_per_epoch = 50000 // 32 + 1
+        jobs = [make_job(job_type="ResNet-18 (batch size 32)",
+                         total_steps=steps_per_epoch * 40, duration=10**6,
+                         mode="accordion")]
+        sched, _ = run_sim(jobs, [0.0], num_workers=1)
+        assert len(sched._completed_jobs) == 1
+
+
+class TestMetrics:
+    def test_utilization_bounded(self):
+        jobs = [make_job(total_steps=30000) for _ in range(3)]
+        sched, _ = run_sim(jobs, [0.0, 0.0, 0.0], num_workers=2)
+        util, per_worker = sched.get_cluster_utilization()
+        assert 0 < util <= 1.0
+
+    def test_envy_ratios(self):
+        jobs = [make_job(total_steps=30000) for _ in range(3)]
+        sched, _ = run_sim(jobs, [0.0, 0.0, 0.0], num_workers=2)
+        ratios, pairwise = sched.get_envy_ratios()
+        assert len(ratios) == 3
+        assert all(0 <= r <= 1 for r in ratios.values())
+
+
+@pytest.mark.slow
+class TestCanonicalParity:
+    """Replay the canonical 120-job trace and compare against the
+    reference's shipped result pickles (BASELINE.md)."""
+
+    REFERENCE = {
+        # policy: (makespan, avg_jct, unfair_fraction)
+        "max_min_fairness": (33207.66, 11274.12, 0.2167),
+        "gandiva_fair": (32367.43, 12574.27, 0.4333),
+        "max_sum_throughput_perf": (31909.03, 9654.70, 0.225),
+        "min_total_duration": (24204.82, 19806.73, 0.7167),
+        "allox": (32488.62, 9926.31, 0.2667),
+        "finish_time_fairness": (31928.74, 11301.98, 0.175),
+        "shockwave": (24197.42, 9958.49, 0.05),
+    }
+
+    @pytest.mark.parametrize("policy", sorted(REFERENCE))
+    def test_policy_close_to_reference(self, policy):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts/drivers/simulate.py"),
+             "--trace", os.path.join(DATA, "canonical_120job.trace"),
+             "--policy", policy,
+             "--throughputs", os.path.join(DATA, "tacc_throughputs.json"),
+             "--cluster_spec", "v100:32", "--round_duration", "120"]
+            + (["--config", os.path.join(REPO, "configs/tacc_32gpus.json")]
+               if policy == "shockwave" else []),
+            capture_output=True, text=True, timeout=1800)
+        assert out.returncode == 0, out.stderr[-2000:]
+        result = json.loads(out.stdout.strip().splitlines()[-1])
+        ref_makespan, ref_jct, ref_unfair = self.REFERENCE[policy]
+        assert result["makespan"] == pytest.approx(ref_makespan, rel=0.08)
+        assert result["avg_jct"] == pytest.approx(ref_jct, rel=0.10)
+        assert result["unfair_fraction"] == pytest.approx(ref_unfair, abs=0.08)
