@@ -1,0 +1,49 @@
+#!/usr/bin/env python3
+"""Headline benchmark: replay the reference's canonical experiment.
+
+Runs the Shockwave policy on the canonical 120-job trace against a
+32-chip cluster (120 s rounds) — the reference's own headline result
+(EXPERIMENTS.md:42, reproduce/tacc_32gpus.sh) — and reports makespan vs
+the reference's shipped result pickle (BASELINE.md: 24197.42 s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "s", "vs_baseline": value/baseline}
+(vs_baseline < 1.0 means faster/better than the reference.)
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+BASELINE_MAKESPAN_S = 24197.42350629904  # reference shockwave pickle
+
+
+def main():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts/drivers/simulate.py"),
+         "--trace", os.path.join(REPO, "data/canonical_120job.trace"),
+         "--policy", "shockwave",
+         "--throughputs", os.path.join(REPO, "data/tacc_throughputs.json"),
+         "--cluster_spec", "v100:32", "--round_duration", "120",
+         "--config", os.path.join(REPO, "configs/tacc_32gpus.json")],
+        capture_output=True, text=True, timeout=3600)
+    if out.returncode != 0:
+        print(json.dumps({"metric": "canonical_shockwave_makespan",
+                          "value": None, "unit": "s", "vs_baseline": None,
+                          "error": out.stderr[-500:]}))
+        sys.exit(1)
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    makespan = result["makespan"]
+    print(json.dumps({
+        "metric": "canonical_shockwave_makespan",
+        "value": round(makespan, 2),
+        "unit": "s",
+        "vs_baseline": round(makespan / BASELINE_MAKESPAN_S, 4),
+        "avg_jct": result["avg_jct"],
+        "unfair_fraction": result["unfair_fraction"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

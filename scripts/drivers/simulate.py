@@ -105,7 +105,7 @@ def main():
         "num_lease_extension_opportunities": opp,
         "per_round_schedule": sched.rounds.per_round_schedule,
         "time_per_iteration": args.round_duration,
-        "throughput_timeline": sched.get_makespan() and None,
+        "throughput_timeline": sched.get_throughput_timeline(),
     }
 
     unfair = (sum(1 for r in ftf_static if r > 1.1) / len(ftf_static)

@@ -1,0 +1,15 @@
+"""JAX/Flax workload model families.
+
+The five active families of the reference's job table
+(reference: scheduler/job_table.py:110-130), redesigned for the MXU:
+bf16 compute, channels-last convs, static shapes, jit-compiled train
+steps sharded over a dp mesh.
+
+| Family         | Model                      | Dataset (synthetic fallback) |
+|----------------|----------------------------|------------------------------|
+| ResNet-18      | resnet.ResNet18            | CIFAR-10 32x32x3, 10 cls     |
+| ResNet-50      | resnet.ResNet50            | ImageNet 224x224x3, 1000 cls |
+| Transformer    | transformer.Seq2SeqTransformer | Multi30k-like token pairs |
+| LM             | lm.LSTMLanguageModel       | Wikitext-2-like tokens       |
+| Recommendation | recommendation.AutoEncoder | ML-20M-like interaction rows |
+"""

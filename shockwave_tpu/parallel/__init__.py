@@ -1,0 +1,5 @@
+from .mesh import (make_mesh, data_parallel_sharding, replicate,
+                   shard_batch, local_batch_slice)
+
+__all__ = ["make_mesh", "data_parallel_sharding", "replicate", "shard_batch",
+           "local_batch_slice"]

@@ -1,0 +1,71 @@
+"""Device mesh and sharding helpers.
+
+The framework's data plane: jobs shard over a `jax.sharding.Mesh` and let
+XLA insert collectives on ICI — replacing the reference's PyTorch
+DDP/NCCL stack (reference: workloads/pytorch/*/main.py dist.init calls).
+
+Axis conventions used across the workloads:
+  dp — data parallel (batch sharded, params replicated; psum on grads)
+  tp — tensor parallel (feature-sharded matmuls)
+  sp — sequence parallel (ring attention over sequence shards)
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(dp: Optional[int] = None, tp: int = 1, sp: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a (dp, tp, sp) mesh; dp defaults to all remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if dp is None:
+        assert n % (tp * sp) == 0, (n, tp, sp)
+        dp = n // (tp * sp)
+    assert dp * tp * sp == n, f"mesh {dp}x{tp}x{sp} != {n} devices"
+    arr = np.array(devices).reshape((dp, tp, sp))
+    return Mesh(arr, axis_names=("dp", "tp", "sp"))
+
+
+def data_parallel_sharding(mesh: Mesh) -> Tuple[NamedSharding, NamedSharding]:
+    """(batch_sharding, replicated_sharding) for pure data parallelism."""
+    return (NamedSharding(mesh, P("dp")), NamedSharding(mesh, P()))
+
+
+def replicate(mesh: Mesh, tree):
+    """Replicate a pytree onto every device of the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Shard a batch pytree along its leading axis over the dp axis."""
+    sharding = NamedSharding(mesh, P("dp"))
+    return jax.device_put(batch, sharding)
+
+
+def local_batch_slice(global_batch_size: int, process_index: Optional[int] = None,
+                      process_count: Optional[int] = None) -> slice:
+    """The slice of a global batch this host is responsible for feeding."""
+    if process_index is None:
+        process_index = jax.process_index()
+    if process_count is None:
+        process_count = jax.process_count()
+    assert global_batch_size % process_count == 0
+    per = global_batch_size // process_count
+    return slice(process_index * per, (process_index + 1) * per)
+
+
+def maybe_initialize_distributed(coordinator: Optional[str],
+                                 num_processes: Optional[int],
+                                 process_id: Optional[int]) -> None:
+    """Join a multi-host JAX cluster when dispatched as part of a gang."""
+    if coordinator and num_processes and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id)

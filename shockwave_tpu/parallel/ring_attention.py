@@ -1,0 +1,114 @@
+"""Ring attention: sequence-parallel exact attention over the `sp` axis.
+
+Each device holds one sequence shard of Q, K, V. K/V blocks rotate around
+the ring with `lax.ppermute` while every device accumulates its queries'
+attention over all blocks using the numerically-stable online-softmax
+(flash-attention) update. Communication overlaps the per-block compute,
+FLOPs stay on the MXU, and per-device memory is O(seq/sp).
+
+This is the long-context capability the reference lacks entirely
+(SURVEY.md §5: no sequence parallelism anywhere); here it is first-class
+so workloads can scale past single-chip sequence-length limits.
+References: Liu et al., "Ring Attention with Blockwise Transformers"
+(arXiv:2310.01889); the public scaling-book collective patterns.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, m_prev, l_prev, o_prev, causal_mask=None):
+    """One online-softmax accumulation step.
+
+    q: (B, Tq, H, D); k/v: (B, Tk, H, D)
+    m_prev/l_prev: (B, H, Tq) running max / normalizer
+    o_prev: (B, Tq, H, D) running (unnormalized) output
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(q.shape[-1])
+    scores = scores.astype(jnp.float32)
+    if causal_mask is not None:
+        scores = jnp.where(causal_mask, scores, NEG_INF)
+    m_new = jnp.maximum(m_prev, scores.max(axis=-1))
+    correction = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l_prev * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    o_new = o_prev * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
+    """Per-shard body: rotate K/V around the ring, accumulate attention."""
+    axis_size = lax.psum(1, axis_name)
+    axis_index = lax.axis_index(axis_name)
+    batch, q_len, num_heads, head_dim = q.shape
+
+    m = jnp.full((batch, num_heads, q_len), NEG_INF, jnp.float32)
+    l = jnp.zeros((batch, num_heads, q_len), jnp.float32)
+    o = jnp.zeros(q.shape, jnp.float32)
+    # Mark the accumulators as device-varying along the ring axis so the
+    # scan carry types line up with the shard-resident outputs.
+    m, l, o = jax.tree.map(lambda x: lax.pvary(x, axis_name), (m, l, o))
+
+    def make_mask(step):
+        if not causal:
+            return None
+        # After `step` rotations this device holds the KV block that
+        # originated on device (axis_index - step) mod axis_size.
+        kv_index = jnp.mod(axis_index - step, axis_size)
+        q_pos = axis_index * q_len + jnp.arange(q_len)
+        k_pos = kv_index * q_len + jnp.arange(q_len)
+        return (q_pos[:, None] >= k_pos[None, :])[None, None]
+
+    def body(carry, step):
+        k_blk, v_blk, m, l, o = carry
+        m, l, o = _block_attention(q, k_blk, v_blk, m, l, o, make_mask(step))
+        # Pass KV to the next device in the ring (overlaps next compute).
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, o), None
+
+    (k, v, m, l, o), _ = lax.scan(
+        body, (k, v, m, l, o), jnp.arange(axis_size))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = False):
+    """Exact attention with Q/K/V sharded along sequence over `axis_name`.
+
+    Args:
+      q, k, v: (batch, seq, heads, head_dim), seq sharded over axis_name.
+    Returns: attention output with the same sharding as q.
+    """
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        partial(_ring_attention_local, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Unsharded attention for correctness checks."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(q.shape[-1])
+    scores = scores.astype(jnp.float32)
+    if causal:
+        q_len, k_len = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((q_len, k_len), bool))[None, None]
+        scores = jnp.where(mask, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v.dtype), v).astype(q.dtype)

@@ -1,0 +1,104 @@
+"""gRPC clients for all three control-plane directions
+(reference: runtime/rpc/{scheduler_client,worker_client,iterator_client}.py)."""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence, Tuple
+
+import grpc
+
+from .proto import control_pb2 as pb
+from .rpc import Stub
+
+logger = logging.getLogger("shockwave_tpu.runtime")
+
+
+class SchedulerToWorkerClient:
+    """Scheduler -> one worker daemon."""
+
+    def __init__(self, addr: str, port: int):
+        self.addr = addr
+        self.port = port
+        self._channel = grpc.insecure_channel(f"{addr}:{port}")
+        self._stub = Stub(self._channel, "shockwave_tpu.SchedulerToWorker")
+
+    def run_job(self, job_descriptions: Sequence[dict], worker_id: int,
+                round_id: int) -> None:
+        request = pb.RunJobRequest(
+            jobs=[pb.JobDescription(**d) for d in job_descriptions],
+            worker_id=worker_id, round_id=round_id)
+        self._stub.RunJob(request)
+
+    def kill_job(self, job_id: int) -> None:
+        self._stub.KillJob(pb.KillJobRequest(job_id=job_id))
+
+    def reset(self) -> None:
+        self._stub.Reset(pb.Empty())
+
+    def shutdown(self) -> None:
+        try:
+            self._stub.Shutdown(pb.Empty(), timeout=5)
+        except grpc.RpcError:
+            pass  # worker may exit before replying
+
+
+class WorkerToSchedulerClient:
+    """Worker daemon -> scheduler."""
+
+    def __init__(self, sched_addr: str, sched_port: int):
+        self._channel = grpc.insecure_channel(f"{sched_addr}:{sched_port}")
+        self._stub = Stub(self._channel, "shockwave_tpu.WorkerToScheduler")
+
+    def register_worker(self, worker_type: str, ip_addr: str, port: int,
+                        num_chips: int) -> Tuple[List[int], float]:
+        response = self._stub.RegisterWorker(pb.RegisterWorkerRequest(
+            worker_type=worker_type, ip_addr=ip_addr, port=port,
+            num_chips=num_chips))
+        if not response.success:
+            raise RuntimeError(response.error_message)
+        return list(response.worker_ids), response.round_duration
+
+    def notify_done(self, job_ids: Sequence[int], worker_id: int,
+                    num_steps: Sequence[int], execution_times: Sequence[float],
+                    iterator_logs: Optional[Sequence[str]] = None) -> None:
+        self._stub.Done(pb.DoneRequest(
+            job_ids=list(job_ids), worker_id=worker_id,
+            num_steps=[int(s) for s in num_steps],
+            execution_times=list(execution_times),
+            iterator_logs=list(iterator_logs or [])))
+
+
+class IteratorToSchedulerClient:
+    """Training process (lease iterator) -> scheduler. A fresh channel per
+    call keeps the client robust to scheduler restarts, as in the reference."""
+
+    def __init__(self, job_id: int, worker_id: int, sched_addr: str,
+                 sched_port: int):
+        self._job_id = job_id
+        self._worker_id = worker_id
+        self._target = f"{sched_addr}:{sched_port}"
+
+    def _stub(self, channel):
+        return Stub(channel, "shockwave_tpu.IteratorToScheduler")
+
+    def init(self) -> Tuple[int, float, float]:
+        with grpc.insecure_channel(self._target) as channel:
+            r = self._stub(channel).InitJob(pb.InitJobRequest(
+                job_id=self._job_id, worker_id=self._worker_id))
+            return r.max_steps, r.max_duration, r.extra_time
+
+    def update_lease(self, steps: int, duration: float, max_steps: int,
+                     max_duration: float) -> Tuple[int, float, float, float]:
+        with grpc.insecure_channel(self._target) as channel:
+            r = self._stub(channel).UpdateLease(pb.UpdateLeaseRequest(
+                job_id=self._job_id, worker_id=self._worker_id,
+                steps=int(steps), duration=duration, max_steps=int(max_steps),
+                max_duration=max_duration))
+            return r.max_steps, r.max_duration, r.run_time_so_far, r.deadline
+
+    def update_resource_requirement(self, big_bs: bool, small_bs: bool) -> None:
+        with grpc.insecure_channel(self._target) as channel:
+            self._stub(channel).UpdateResourceRequirement(
+                pb.UpdateResourceRequirementRequest(
+                    job_id=self._job_id, worker_id=self._worker_id,
+                    big_bs=big_bs, small_bs=small_bs))

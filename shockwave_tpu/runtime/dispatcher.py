@@ -1,0 +1,178 @@
+"""Per-worker job dispatcher: launches training processes on chips.
+
+Constructs the launch command (appending step budget, checkpoint dir, and
+the lease-iterator flag), injects the SWTPU_* environment, runs the
+process, scrapes progress from the iterator log, and notifies the
+scheduler (reference: runtime/rpc/dispatcher.py).
+
+TPU-native differences:
+- a "GPU id" becomes a chip index; single-chip jobs get exclusive use of
+  one chip via JAX_VISIBLE_DEVICES (no CUDA MPS equivalent on TPU, so no
+  space sharing on real hardware);
+- multi-chip jobs receive coordinator address/rank env for
+  `jax.distributed.initialize` instead of torch master_addr/port args.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import re
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("shockwave_tpu.runtime")
+
+_PROGRESS_RE = {
+    "steps": re.compile(r"\[PROGRESS\] \[STEPS\] (\d+)"),
+    "duration": re.compile(r"\[PROGRESS\] \[DURATION\] ([-+]?\d*\.\d+|\d+)"),
+}
+
+
+class Dispatcher:
+    def __init__(self, round_duration: float, chip_ids: List[int],
+                 worker_rpc_client, sched_addr: str, sched_port: int,
+                 run_dirs: Dict[str, str], data_dir: Optional[str],
+                 checkpoint_dir: str):
+        self._round_duration = round_duration
+        self._worker_rpc_client = worker_rpc_client
+        self._sched_addr = sched_addr
+        self._sched_port = sched_port
+        self._run_dirs = run_dirs  # mode -> root of training scripts
+        self._data_dir = data_dir
+        self._checkpoint_dir = checkpoint_dir
+        self._chip_queue: "queue.Queue[int]" = queue.Queue()
+        for chip_id in chip_ids:
+            self._chip_queue.put(chip_id)
+        self._lock = threading.Lock()
+        self._processes: Dict[int, subprocess.Popen] = {}  # job_id -> proc
+        self._pool = []
+        self._shutdown = threading.Event()
+
+    # -- command construction ---------------------------------------------
+
+    def _construct_command(self, job: dict, chip_id: int, worker_id: int) -> str:
+        command = job["command"]
+        if job["needs_data_dir"] and self._data_dir and "%s" in command:
+            command = command % (self._data_dir,)
+        command = (
+            f"{command} --local_rank {chip_id} "
+            f"{job['num_steps_arg']} {job['num_steps']} "
+            f"--checkpoint_dir {self._job_checkpoint_dir(job['job_id'])} "
+            f"--enable_lease_iterator"
+        )
+        return command
+
+    def _job_checkpoint_dir(self, job_id: int) -> str:
+        path = os.path.join(self._checkpoint_dir, f"job_id={job_id}")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _job_env(self, job: dict, worker_id: int, round_id: int,
+                 chip_id: int) -> dict:
+        env = dict(os.environ)
+        env.update({
+            "SWTPU_JOB_ID": str(job["job_id"]),
+            "SWTPU_WORKER_ID": str(worker_id),
+            "SWTPU_ROUND_ID": str(round_id),
+            "SWTPU_SCHED_ADDR": self._sched_addr,
+            "SWTPU_SCHED_PORT": str(self._sched_port),
+            # Restrict the training process to its chip.
+            "JAX_VISIBLE_DEVICES": str(chip_id),
+            "TPU_VISIBLE_CHIPS": str(chip_id),
+        })
+        return env
+
+    # -- progress scraping -------------------------------------------------
+
+    def _read_progress(self, job_id: int, round_id: int, worker_id: int):
+        log_path = os.path.join(
+            self._job_checkpoint_dir(job_id), ".swtpu",
+            f"round={round_id}", f"worker={worker_id}.log")
+        steps, duration, lines = 0, 0.0, []
+        try:
+            with open(log_path) as f:
+                for line in f:
+                    lines.append(line.rstrip("\n"))
+                    if m := _PROGRESS_RE["steps"].search(line):
+                        steps = int(m.group(1))
+                    if m := _PROGRESS_RE["duration"].search(line):
+                        duration = float(m.group(1))
+        except FileNotFoundError:
+            logger.warning("no iterator log for job %d round %d", job_id, round_id)
+        return steps, duration, "\n".join(lines)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def dispatch_jobs(self, jobs: List[dict], worker_id: int, round_id: int):
+        thread = threading.Thread(
+            target=self._dispatch_jobs_helper, args=(jobs, worker_id, round_id),
+            daemon=True)
+        self._pool.append(thread)
+        thread.start()
+
+    def _dispatch_jobs_helper(self, jobs: List[dict], worker_id: int,
+                              round_id: int):
+        chip_id = self._chip_queue.get()
+        results = []
+        try:
+            for job in jobs:
+                command = self._construct_command(job, chip_id, worker_id)
+                env = self._job_env(job, worker_id, round_id, chip_id)
+                cwd = self._run_dirs.get(job["mode"], ".")
+                if job["working_directory"]:
+                    cwd = os.path.join(cwd, job["working_directory"])
+                logger.info("[job %d round %d chip %d] launching: %s",
+                            job["job_id"], round_id, chip_id, command)
+                start = time.time()
+                proc = subprocess.Popen(
+                    command, shell=True, cwd=cwd, env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    start_new_session=True)
+                with self._lock:
+                    self._processes[job["job_id"]] = proc
+                output, _ = proc.communicate()
+                elapsed = time.time() - start
+                with self._lock:
+                    self._processes.pop(job["job_id"], None)
+                steps, duration, iterator_log = self._read_progress(
+                    job["job_id"], round_id, worker_id)
+                if proc.returncode != 0:
+                    logger.error("[job %d] exited %d:\n%s", job["job_id"],
+                                 proc.returncode,
+                                 output.decode(errors="replace")[-2000:])
+                if duration <= 0:
+                    duration = elapsed
+                results.append((job["job_id"], steps, duration, iterator_log))
+        finally:
+            self._chip_queue.put(chip_id)
+        self._worker_rpc_client.notify_done(
+            job_ids=[r[0] for r in results], worker_id=worker_id,
+            num_steps=[r[1] for r in results],
+            execution_times=[r[2] for r in results],
+            iterator_logs=[r[3] for r in results])
+
+    # -- control -----------------------------------------------------------
+
+    def kill_job(self, job_id: int):
+        with self._lock:
+            proc = self._processes.get(job_id)
+        if proc is not None and proc.poll() is None:
+            logger.info("killing job %d (pid %d)", job_id, proc.pid)
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    def reset(self):
+        with self._lock:
+            job_ids = list(self._processes)
+        for job_id in job_ids:
+            self.kill_job(job_id)
+
+    def shutdown(self):
+        self._shutdown.set()
+        self.reset()

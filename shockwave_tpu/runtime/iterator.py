@@ -1,0 +1,251 @@
+"""LeaseIterator: the job-side cooperative-preemption runtime for JAX.
+
+Wraps a training input pipeline; each `next()` accounts one step against a
+scheduler-granted lease and renews the lease at 75% consumption. When the
+lease expires the iterator raises StopIteration so the training loop can
+checkpoint and exit; the worker daemon then reports progress back.
+
+TPU-native notes (vs the reference's GavelIterator, gavel_iterator.py):
+- JAX dispatch is async: wall-clock per step lies unless we synchronize.
+  The iterator calls `jax.block_until_ready` on the caller-provided
+  `sync_ref` (usually the last step's loss) only at lease-check
+  boundaries, so honest timing costs one device sync per lease check,
+  not per step.
+- Multi-chip jobs synchronize their exit with a global barrier across
+  hosts so a gang checkpoint is consistent.
+- Checkpointing is delegated to caller functions (orbax-based helpers in
+  models/checkpoint.py).
+
+Environment contract (set by the dispatcher):
+  SWTPU_JOB_ID, SWTPU_WORKER_ID, SWTPU_ROUND_ID, SWTPU_SCHED_ADDR,
+  SWTPU_SCHED_PORT
+"""
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import time
+from typing import Any, Callable, Iterable, Optional
+
+from .clients import IteratorToSchedulerClient
+from .lease import Lease
+
+INFINITY = 1e9
+LEASE_UPDATE_FRACTION = 0.75
+LOG_FORMAT = "[{asctime}] [{event}] [{status}] {message}"
+DATE_FORMAT = "%Y-%m-%d %H:%M:%S"
+
+
+def _device_sync(value: Any) -> None:
+    """Block until device work producing `value` is complete."""
+    if value is None:
+        return
+    try:
+        import jax
+        jax.block_until_ready(value)
+    except ImportError:
+        pass
+
+
+class LeaseIterator:
+    def __init__(self, data_loader: Iterable, checkpoint_dir: str,
+                 load_checkpoint_func: Callable, save_checkpoint_func: Callable,
+                 synthetic_data: bool = False, write_on_close: bool = True,
+                 distributed_barrier: Optional[Callable] = None):
+        self._data_loader = data_loader
+        self._load_checkpoint_func = load_checkpoint_func
+        self._save_checkpoint_func = save_checkpoint_func
+        self._synthetic_data = synthetic_data
+        self._distributed_barrier = distributed_barrier
+
+        self._job_id = int(os.environ["SWTPU_JOB_ID"])
+        self._worker_id = int(os.environ["SWTPU_WORKER_ID"])
+        self._round_id = int(os.environ["SWTPU_ROUND_ID"])
+        sched_addr = os.environ["SWTPU_SCHED_ADDR"]
+        sched_port = int(os.environ["SWTPU_SCHED_PORT"])
+
+        round_dir = os.path.join(checkpoint_dir, ".swtpu",
+                                 f"round={self._round_id}")
+        os.makedirs(round_dir, exist_ok=True)
+        self._log_file = os.path.join(round_dir,
+                                      f"worker={self._worker_id}.log")
+        self._init_logger()
+
+        self._rpc = IteratorToSchedulerClient(
+            self._job_id, self._worker_id, sched_addr, sched_port)
+
+        self._steps = 0
+        self._duration = 0.0
+        self._done = False
+        self._sync_ref: Any = None
+        self._cached_batch = None
+        self._lease = Lease(0, 0)
+        self._write_on_close = write_on_close
+        atexit.register(self._close_log)
+        if write_on_close:
+            atexit.register(self._write_info)
+        self._update_lease(init=True)
+        self._write_info()
+        # Start the clock at construction: shared-filesystem reads before the
+        # first step can take tens of seconds and must count against the lease.
+        self._prev_time = time.time()
+
+    # -- iteration ---------------------------------------------------------
+
+    def __iter__(self):
+        self._iterator = iter(self._data_loader)
+        return self
+
+    def __len__(self):
+        return len(self._data_loader)
+
+    def set_sync_ref(self, value: Any) -> None:
+        """Give the iterator a device value (e.g. the last loss) to sync on
+        when honest timing is needed."""
+        self._sync_ref = value
+
+    def __next__(self):
+        now = time.time()
+        if self._prev_time is None:
+            self._prev_time = now
+        elapsed = now - self._prev_time
+        self._duration += elapsed
+        self._prev_time = now
+
+        if (self._steps_until_lease_update <= 0
+                or self._time_until_lease_update <= 0):
+            # Sync outstanding device work so self._duration is honest at the
+            # renewal boundary.
+            _device_sync(self._sync_ref)
+            sync_now = time.time()
+            self._duration += sync_now - self._prev_time
+            self._prev_time = sync_now
+            self._update_lease()
+
+        if (self._duration >= self._lease.max_duration
+                or self._steps >= self._lease.max_steps):
+            self._done = True
+            self._logger.info(
+                "%d / %s steps, %.4f / %.4f seconds",
+                self._steps, self._lease.max_steps, self._duration,
+                self._lease.max_duration,
+                extra={"event": "LEASE", "status": "EXPIRED"})
+            _device_sync(self._sync_ref)
+            if self._distributed_barrier is not None:
+                self._distributed_barrier()
+            raise StopIteration
+
+        try:
+            if self._synthetic_data and self._cached_batch is not None:
+                value = self._cached_batch
+            else:
+                value = next(self._iterator)
+                if self._synthetic_data:
+                    self._cached_batch = value
+            self._steps += 1
+        except StopIteration:
+            self._write_info()
+            raise
+
+        if self._synthetic_data and self._steps % len(self._data_loader) == 0:
+            raise StopIteration
+
+        self._steps_until_lease_update -= 1
+        self._time_until_lease_update -= elapsed
+        return value
+
+    # -- job-side API ------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def complete(self, timeout: bool = False) -> None:
+        self._done = True
+        if not self._write_on_close:
+            self._write_info()
+        self._logger.info("", extra={"event": "LEASE", "status": "COMPLETE"})
+
+    def update_resource_requirement(self, big_bs: bool, small_bs: bool) -> None:
+        """Report a batch-size change request; job must checkpoint + exit."""
+        self._done = True
+        self._rpc.update_resource_requirement(big_bs, small_bs)
+
+    def load_checkpoint(self, *args, **kwargs):
+        self._logger.info("", extra={"event": "LOAD CHECKPOINT", "status": "BEGIN"})
+        out = self._load_checkpoint_func(*args, **kwargs)
+        self._logger.info("", extra={"event": "LOAD CHECKPOINT", "status": "END"})
+        return out
+
+    def save_checkpoint(self, *args, **kwargs):
+        self._logger.info("", extra={"event": "SAVE CHECKPOINT", "status": "BEGIN"})
+        out = self._save_checkpoint_func(*args, **kwargs)
+        self._logger.info("", extra={"event": "SAVE CHECKPOINT", "status": "END"})
+        return out
+
+    # -- lease protocol ----------------------------------------------------
+
+    def _update_lease(self, init: bool = False) -> None:
+        if init:
+            max_steps, max_duration, extra_time = self._rpc.init()
+        else:
+            max_steps, max_duration, run_time_so_far, deadline = (
+                self._rpc.update_lease(self._steps, self._duration,
+                                       self._lease.max_steps,
+                                       self._lease.max_duration))
+            extra_time = 0.0
+            if self._duration + run_time_so_far > deadline:
+                # Deadline enforcement: scheduler says we have overrun 1.5x
+                # our expected duration; finish now.
+                self._logger.info(
+                    "over deadline (%.1f + %.1f > %.1f)", self._duration,
+                    run_time_so_far, deadline,
+                    extra={"event": "LEASE", "status": "DEADLINE"})
+                self.complete(timeout=True)
+                raise StopIteration
+
+        # Plan the next renewal at LEASE_UPDATE_FRACTION of the new grant; an
+        # unchanged grant means this lease is final.
+        if max_steps == self._lease.max_steps:
+            self._steps_until_lease_update = INFINITY
+        else:
+            additional = max_steps - self._lease.max_steps
+            left = self._lease.max_steps - self._steps
+            self._steps_until_lease_update = (
+                left + additional * LEASE_UPDATE_FRACTION)
+        if max_duration <= self._lease.max_duration:
+            self._time_until_lease_update = INFINITY
+        else:
+            additional = max_duration - self._lease.max_duration
+            left = self._lease.max_duration - self._duration
+            self._time_until_lease_update = (
+                left + additional * LEASE_UPDATE_FRACTION + extra_time)
+
+        self._lease.max_steps = max_steps
+        self._lease.max_duration = max_duration + extra_time
+
+    # -- logging -----------------------------------------------------------
+
+    def _init_logger(self):
+        self._logger = logging.getLogger(f"lease_iterator.{self._job_id}")
+        self._logger.propagate = False
+        self._logger.setLevel(logging.DEBUG)
+        self._file_handler = logging.FileHandler(self._log_file)
+        self._file_handler.setFormatter(
+            logging.Formatter(LOG_FORMAT, datefmt=DATE_FORMAT, style="{"))
+        self._logger.addHandler(self._file_handler)
+
+    def _write_info(self):
+        self._logger.info("%d", self._steps,
+                          extra={"event": "PROGRESS", "status": "STEPS"})
+        self._logger.info("%f", self._duration,
+                          extra={"event": "PROGRESS", "status": "DURATION"})
+
+    def _close_log(self):
+        self._logger.removeHandler(self._file_handler)
+        self._file_handler.close()
+
+
+# Alias for users migrating from the reference framework.
+GavelIterator = LeaseIterator

@@ -1,0 +1,57 @@
+"""gRPC service bindings without protoc's grpc plugin.
+
+Service/method tables are declared once; `make_stub` builds a client-side
+callable stub and `generic_handler` a server-side handler from the same
+table, so the two can never drift apart.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import grpc
+
+from .proto import control_pb2 as pb
+
+SERVICES: Dict[str, Dict[str, tuple]] = {
+    "shockwave_tpu.WorkerToScheduler": {
+        "RegisterWorker": (pb.RegisterWorkerRequest, pb.RegisterWorkerResponse),
+        "Done": (pb.DoneRequest, pb.Empty),
+    },
+    "shockwave_tpu.SchedulerToWorker": {
+        "RunJob": (pb.RunJobRequest, pb.Empty),
+        "KillJob": (pb.KillJobRequest, pb.Empty),
+        "Reset": (pb.Empty, pb.Empty),
+        "Shutdown": (pb.Empty, pb.Empty),
+    },
+    "shockwave_tpu.IteratorToScheduler": {
+        "InitJob": (pb.InitJobRequest, pb.InitJobResponse),
+        "UpdateLease": (pb.UpdateLeaseRequest, pb.UpdateLeaseResponse),
+        "UpdateResourceRequirement": (pb.UpdateResourceRequirementRequest, pb.Empty),
+    },
+}
+
+
+class Stub:
+    """Client stub exposing one attribute per RPC method."""
+
+    def __init__(self, channel: grpc.Channel, service: str):
+        for method, (req_cls, resp_cls) in SERVICES[service].items():
+            callable_ = channel.unary_unary(
+                f"/{service}/{method}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+            setattr(self, method, callable_)
+
+
+def generic_handler(service: str, implementations: Dict[str, Callable]):
+    """Build a grpc generic handler from {method_name: fn(request, context)}."""
+    method_handlers = {}
+    for method, fn in implementations.items():
+        req_cls, resp_cls = SERVICES[service][method]
+        method_handlers[method] = grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    return grpc.method_handlers_generic_handler(service, method_handlers)

@@ -1,0 +1,139 @@
+"""gRPC servers for both ends of the control plane.
+
+- `serve_scheduler`: hosts WorkerToScheduler + IteratorToScheduler on the
+  scheduler (reference: runtime/rpc/scheduler_server.py).
+- `serve_worker`: hosts SchedulerToWorker on each worker daemon
+  (reference: runtime/rpc/worker_server.py).
+
+Callback dicts carry plain-Python payloads; proto (de)serialization stays
+inside this module.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+from concurrent import futures
+from typing import Callable, Dict
+
+import grpc
+
+from ..core.job import JobIdPair
+from .proto import control_pb2 as pb
+from .rpc import generic_handler
+
+logger = logging.getLogger("shockwave_tpu.runtime")
+
+
+def get_host_ip() -> str:
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except socket.gaierror:
+        return "127.0.0.1"
+
+
+def serve_scheduler(port: int, callbacks: Dict[str, Callable],
+                    max_workers: int = 32) -> grpc.Server:
+    """Start the scheduler-side server (non-blocking); returns the server."""
+
+    def register_worker(request, context):
+        try:
+            worker_ids, round_duration = callbacks["RegisterWorker"](
+                worker_type=request.worker_type,
+                num_chips=request.num_chips,
+                ip_addr=request.ip_addr,
+                port=request.port)
+            return pb.RegisterWorkerResponse(
+                success=True, worker_ids=worker_ids,
+                round_duration=round_duration)
+        except Exception as e:  # noqa: BLE001 - reported to the caller
+            logger.exception("RegisterWorker failed")
+            return pb.RegisterWorkerResponse(success=False, error_message=str(e))
+
+    def done(request, context):
+        job_id = JobIdPair(*(list(request.job_ids) + [None])[:2])
+        callbacks["Done"](job_id, request.worker_id,
+                          list(request.num_steps),
+                          list(request.execution_times),
+                          list(request.iterator_logs) or None)
+        return pb.Empty()
+
+    def init_job(request, context):
+        max_steps, max_duration, extra_time = callbacks["InitJob"](
+            JobIdPair(request.job_id))
+        return pb.InitJobResponse(max_steps=int(max_steps),
+                                  max_duration=max_duration,
+                                  extra_time=extra_time)
+
+    def update_lease(request, context):
+        max_steps, max_duration, run_time_so_far, deadline = callbacks["UpdateLease"](
+            JobIdPair(request.job_id), request.worker_id, request.steps,
+            request.duration, request.max_steps, request.max_duration)
+        return pb.UpdateLeaseResponse(
+            max_steps=int(max_steps), max_duration=float(max_duration),
+            run_time_so_far=float(run_time_so_far), deadline=float(deadline))
+
+    def update_resource_requirement(request, context):
+        callbacks["UpdateResourceRequirement"](
+            JobIdPair(request.job_id), request.worker_id,
+            request.big_bs, request.small_bs)
+        return pb.Empty()
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((
+        generic_handler("shockwave_tpu.WorkerToScheduler", {
+            "RegisterWorker": register_worker,
+            "Done": done,
+        }),
+        generic_handler("shockwave_tpu.IteratorToScheduler", {
+            "InitJob": init_job,
+            "UpdateLease": update_lease,
+            "UpdateResourceRequirement": update_resource_requirement,
+        }),
+    ))
+    server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    logger.info("scheduler control server listening on %d", port)
+    return server
+
+
+def serve_worker(port: int, callbacks: Dict[str, Callable],
+                 max_workers: int = 16) -> grpc.Server:
+    """Start the worker-side server (non-blocking); returns the server."""
+
+    def run_job(request, context):
+        jobs = [
+            dict(job_id=j.job_id, command=j.command,
+                 working_directory=j.working_directory,
+                 needs_data_dir=j.needs_data_dir,
+                 num_steps_arg=j.num_steps_arg, num_steps=j.num_steps,
+                 mode=j.mode)
+            for j in request.jobs
+        ]
+        callbacks["RunJob"](jobs, request.worker_id, request.round_id)
+        return pb.Empty()
+
+    def kill_job(request, context):
+        callbacks["KillJob"](request.job_id)
+        return pb.Empty()
+
+    def reset(request, context):
+        callbacks["Reset"]()
+        return pb.Empty()
+
+    def shutdown(request, context):
+        callbacks["Shutdown"]()
+        return pb.Empty()
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((
+        generic_handler("shockwave_tpu.SchedulerToWorker", {
+            "RunJob": run_job,
+            "KillJob": kill_job,
+            "Reset": reset,
+            "Shutdown": shutdown,
+        }),
+    ))
+    server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    logger.info("worker control server listening on %d", port)
+    return server

@@ -1,0 +1,115 @@
+"""Worker daemon: registers this host's TPU chips with the scheduler and
+dispatches training jobs onto them (reference: scheduler/worker.py).
+
+Usage:
+    python -m shockwave_tpu.runtime.worker \
+        --worker_type v5e --sched_addr 10.0.0.2 --sched_port 50070 \
+        --worker_port 50061 --run_dir workloads/ --checkpoint_dir /nfs/ckpt
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+from .clients import WorkerToSchedulerClient
+from .dispatcher import Dispatcher
+from .servers import get_host_ip, serve_worker
+
+logger = logging.getLogger("shockwave_tpu.runtime")
+
+
+def detect_num_chips() -> int:
+    try:
+        import jax
+        return len(jax.devices())
+    except Exception:  # noqa: BLE001 - no accelerator runtime available
+        return 0
+
+
+class WorkerDaemon:
+    def __init__(self, worker_type: str, sched_addr: str, sched_port: int,
+                 worker_port: int, num_chips: int, run_dirs: dict,
+                 data_dir: str, checkpoint_dir: str):
+        self._shutdown_event = threading.Event()
+        self._rpc_client = WorkerToSchedulerClient(sched_addr, sched_port)
+
+        callbacks = {
+            "RunJob": self._run_job,
+            "KillJob": self._kill_job,
+            "Reset": self._reset,
+            "Shutdown": self._shutdown,
+        }
+        self._server = serve_worker(worker_port, callbacks)
+
+        worker_ids, round_duration = self._rpc_client.register_worker(
+            worker_type=worker_type, ip_addr=get_host_ip(), port=worker_port,
+            num_chips=num_chips)
+        logger.info("registered %d chips as workers %s (round %.0fs)",
+                    num_chips, worker_ids, round_duration)
+        self._worker_ids = worker_ids
+
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self._dispatcher = Dispatcher(
+            round_duration, chip_ids=list(range(num_chips)),
+            worker_rpc_client=self._rpc_client, sched_addr=sched_addr,
+            sched_port=sched_port, run_dirs=run_dirs, data_dir=data_dir,
+            checkpoint_dir=checkpoint_dir)
+
+    def _run_job(self, jobs, worker_id, round_id):
+        self._dispatcher.dispatch_jobs(jobs, worker_id, round_id)
+
+    def _kill_job(self, job_id):
+        self._dispatcher.kill_job(job_id)
+
+    def _reset(self):
+        self._dispatcher.reset()
+
+    def _shutdown(self):
+        self._dispatcher.shutdown()
+        self._shutdown_event.set()
+
+    def join(self):
+        self._shutdown_event.wait()
+        self._server.stop(grace=1)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--worker_type", "-t", default="v5e")
+    p.add_argument("--sched_addr", "-i", required=True)
+    p.add_argument("--sched_port", "-s", type=int, default=50070)
+    p.add_argument("--worker_port", "-w", type=int, default=50061)
+    p.add_argument("--num_chips", "-g", type=int, default=None,
+                   help="default: autodetect via jax.devices()")
+    p.add_argument("--static_run_dir", default="shockwave_tpu/models")
+    p.add_argument("--accordion_run_dir", default="shockwave_tpu/models")
+    p.add_argument("--gns_run_dir", default="shockwave_tpu/models")
+    p.add_argument("--data_dir", default=None)
+    p.add_argument("--checkpoint_dir", default="/tmp/swtpu_checkpoints")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(name)s:%(levelname)s %(message)s")
+
+    num_chips = args.num_chips if args.num_chips is not None else detect_num_chips()
+    if num_chips <= 0:
+        raise RuntimeError("no accelerator chips detected; pass --num_chips")
+
+    daemon = WorkerDaemon(
+        worker_type=args.worker_type, sched_addr=args.sched_addr,
+        sched_port=args.sched_port, worker_port=args.worker_port,
+        num_chips=num_chips,
+        run_dirs={"static": args.static_run_dir,
+                  "accordion": args.accordion_run_dir,
+                  "gns": args.gns_run_dir},
+        data_dir=args.data_dir, checkpoint_dir=args.checkpoint_dir)
+    signal.signal(signal.SIGINT, lambda s, f: daemon._shutdown())
+    daemon.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,572 @@
+"""Physical-cluster execution: the round mechanism over real workers.
+
+`PhysicalScheduler` extends the simulator-capable core with:
+- wall-clock time and thread-safe callback entry points,
+- the begin/mid/end round pipeline: recompute the schedule at 50% of the
+  round, extend leases when placements repeat, dispatch the next round
+  early, and enforce round completion with watchdog events,
+- the lease protocol callbacks (init / renew / consensus for multi-chip
+  gangs) and failure handling (kill unresponsive jobs)
+(reference: scheduler/scheduler.py:2382-2777, 3880-4339).
+"""
+from __future__ import annotations
+
+import collections
+import copy
+import logging
+import math
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..core.job import JobIdPair
+from .scheduler import DEADLINE_SLACK, INFINITY, Scheduler, SchedulerConfig
+
+logger = logging.getLogger("shockwave_tpu.sched")
+
+SCHEDULE_RECOMPUTE_FRACTION = 0.5
+JOB_COMPLETION_BUFFER_TIME = 60.0
+EARLY_INIT_THRESHOLD = 3.0
+BASE_JOB_PORT = 60570
+MAX_PORT = 65535
+
+
+class PhysicalScheduler(Scheduler):
+    def __init__(self, policy, throughputs_file=None, profiles=None,
+                 config: Optional[SchedulerConfig] = None,
+                 expected_num_workers: Optional[int] = None,
+                 port: int = 50070):
+        super().__init__(policy, simulate=False,
+                         throughputs_file=throughputs_file, profiles=profiles,
+                         config=config)
+        self._start_time = time.time()
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._expected_num_workers = expected_num_workers
+
+        self._worker_connections: Dict[int, object] = {}
+        self._available_workers: "queue.Queue[int]" = queue.Queue()
+        self._lease_update_requests: Dict[JobIdPair, list] = {}
+        self._max_steps_consensus: Dict[JobIdPair, Optional[int]] = {}
+        self._completion_events: Dict[JobIdPair, threading.Timer] = {}
+        self._redispatch_assignments: "collections.OrderedDict" = collections.OrderedDict()
+        self._current_round_start_time = 0.0
+        self._port_offset = 0
+        self._done_event = threading.Event()
+
+        from ..runtime.servers import serve_scheduler
+        self._server = serve_scheduler(port, {
+            "RegisterWorker": self._register_worker_rpc,
+            "Done": self.done_callback,
+            "InitJob": self._init_job_callback,
+            "UpdateLease": self._update_lease_callback,
+            "UpdateResourceRequirement": self._update_resource_requirement_callback,
+        })
+
+        if policy.name != "shockwave":
+            threading.Thread(target=self._allocation_thread, daemon=True).start()
+
+    # ------------------------------------------------------------------
+    # Time / threading
+    # ------------------------------------------------------------------
+
+    def get_current_timestamp(self) -> float:
+        return time.time()
+
+    def add_job(self, job, timestamp=None):
+        with self._cv:
+            job_id = super().add_job(job, timestamp)
+            self._lease_update_requests[job_id] = []
+            self._max_steps_consensus[job_id] = None
+            self._cv.notify_all()
+            return job_id
+
+    # ------------------------------------------------------------------
+    # RPC callbacks
+    # ------------------------------------------------------------------
+
+    def _register_worker_rpc(self, worker_type, num_chips, ip_addr, port):
+        from ..runtime.clients import SchedulerToWorkerClient
+        client = SchedulerToWorkerClient(ip_addr, port)
+        with self._cv:
+            worker_ids, round_duration = self.register_worker(
+                worker_type, num_chips)
+            for worker_id in worker_ids:
+                self._worker_connections[worker_id] = client
+            self._cv.notify_all()
+        return worker_ids, round_duration
+
+    def _init_job_callback(self, job_id: JobIdPair):
+        """Grant the initial lease (reference: scheduler.py:3880-4048)."""
+        with self._cv:
+            if job_id not in self.acct.jobs:
+                return (0, 0.0, 0.0)
+            # If the job was dispatched early for the *next* round, wait for
+            # its current-round run (or a colocated partner) to finish.
+            while True:
+                next_combo = None
+                if self.rounds.next_assignments is not None:
+                    for combo in self.rounds.next_assignments:
+                        if job_id.overlaps_with(combo):
+                            next_combo = combo
+                            break
+                blocked = False
+                if next_combo is not None:
+                    for combo in self.rounds.current_assignments:
+                        for m in next_combo.singletons():
+                            if (m.overlaps_with(combo) and combo not in
+                                    self.rounds.completed_in_round):
+                                blocked = True
+                if blocked:
+                    self._cv.wait()
+                else:
+                    break
+
+            self.acct.latest_timestamps[job_id] = self.get_current_timestamp()
+            for m in job_id.singletons():
+                self._running_jobs.add(m)
+
+            job = self.acct.jobs[job_id]
+            remaining = int(math.ceil(
+                self._get_remaining_steps(job_id) / job.scale_factor))
+            now = self.get_current_timestamp()
+            round_end = self._current_round_start_time + self._time_per_iteration
+            time_left = max(round_end - now, 0.0)
+
+            if self.rounds.next_assignments is not None and next_combo is not None:
+                # Early dispatch for the next round: full round + leftover.
+                return (remaining, self._time_per_iteration, time_left)
+            if time_left > 0:
+                return (remaining, time_left, 0.0)
+            # Init in the gap between rounds.
+            return (remaining, self._time_per_iteration - EARLY_INIT_THRESHOLD,
+                    time_left)
+
+    def _update_lease_callback(self, job_id: JobIdPair, worker_id: int,
+                               steps: int, duration: float, max_steps: int,
+                               max_duration: float):
+        """Renew a lease (reference: scheduler.py:4050-4180)."""
+        with self._lock:
+            if job_id not in self.acct.jobs:
+                return (0, 0.0, 0.0, 0.0)
+            job = self.acct.jobs[job_id]
+            run_time_so_far = int(
+                sum(self.acct.run_time_per_worker[job_id].values())
+                / job.scale_factor)
+            deadline = int(job.duration * DEADLINE_SLACK)
+            self._lease_update_requests.setdefault(job_id, [])
+            update_id = len(self._lease_update_requests[job_id])
+            self._lease_update_requests[job_id].append(
+                (steps, duration, max_steps, max_duration))
+
+            scale_factor = job.scale_factor
+            remaining = int(math.ceil(
+                self._get_remaining_steps(job_id) / scale_factor))
+            now = self.get_current_timestamp()
+            round_end = self._current_round_start_time + self._time_per_iteration
+            time_left = max(0.0, round_end - now)
+
+            # Track in-lease progress so the planner sees fresh epochs even
+            # under extended leases.
+            self._steps_run_in_current_lease[job_id] = steps * scale_factor
+
+        if steps == 0 or duration == 0:
+            return (remaining, time_left, run_time_so_far, deadline)
+
+        with self._lock:
+            for combo in self.rounds.extended_leases:
+                if job_id.overlaps_with(combo):
+                    extended = duration + time_left + self._time_per_iteration
+                    return (max_steps, extended, run_time_so_far, deadline)
+
+        if scale_factor == 1:
+            return (max_steps, duration + time_left, run_time_so_far, deadline)
+
+        # Multi-chip gang: the first renewer computes the shared step budget;
+        # the rest adopt it (first-requester-computes consensus).
+        if update_id == 0:
+            with self._lock:
+                throughput = steps / duration
+                self._max_steps_consensus[job_id] = min(
+                    remaining, steps + int(time_left * throughput))
+                return (self._max_steps_consensus[job_id], INFINITY,
+                        run_time_so_far, deadline)
+        while True:
+            with self._lock:
+                consensus = self._max_steps_consensus.get(job_id)
+            if consensus is not None:
+                return (consensus, INFINITY, run_time_so_far, deadline)
+            time.sleep(1)
+
+    def _update_resource_requirement_callback(self, job_id: JobIdPair,
+                                              worker_id: int, big_bs: bool,
+                                              small_bs: bool):
+        with self._cv:
+            if job_id not in self._bs_flags:
+                return
+            if big_bs:
+                self._bs_flags[job_id]["big_bs"] = True
+            else:
+                self._bs_flags[job_id]["small_bs"] = True
+            self._cv.notify_all()
+
+    def done_callback(self, job_id, worker_id, all_num_steps,
+                      all_execution_times, iterator_logs=None):
+        with self._cv:
+            # If the job was dispatched for round r+1 and finished before
+            # round r closed, wait for the round boundary.
+            while (job_id not in self.rounds.current_assignments
+                   or job_id in self.rounds.completed_in_round):
+                if (job_id not in self.rounds.current_assignments
+                        and self.rounds.next_assignments is not None
+                        and job_id not in self.rounds.next_assignments):
+                    logger.warning("discarding completion for unscheduled job %s",
+                                   job_id)
+                    return
+                self._cv.wait()
+
+            for m in job_id.singletons():
+                if m in self.acct.jobs:
+                    self.acct.latest_timestamps[m] = self.get_current_timestamp()
+            self._available_workers.put(worker_id)
+
+            timer = self._completion_events.pop(job_id, None)
+            if timer is not None:
+                timer.cancel()
+
+            super().done_callback(job_id, worker_id, all_num_steps,
+                                  all_execution_times)
+
+            for m in job_id.singletons():
+                self._lease_update_requests[m] = []
+                self._max_steps_consensus[m] = None
+
+            # Early finisher holding an extended lease must be re-dispatched
+            # for the round it was already granted.
+            is_active = any(m in self.acct.jobs for m in job_id.singletons())
+            if is_active and job_id in self.rounds.extended_leases:
+                self._redispatch_assignments[job_id] = (
+                    self.rounds.next_assignments[job_id])
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # Allocation thread
+    # ------------------------------------------------------------------
+
+    def _allocation_thread(self):
+        while not self._done_event.is_set():
+            with self._cv:
+                while not self._need_to_update_allocation:
+                    self._cv.wait(timeout=1.0)
+                    if self._done_event.is_set():
+                        return
+                state = self._allocation_state()
+            allocation = self._compute_allocation(state)
+            with self._cv:
+                self._allocation = allocation
+                self._need_to_update_allocation = False
+                self._cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # Round pipeline
+    # ------------------------------------------------------------------
+
+    def _try_dispatch_job(self, job_id: JobIdPair, worker_ids: Tuple[int, ...],
+                          next_round: bool = False):
+        if not next_round or job_id not in self.rounds.current_assignments:
+            self._in_progress_updates[job_id] = []
+            for m in job_id.singletons():
+                self._lease_update_requests[m] = []
+                self._max_steps_consensus[m] = None
+
+        scale_factor = len(worker_ids)
+        round_id = self.rounds.num_completed_rounds + (1 if next_round else 0)
+        coordinator = None
+        if scale_factor > 1:
+            head = self._worker_connections[worker_ids[0]]
+            port = BASE_JOB_PORT + self._port_offset
+            self._port_offset = (self._port_offset + 1) % (MAX_PORT - BASE_JOB_PORT)
+            coordinator = f"{head.addr}:{port}"
+
+        for rank, worker_id in enumerate(worker_ids):
+            descriptions = []
+            for m in job_id.singletons():
+                job = self.acct.jobs[m]
+                command = job.command
+                if scale_factor > 1:
+                    # Multi-chip gang: coordinator rendezvous for
+                    # jax.distributed.initialize.
+                    command += (f" --coordinator {coordinator}"
+                                f" --num_processes {scale_factor}"
+                                f" --process_id {rank}")
+                descriptions.append(dict(
+                    job_id=m.integer_job_id(), command=command,
+                    working_directory=job.working_directory,
+                    needs_data_dir=job.needs_data_dir,
+                    num_steps_arg=job.num_steps_arg,
+                    num_steps=job.total_steps, mode=job.mode))
+            self._worker_connections[worker_id].run_job(
+                descriptions, worker_id, round_id)
+            if not next_round:
+                self._remove_available_worker(worker_id)
+
+    def _remove_available_worker(self, worker_id):
+        try:
+            # Drain this specific id (queue holds unique ids).
+            items = []
+            while True:
+                item = self._available_workers.get_nowait()
+                if item == worker_id:
+                    break
+                items.append(item)
+            for item in items:
+                self._available_workers.put(item)
+        except queue.Empty:
+            for item in items:
+                self._available_workers.put(item)
+
+    def _begin_round(self):
+        self._current_round_start_time = self.get_current_timestamp()
+        for job_id in self.rounds.current_assignments:
+            for m in job_id.singletons():
+                self._lease_update_requests[m] = []
+                self._max_steps_consensus[m] = None
+        for job_id, worker_ids in self._redispatch_assignments.items():
+            if any(m in self.acct.jobs for m in job_id.singletons()):
+                logger.info("re-dispatching early-finished job %s", job_id)
+                self._try_dispatch_job(job_id, worker_ids)
+        self._redispatch_assignments = collections.OrderedDict()
+        logger.info("*** START ROUND %d ***", self.rounds.num_completed_rounds)
+
+    def _is_final_round(self):
+        return (self._config.max_rounds is not None
+                and self.rounds.num_completed_rounds + 1 == self._config.max_rounds)
+
+    def _mid_round(self):
+        """Recompute next round's schedule, extend leases, dispatch early."""
+        if self._is_final_round():
+            self.rounds.extended_leases = set()
+            return
+        round_end = self._current_round_start_time + self._time_per_iteration
+
+        self.rounds.next_assignments = self._schedule_jobs_on_workers()
+
+        for job_id in self.rounds.current_assignments:
+            if any(m in self.acct.jobs for m in job_id.singletons()):
+                self.rounds.num_lease_opportunities += 1
+
+        for job_id in self.rounds.current_assignments:
+            current = set(self.rounds.current_assignments[job_id])
+            if (job_id in self.rounds.next_assignments
+                    and job_id not in self.rounds.completed_in_round):
+                if current == set(self.rounds.next_assignments[job_id]):
+                    self.rounds.extended_leases.add(job_id)
+                    self.rounds.num_lease_extensions += 1
+                else:
+                    self.rounds.extended_leases.discard(job_id)
+            else:
+                self.rounds.extended_leases.discard(job_id)
+
+        for job_id, worker_ids in self.rounds.next_assignments.items():
+            if not any(m in self.acct.jobs for m in job_id.singletons()):
+                continue
+            if (job_id not in self.rounds.extended_leases
+                    or job_id in self.rounds.completed_in_round):
+                self._try_dispatch_job(job_id, worker_ids, next_round=True)
+
+        self._schedule_completion_events(round_end)
+
+    def _schedule_completion_events(self, round_end):
+        """Watchdogs: kill jobs that miss the round deadline; synthesize
+        completion for jobs with extended leases."""
+        now = self.get_current_timestamp()
+        for job_id in self.rounds.current_assignments:
+            if not any(m in self.acct.jobs for m in job_id.singletons()):
+                continue
+            if job_id in self.rounds.completed_in_round:
+                continue
+            delay = round_end - now
+            if job_id not in self.rounds.extended_leases:
+                delay += JOB_COMPLETION_BUFFER_TIME
+                action = self._kill_job
+            else:
+                action = self._done_callback_extended_lease
+            timer = threading.Timer(max(delay, 0.0), action, args=(job_id,))
+            timer.daemon = True
+            timer.start()
+            self._completion_events[job_id] = timer
+
+    def _end_round(self):
+        """Wait for all scheduled jobs to complete, then roll the round."""
+        jobs_to_complete = {
+            job_id for job_id in self.rounds.current_assignments
+            if any(m in self.acct.jobs for m in job_id.singletons())}
+        while not jobs_to_complete.issubset(self.rounds.completed_in_round):
+            self._cv.wait()
+
+        for job_id in list(self.rounds.extended_leases):
+            if job_id in self.acct.jobs:
+                for worker_id in self.rounds.current_assignments[job_id]:
+                    self._available_workers.put(worker_id)
+            self.rounds.extended_leases.discard(job_id)
+
+        if not self._is_final_round():
+            assert self.rounds.next_assignments is not None
+            for job_id, worker_ids in self.rounds.next_assignments.items():
+                if any(m in self.acct.jobs for m in job_id.singletons()):
+                    if job_id in self._redispatch_assignments:
+                        continue
+                    for worker_id in worker_ids:
+                        self._remove_available_worker(worker_id)
+            now = self.get_current_timestamp()
+            remaining = (self._current_round_start_time
+                         + self._time_per_iteration - now)
+            if remaining > 0:
+                self._cv.release()
+                try:
+                    time.sleep(remaining)
+                finally:
+                    self._cv.acquire()
+
+        self.rounds.num_completed_rounds += 1
+        self.rounds.completed_in_round = set()
+        self.rounds.current_assignments = self.rounds.next_assignments or (
+            collections.OrderedDict())
+        self.rounds.next_assignments = None
+        self._cv.notify_all()
+        logger.info("*** END ROUND %d ***", self.rounds.num_completed_rounds - 1)
+
+    def _kill_job(self, job_id: JobIdPair):
+        with self._cv:
+            if job_id not in self.rounds.current_assignments:
+                return
+            if job_id not in self._completion_events:
+                if (job_id in self.rounds.completed_in_round
+                        and job_id not in self.rounds.extended_leases):
+                    return
+            logger.warning("killing unresponsive job %s", job_id)
+            worker_ids = self.rounds.current_assignments[job_id]
+            servers = set()
+            for worker_id in worker_ids:
+                client = self._worker_connections[worker_id]
+                if (client.addr, client.port) not in servers:
+                    for m in job_id.singletons():
+                        client.kill_job(m.integer_job_id())
+                    servers.add((client.addr, client.port))
+            self._completion_events.pop(job_id, None)
+            prev_round = self.rounds.num_completed_rounds
+            self._cv.wait(timeout=30)
+            killed = (self.rounds.num_completed_rounds != prev_round
+                      or job_id in self.rounds.completed_in_round)
+            if killed:
+                return
+            all_ids = set(self.rounds.current_assignments[job_id])
+            reported = {u[0] for u in self._in_progress_updates.get(job_id, [])}
+            missing = all_ids - reported
+        zeros = [0 for _ in job_id.singletons()]
+        for worker_id in missing:
+            self.done_callback(job_id, worker_id, zeros, zeros)
+
+    def _done_callback_extended_lease(self, job_id: JobIdPair):
+        """Round-boundary completion for jobs running across rounds on an
+        extended lease (they never exit, so no worker Done arrives)."""
+        kill = False
+        with self._cv:
+            if not any(m in self.acct.jobs for m in job_id.singletons()):
+                return
+            job = self.acct.jobs[job_id.singletons()[0]]
+            num_updates = [len(self._lease_update_requests.get(m, []))
+                           for m in job_id.singletons()]
+            if min(num_updates) < job.scale_factor:
+                # No lease renewals arrived this round: job is unresponsive.
+                kill = True
+            elif job_id in self._completion_events:
+                self.rounds.completed_in_round.add(job_id)
+                del self._completion_events[job_id]
+                for m in job_id.singletons():
+                    self._lease_update_requests[m] = []
+                    self._max_steps_consensus[m] = None
+            if not kill:
+                self._cv.notify_all()
+        if kill:
+            self._kill_job(job_id)
+
+    # ------------------------------------------------------------------
+    # Main loop
+    # ------------------------------------------------------------------
+
+    def run(self):
+        """Drive the round mechanism until max_rounds (or forever)."""
+        with self._cv:
+            while not self.acct.jobs or (
+                    self._expected_num_workers is not None
+                    and len(self.workers.worker_ids) < self._expected_num_workers):
+                self._cv.wait()
+            if self._policy.name != "shockwave":
+                while self._need_to_update_allocation:
+                    self._cv.wait()
+            self.rounds.current_assignments = self._schedule_jobs_on_workers()
+            if self._shockwave_planner is not None:
+                self._shockwave_planner.increment_round()
+            for job_id, worker_ids in self.rounds.current_assignments.items():
+                self._try_dispatch_job(job_id, worker_ids)
+
+        while True:
+            final = self._is_final_round()
+            with self._cv:
+                self._begin_round()
+            time.sleep(self._time_per_iteration * SCHEDULE_RECOMPUTE_FRACTION)
+            with self._cv:
+                self._mid_round()
+                if self._shockwave_planner is not None:
+                    extended = copy.deepcopy(self.rounds.extended_leases)
+                self._end_round()
+                if self._shockwave_planner is not None:
+                    self._update_shockwave_planner_physical(extended)
+            if final or not self.acct.jobs and self._config.max_rounds is None:
+                if final or self._all_done():
+                    break
+        self._done_event.set()
+
+    def _all_done(self):
+        with self._lock:
+            return not self.acct.jobs
+
+    def _update_shockwave_planner_physical(self, extended_leases):
+        """Physical variant: account in-lease steps for extended leases
+        (reference: scheduler.py:2294-2331)."""
+        planner = self._shockwave_planner
+        scheduled = self._scheduled_jobs_in_prev_round or []
+        from ..core import constants
+        for int_id in scheduled:
+            job_id = JobIdPair(int_id)
+            if job_id in self._completed_jobs:
+                if int_id in planner.metadata:
+                    planner.mark_progress(int_id, planner.metadata[int_id].epochs)
+                continue
+            if job_id not in self.acct.jobs:
+                continue
+            steps = sum(self.acct.steps_run.get(job_id, {}).values())
+            if job_id in extended_leases:
+                steps += self._steps_run_in_current_lease.get(job_id, 0)
+            job = self.acct.jobs[job_id]
+            epoch = math.floor(
+                steps / constants.steps_per_epoch(job.model, job.batch_size))
+            planner.mark_progress(int_id, epoch)
+        active = {j.integer_job_id() for j in self.acct.jobs}
+        for int_id in active - set(scheduled):
+            planner.add_waiting_delay(int_id, self._time_per_iteration)
+        planner.increment_round()
+        self._rounds_since_reopt += 1
+        from .scheduler import REOPT_ROUNDS
+        if self._shockwave_job_completed or self._rounds_since_reopt >= REOPT_ROUNDS:
+            self._shockwave_job_completed = False
+            self._rounds_since_reopt = 0
+            planner.request_resolve()
+
+    def shutdown(self):
+        self._done_event.set()
+        for client in set(self._worker_connections.values()):
+            client.shutdown()
+        self._server.stop(grace=1)

@@ -52,6 +52,8 @@ class SchedulerConfig:
     max_rounds: Optional[int] = None
     # Shockwave planner hyperparameters (configs/*.json).
     shockwave: Optional[dict] = None
+    # Per-worker-type $/hour, for cost-normalized policies.
+    per_worker_type_prices: Optional[Dict[str, float]] = None
 
 
 class Scheduler:
@@ -64,6 +66,7 @@ class Scheduler:
                  config: Optional[SchedulerConfig] = None):
         self._policy = policy
         self._simulate = simulate
+        self._job_packing = "Packing" in policy.name
         self._config = config or SchedulerConfig()
         self._time_per_iteration = self._config.time_per_iteration
 
@@ -146,6 +149,8 @@ class Scheduler:
         self._throughputs[job_id] = {}
         for wt in self.workers.worker_types:
             self._set_initial_throughput(job_id, wt)
+        if self._job_packing:
+            self._populate_pair_throughputs(job_id)
 
         ts = timestamp if timestamp is not None else self.get_current_timestamp()
         a.start_timestamps[job_id] = ts
@@ -189,6 +194,11 @@ class Scheduler:
         del a.job_time[job_id]
         del self._throughputs[job_id]
         del a.failures[job_id]
+        if self._job_packing:
+            for merged in [k for k in self._throughputs
+                           if k.is_pair() and job_id.overlaps_with(k)]:
+                del self._throughputs[merged]
+                a.job_time.pop(merged, None)
         self._in_progress_updates.pop(job_id, None)
         self._steps_run_in_current_lease.pop(job_id, None)
         self.rounds.extended_leases.discard(job_id)
@@ -219,6 +229,9 @@ class Scheduler:
                 self.acct.steps_run[job_id][worker_type] = 0
                 self.acct.job_time[job_id][worker_type] = self._time_per_iteration / 2.0
                 self._set_initial_throughput(job_id, worker_type)
+                if self._job_packing:
+                    # Extend existing pair entries with the new worker type.
+                    self._populate_pair_throughputs(job_id)
                 self._add_to_priorities(job_id, worker_type)
         server_ids = []
         for _ in range(num_chips):
@@ -241,28 +254,70 @@ class Scheduler:
 
     def _set_initial_throughput(self, job_id: JobIdPair, worker_type: str):
         job = self.acct.jobs[job_id]
-        if self._oracle_throughputs is not None:
-            key = (job.job_type, job.scale_factor)
-            self._throughputs[job_id][worker_type] = (
-                self._oracle_throughputs[worker_type][key]["null"])
+        key = (job.job_type, job.scale_factor)
+        oracle = (self._oracle_throughputs or {}).get(worker_type)
+        if oracle is not None and key in oracle:
+            self._throughputs[job_id][worker_type] = oracle[key]["null"]
+        elif self._simulate and self._oracle_throughputs is not None:
+            # Simulation has no measured path to recover from a missing
+            # oracle entry; fail loudly rather than fabricate throughput.
+            raise KeyError(
+                f"no oracle throughput for {key} on {worker_type!r}")
         else:
+            # Unprofiled hardware (e.g. a TPU worker against a GPU-profiled
+            # oracle): start from the default and let the EMA learn it.
+            logger.warning("no profiled throughput for %s on %s; starting "
+                           "from default and learning online", key, worker_type)
             self._throughputs[job_id][worker_type] = DEFAULT_THROUGHPUT
 
+    def _populate_pair_throughputs(self, job_id: JobIdPair):
+        """Record co-located throughputs for every same-scale-factor partner
+        of `job_id` (packing policies only; reference: scheduler.py:3404-3483)."""
+        job = self.acct.jobs[job_id]
+        key = (job.job_type, job.scale_factor)
+        for other_id, other in list(self.acct.jobs.items()):
+            if other_id == job_id or other.scale_factor != job.scale_factor:
+                continue
+            other_key = (other.job_type, other.scale_factor)
+            merged = JobIdPair(job_id[0], other_id[0])
+            self._throughputs.setdefault(merged, {})
+            self.acct.job_time.setdefault(merged, {})
+            for wt in self.workers.worker_types:
+                self.acct.job_time[merged].setdefault(wt, 0.0)
+                oracle = (self._oracle_throughputs or {}).get(wt, {})
+                if key in oracle and other_key in oracle[key]:
+                    pair = oracle[key][other_key]
+                    # Throughputs stored in sorted-member order.
+                    ordered = pair if job_id[0] == merged[0] else pair[::-1]
+                    self._throughputs[merged][wt] = list(ordered)
+                else:
+                    self._throughputs[merged][wt] = [0.0, 0.0]
+
     def _update_throughput(self, job_id: JobIdPair, worker_type: str,
-                           num_steps: int, execution_time: float):
+                           all_num_steps: Sequence[int],
+                           all_execution_times: Sequence[float]):
         if job_id not in self._throughputs:
             return
-        int_id = job_id.integer_job_id()
-        timeline = self._throughput_timeline.setdefault(
-            int_id, collections.OrderedDict())
-        new_tput = 0.0 if execution_time <= 0 else num_steps / execution_time
-        timeline[self.rounds.num_completed_rounds] = (
-            new_tput, self.acct.jobs[job_id].batch_size)
-        if not self._simulate and execution_time > 0:
-            old = self._throughputs[job_id][worker_type]
-            if old != INFINITY:
-                new_tput = EMA_ALPHA * new_tput + (1 - EMA_ALPHA) * old
-            self._throughputs[job_id][worker_type] = new_tput
+        members = job_id.singletons()
+        for i, m in enumerate(members):
+            if m not in self.acct.jobs:
+                continue
+            timeline = self._throughput_timeline.setdefault(
+                m.integer_job_id(), collections.OrderedDict())
+            exec_time = all_execution_times[i]
+            tput = 0.0 if exec_time <= 0 else all_num_steps[i] / exec_time
+            timeline[self.rounds.num_completed_rounds] = (
+                tput, self.acct.jobs[m].batch_size)
+            if not self._simulate and exec_time > 0:
+                if job_id.is_pair():
+                    old = self._throughputs[job_id][worker_type][i]
+                    self._throughputs[job_id][worker_type][i] = (
+                        EMA_ALPHA * tput + (1 - EMA_ALPHA) * old)
+                else:
+                    old = self._throughputs[job_id][worker_type]
+                    if old != INFINITY:
+                        tput = EMA_ALPHA * tput + (1 - EMA_ALPHA) * old
+                    self._throughputs[job_id][worker_type] = tput
 
     # ------------------------------------------------------------------
     # Priorities / deficits (Gavel machinery)
@@ -272,6 +327,10 @@ class Scheduler:
         for wt in ([worker_type] if worker_type else self.workers.worker_types):
             self._priorities[wt][job_id] = 0.0
             self._deficits[wt][job_id] = 0.0
+            for other in self._throughputs:
+                if other.is_pair() and job_id.overlaps_with(other):
+                    self._priorities[wt][other] = 0.0
+                    self._deficits[wt][other] = 0.0
 
     def _remove_from_priorities(self, job_id: JobIdPair):
         for wt in self.workers.worker_types:
@@ -352,6 +411,7 @@ class Scheduler:
             "throughputs": copy.deepcopy(self._throughputs),
             "per_round_schedule": list(self.rounds.per_round_schedule),
             "cluster_spec": dict(self.workers.cluster_spec),
+            "instance_costs": self._config.per_worker_type_prices,
         }
 
     def _compute_allocation(self, state: Optional[dict] = None) -> dict:
@@ -379,6 +439,11 @@ class Scheduler:
         elif name.startswith("MinTotalDuration"):
             allocation = self._policy.get_allocation(
                 throughputs, sf, state["num_steps_remaining"], cluster)
+        elif name == "Proportional":
+            allocation = self._policy.get_allocation(throughputs, cluster)
+        elif name == "ThroughputNormalizedByCostSum_Perf":
+            allocation = self._policy.get_allocation(
+                throughputs, sf, cluster, state.get("instance_costs"))
         else:
             allocation = self._policy.get_allocation(throughputs, sf, cluster)
         return allocation or {}
@@ -397,13 +462,23 @@ class Scheduler:
             self._scheduled_jobs_in_prev_round = self._scheduled_jobs_in_current_round
             self._scheduled_jobs_in_current_round = job_ids
             scheduled = {wt: [] for wt in worker_types}
-            target = worker_types[0]
+            # The planner budgets against total chips; spread the selected
+            # jobs across worker types by remaining capacity.
+            capacity = {wt: self.workers.cluster_spec[wt] for wt in worker_types}
             for int_id in job_ids:
                 job_id = JobIdPair(int_id)
                 if job_id not in self.acct.jobs:
                     logger.warning("job %s in round schedule but completed", int_id)
                     continue
-                scheduled[target].append((job_id, self.acct.jobs[job_id].scale_factor))
+                sf = self.acct.jobs[job_id].scale_factor
+                for wt in worker_types:
+                    if capacity[wt] >= sf:
+                        scheduled[wt].append((job_id, sf))
+                        capacity[wt] -= sf
+                        break
+                else:
+                    logger.warning("no capacity for planned job %s (sf=%d)",
+                                   int_id, sf)
             return scheduled
 
         scheduled = {wt: [] for wt in worker_types}
@@ -620,16 +695,24 @@ class Scheduler:
         job.update_bs(new_bs)
 
         key = (job.job_type, job.scale_factor)
-        for wt in self.workers.worker_types:
-            if (self._oracle_throughputs is None
-                    or key not in self._oracle_throughputs[wt]):
-                logger.error("job %s requested unprofiled bs %s; reverting",
-                             job_id, key)
-                job.update_bs(old_bs)
-                flags["big_bs"] = flags["small_bs"] = False
-                return
-        for wt in self.workers.worker_types:
+        profiled_types = [
+            wt for wt in self.workers.worker_types
+            if key in (self._oracle_throughputs or {}).get(wt, {})]
+        # Simulation has no way to measure the new batch size on worker
+        # types the oracle missed, so require full coverage there; physical
+        # mode can learn unprofiled types online.
+        needed = (len(self.workers.worker_types) if self._simulate else 1)
+        if self._oracle_throughputs is not None and len(profiled_types) < needed:
+            logger.error("job %s requested unprofiled bs %s; reverting",
+                         job_id, key)
+            job.update_bs(old_bs)
+            flags["big_bs"] = flags["small_bs"] = False
+            return
+        for wt in profiled_types:
             self._throughputs[job_id][wt] = self._oracle_throughputs[wt][key]["null"]
+        if self._job_packing:
+            # Pair entries are keyed by job_type and are now stale.
+            self._populate_pair_throughputs(job_id)
 
         # Rescale the step budget so total *epochs* are preserved.
         spe_old = constants.steps_per_epoch(model, old_bs)
@@ -659,16 +742,21 @@ class Scheduler:
         """Handle completion of one worker's micro-task for a job round."""
         a = self.acct
         to_remove: List[JobIdPair] = []
-        a.run_time_per_worker[job_id].setdefault(worker_id, 0.0)
-        a.run_time_per_worker[job_id][worker_id] += float(np.max(all_execution_times))
+        # Pair keys (packing) accumulate run time on both members.
+        run_time = float(np.max(all_execution_times))
+        for m in job_id.singletons():
+            a.run_time_per_worker.setdefault(m, {}).setdefault(worker_id, 0.0)
+            a.run_time_per_worker[m][worker_id] += run_time
 
-        if job_id in a.jobs:
-            run_time_so_far = (sum(a.run_time_per_worker[job_id].values())
-                               / a.jobs[job_id].scale_factor)
-            is_over_deadline = run_time_so_far > int(
-                a.jobs[job_id].duration * DEADLINE_SLACK)
-        else:
-            is_over_deadline = True
+        def member_over_deadline(m: JobIdPair) -> bool:
+            if m not in a.jobs:
+                return True
+            run_time_so_far = (sum(a.run_time_per_worker[m].values())
+                               / a.jobs[m].scale_factor)
+            return run_time_so_far > int(a.jobs[m].duration * DEADLINE_SLACK)
+
+        over_deadline = {m: member_over_deadline(m)
+                         for m in job_id.singletons()}
 
         members = job_id.singletons()
         is_active = {m: m in a.jobs for m in members}
@@ -720,7 +808,7 @@ class Scheduler:
                     a.steps_run[m][worker_type] += steps
                     a.total_steps_run[m] += steps
                     self._steps_run_in_current_lease[m] = 0
-                    if self._get_remaining_steps(m) <= 0 or is_over_deadline:
+                    if self._get_remaining_steps(m) <= 0 or over_deadline[m]:
                         to_remove.append(m)
             max_time = max(agg_times)
             if job_id in a.job_time:
@@ -729,7 +817,7 @@ class Scheduler:
             for w in all_worker_ids:
                 self.workers.cumulative_time[w] += max_time
 
-        self._update_throughput(job_id, worker_type, agg_steps[0], agg_times[0])
+        self._update_throughput(job_id, worker_type, agg_steps, agg_times)
 
         for m in members:
             self._scale_bs_and_iters(m)
@@ -756,7 +844,7 @@ class Scheduler:
                 if int_id in planner.metadata:
                     planner.mark_progress(int_id, planner.metadata[int_id].epochs)
                 continue
-            steps = self.acct.steps_run.get(job_id, {}).get("v100", 0)
+            steps = self.acct.total_steps_run.get(job_id, 0)
             job = self.acct.jobs[job_id]
             epoch = math.floor(
                 steps / constants.steps_per_epoch(job.model, job.batch_size))
@@ -789,8 +877,6 @@ class Scheduler:
         running: List[tuple] = []  # heap of (-finish_time, job_id, worker_ids, steps)
         self._current_timestamp = arrival_times[0] if len(arrival_times) else 0.0
         current_round = 0
-        current_round_start = 0.0
-        current_round_end: Optional[float] = None
 
         while remaining_jobs > 0:
             next_arrival = queued[0][0] if queued else None
@@ -799,9 +885,6 @@ class Scheduler:
             max_ts = 0.0
             if running and -running[0][0] > max_ts:
                 max_ts = -running[0][0]
-                if current_round_end is not None:
-                    current_round_start = current_round_end
-                current_round_end = max_ts
             if max_ts > 0:
                 self._current_timestamp = max_ts
             elif next_arrival is not None:
@@ -812,12 +895,15 @@ class Scheduler:
 
             # Drain jobs finishing this round.
             while running:
-                neg_finish, job_id, worker_ids, all_num_steps = running[0]
+                neg_finish, job_id, worker_ids, all_num_steps, dispatch_time = running[0]
                 finish_time = -neg_finish
                 if finish_time > self._current_timestamp:
                     break
                 slowdown = 1.0
-                execution_time = finish_time - current_round_start
+                # Time actually spent this round; using the dispatch timestamp
+                # (not the previous round's end) keeps idle cluster gaps and a
+                # nonzero first arrival from inflating the measurement.
+                execution_time = finish_time - dispatch_time
                 if current_round >= 2:
                     prev_sched = self.rounds.per_round_schedule[current_round - 2]
                     for m in job_id.singletons():
@@ -893,8 +979,9 @@ class Scheduler:
                 worker_type = self.workers.id_to_type[worker_ids[0]]
                 all_num_steps, finish_time = self._steps_and_finish_time(
                     job_id, worker_type)
-                heapq.heappush(running,
-                               (-finish_time, job_id, worker_ids, all_num_steps))
+                heapq.heappush(
+                    running, (-finish_time, job_id, worker_ids, all_num_steps,
+                              self._current_timestamp))
 
             current_round += 1
             self.rounds.num_completed_rounds += 1
@@ -923,12 +1010,11 @@ class Scheduler:
         return all_num_steps, max_finish
 
     def _oracle_step_throughput(self, job_id, worker_type, member):
+        # Both pair and single entries are kept in sync with the oracle (and
+        # refreshed on batch-size rescale), so read the scheduler's view.
         if job_id.is_pair():
             idx = job_id.as_tuple().index(member[0])
-            job_types = [
-                (self.acct.jobs[m].job_type, self.acct.jobs[m].scale_factor)
-                for m in job_id.singletons()]
-            return self._oracle_throughputs[worker_type][job_types[0]][job_types[1]][idx]
+            return self._throughputs[job_id][worker_type][idx]
         return self._throughputs[job_id][worker_type]
 
     # ------------------------------------------------------------------
@@ -1005,3 +1091,8 @@ class Scheduler:
 
     def get_makespan(self) -> float:
         return self._current_timestamp
+
+    def get_throughput_timeline(self):
+        """Per-job {round: (throughput, batch_size)} measurement history."""
+        return {job_id: dict(tl)
+                for job_id, tl in self._throughput_timeline.items()}

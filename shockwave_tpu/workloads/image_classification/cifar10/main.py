@@ -1,0 +1,51 @@
+#!/usr/bin/env python3
+"""ResNet-18 / CIFAR-10 workload (trace: "ResNet-18 (batch size N)").
+
+CLI parity with the reference's cifar10 main.py — the trace command is
+`python3 main.py --data_dir=%s/cifar10 --batch_size N` with `--num_steps`
+appended by the dispatcher.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), *[".."] * 4))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from shockwave_tpu.models import data
+from shockwave_tpu.models.resnet import ResNet18
+from shockwave_tpu.models.train_common import Trainer, common_parser
+
+
+def main():
+    p = common_parser("ResNet-18 on CIFAR-10", steps_args=("--num_steps",))
+    p.add_argument("--data_dir", default=None)
+    p.add_argument("--batch_size", type=int, default=128)
+    args = p.parse_args()
+
+    model = ResNet18()
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    variables = model.init(rng, sample, train=True)
+    init_state = {"params": variables["params"],
+                  "batch_stats": variables["batch_stats"]}
+
+    def loss_fn(params, state, images, labels):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": state["batch_stats"]},
+            images, train=True, mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        return loss, {"batch_stats": mutated["batch_stats"]}
+
+    trainer = Trainer(
+        args, loss_fn, init_state,
+        data.cifar10(args.batch_size),
+        initial_bs=args.batch_size, max_bs=256, learning_rate=0.1)
+    trainer.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""ResNet-50 / ImageNet workload (trace: "ResNet-50 (batch size N)").
+
+CLI parity with the reference's imagenet main.py — the trace command is
+`python3 main.py -j 4 -a resnet50 -b N %s/imagenet/` with
+`--num_minibatches` appended by the dispatcher.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), *[".."] * 4))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from shockwave_tpu.models import data
+from shockwave_tpu.models.resnet import ResNet50
+from shockwave_tpu.models.train_common import Trainer, common_parser
+
+
+def main():
+    p = common_parser("ResNet-50 on ImageNet", steps_args=("--num_minibatches",))
+    p.add_argument("data", nargs="?", default=None)
+    p.add_argument("-j", "--workers", type=int, default=4)
+    p.add_argument("-a", "--arch", default="resnet50")
+    p.add_argument("-b", "--batch_size", type=int, default=64)
+    args = p.parse_args()
+
+    model = ResNet50()
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = model.init(rng, sample, train=True)
+    init_state = {"params": variables["params"],
+                  "batch_stats": variables["batch_stats"]}
+
+    def loss_fn(params, state, images, labels):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": state["batch_stats"]},
+            images, train=True, mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        return loss, {"batch_stats": mutated["batch_stats"]}
+
+    trainer = Trainer(
+        args, loss_fn, init_state,
+        data.imagenet(args.batch_size),
+        initial_bs=args.batch_size, max_bs=128, learning_rate=0.1)
+    trainer.run()
+
+
+if __name__ == "__main__":
+    main()

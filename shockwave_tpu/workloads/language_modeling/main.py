@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""LSTM LM / Wikitext-2 workload (trace: "LM (batch size N)").
+
+CLI parity with the reference's language_modeling main.py — the trace
+command is `python3 main.py --cuda --data %s/wikitext2 --batch_size N`
+with `--steps` appended by the dispatcher (`--cuda` accepted, ignored).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), *[".."] * 3))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from shockwave_tpu.models import data
+from shockwave_tpu.models.lm import LSTMLanguageModel
+from shockwave_tpu.models.train_common import Trainer, common_parser
+
+
+def main():
+    p = common_parser("LSTM LM on Wikitext-2", steps_args=("--steps",))
+    p.add_argument("--data", default=None)
+    p.add_argument("--batch_size", type=int, default=20)
+    args = p.parse_args()
+
+    model = LSTMLanguageModel()
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 35), jnp.int32)
+    variables = model.init(rng, sample)
+    init_state = {"params": variables["params"]}
+
+    def loss_fn(params, state, tokens, targets):
+        logits = model.apply({"params": params}, tokens)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+        return loss, {}
+
+    trainer = Trainer(
+        args, loss_fn, init_state,
+        data.wikitext2(args.batch_size),
+        initial_bs=args.batch_size, max_bs=80, learning_rate=1.0)
+    trainer.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Autoencoder recommender / ML-20M workload
+(trace: "Recommendation (batch size N)").
+
+CLI parity with the reference's recommendation train.py — the trace
+command is `python3 train.py --data_dir %s/ml-20m/pro_sg/ --batch_size N`
+with `-n` (steps) appended by the dispatcher.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), *[".."] * 3))
+
+import jax
+
+from shockwave_tpu.models import data
+from shockwave_tpu.models.recommendation import AutoEncoder, multinomial_nll
+from shockwave_tpu.models.train_common import Trainer, common_parser
+
+
+def main():
+    p = common_parser("AutoEncoder on ML-20M", steps_args=("-n", "--num_steps"))
+    p.add_argument("--data_dir", default=None)
+    p.add_argument("--batch_size", type=int, default=2048)
+    args = p.parse_args()
+
+    model = AutoEncoder()
+    rng = jax.random.PRNGKey(0)
+    import jax.numpy as jnp
+    sample = jnp.zeros((1, model.num_items), jnp.float32)
+    variables = model.init(rng, sample)
+    init_state = {"params": variables["params"]}
+
+    def loss_fn(params, state, interactions):
+        logits = model.apply({"params": params}, interactions)
+        return multinomial_nll(logits, interactions), {}
+
+    trainer = Trainer(
+        args, loss_fn, init_state,
+        data.ml20m(args.batch_size),
+        initial_bs=args.batch_size, max_bs=8192, learning_rate=1e-3)
+    trainer.run()
+
+
+if __name__ == "__main__":
+    main()

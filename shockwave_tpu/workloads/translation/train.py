@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""Transformer / Multi30k translation workload
+(trace: "Transformer (batch size N)").
+
+CLI parity with the reference's translation train.py — the trace command
+is `python3 train.py -data %s/... -batch_size N -proj_share_weight` with
+`-step` appended by the dispatcher.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), *[".."] * 3))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from shockwave_tpu.models import data
+from shockwave_tpu.models.train_common import Trainer, common_parser
+from shockwave_tpu.models.transformer import Seq2SeqTransformer
+
+
+def main():
+    p = common_parser("Transformer on Multi30k", steps_args=("-step", "--step"))
+    p.add_argument("-data", dest="data", default=None)
+    p.add_argument("-batch_size", dest="batch_size", type=int, default=64)
+    p.add_argument("-proj_share_weight", action="store_true")
+    args = p.parse_args()
+
+    model = Seq2SeqTransformer()
+    rng = jax.random.PRNGKey(0)
+    src = jnp.zeros((1, 32), jnp.int32)
+    variables = model.init(rng, src, src)
+    init_state = {"params": variables["params"]}
+
+    def loss_fn(params, state, src_tokens, tgt_tokens):
+        logits = model.apply({"params": params}, src_tokens, tgt_tokens[:, :-1])
+        targets = tgt_tokens[:, 1:]
+        mask = (targets != 0).astype(jnp.float32)
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        loss = (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return loss, {}
+
+    trainer = Trainer(
+        args, loss_fn, init_state,
+        data.multi30k(args.batch_size, tgt_len=33),
+        initial_bs=args.batch_size, max_bs=128, learning_rate=1e-3)
+    trainer.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -10,11 +10,17 @@ import os
 import sys
 import types
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU with 8 virtual devices regardless of ambient accelerator env.
+# The environment's sitecustomize may import jax and pin the platform list
+# before we run, so the config update (not just the env var) is required.
+os.environ["JAX_PLATFORMS"] = "cpu"
 if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     )
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -1,0 +1,176 @@
+"""Workload layer tests: Trainer scaffold, adaptation monitors, checkpoint
+round-trips, and (slow) full workload entry-point smokes."""
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shockwave_tpu.models.train_common import (AccordionMonitor, GNSMonitor,
+                                               Trainer, load_checkpoint,
+                                               save_checkpoint)
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+WORKLOADS = os.path.join(REPO, "shockwave_tpu", "workloads")
+
+
+class FakeArgs:
+    num_steps = 12
+    local_rank = 0
+    checkpoint_dir = None
+    enable_lease_iterator = False
+    throughput_estimation_interval = 100
+    coordinator = None
+    num_processes = None
+    process_id = None
+    synthetic_data = True
+
+
+class TinyData:
+    def __init__(self, n=4):
+        rng = np.random.RandomState(0)
+        self._batches = [(rng.rand(8, 4).astype(np.float32),
+                          rng.rand(8, 1).astype(np.float32))
+                         for _ in range(n)]
+
+    def __len__(self):
+        return len(self._batches)
+
+    def __iter__(self):
+        return iter(self._batches)
+
+
+def tiny_trainer(tmp_path, mode="static", num_steps=12):
+    args = FakeArgs()
+    args.checkpoint_dir = str(tmp_path)
+    args.num_steps = num_steps
+    params = {"w": jnp.zeros((4, 1))}
+
+    def loss_fn(p, state, x, y):
+        return jnp.mean((x @ p["w"] - y) ** 2), {}
+
+    return Trainer(args, loss_fn, {"params": params}, TinyData(), mode=mode,
+                   initial_bs=8, max_bs=32, learning_rate=0.1)
+
+
+class TestTrainer:
+    def test_runs_and_checkpoints(self, tmp_path):
+        trainer = tiny_trainer(tmp_path)
+        steps = trainer.run()
+        assert steps == 12
+        assert int(trainer.state["step"]) == 12
+        # Resume from checkpoint: a fresh trainer continues at step 12.
+        trainer2 = tiny_trainer(tmp_path, num_steps=16)
+        steps2 = trainer2.run()
+        assert steps2 == 4
+        assert int(trainer2.state["step"]) == 16
+
+    def test_loss_decreases(self, tmp_path):
+        trainer = tiny_trainer(tmp_path, num_steps=30)
+        state0 = trainer.state
+        x, y = next(iter(TinyData()))
+        loss_before = float(jnp.mean((x @ np.asarray(state0["params"]["w"]) - y) ** 2))
+        trainer.run()
+        w = np.asarray(trainer.state["params"]["w"])
+        loss_after = float(jnp.mean((x @ w - y) ** 2))
+        assert loss_after < loss_before
+
+    def test_gns_mode_tracks_small_norms(self, tmp_path):
+        trainer = tiny_trainer(tmp_path, mode="gns")
+        state, metrics = trainer.train_step(trainer.state, *next(iter(TinyData())))
+        assert "grad_norm_sq_small" in metrics
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        state = {"params": {"w": jnp.arange(4.0)}, "step": jnp.int32(7)}
+        path = str(tmp_path / "ckpt" / "model.ckpt")
+        save_checkpoint(path, state)
+        restored = load_checkpoint(path, jax.device_get(state))
+        assert int(restored["step"]) == 7
+        np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                      np.arange(4.0))
+
+    def test_missing_returns_none(self, tmp_path):
+        assert load_checkpoint(str(tmp_path / "nope.ckpt"), {}) is None
+
+
+class _RecordingIterator:
+    def __init__(self):
+        self.requests = []
+
+    def update_resource_requirement(self, big_bs, small_bs):
+        self.requests.append((big_bs, small_bs))
+
+
+class TestAdaptationMonitors:
+    def test_accordion_requests_big_when_stable(self):
+        it = _RecordingIterator()
+        mon = AccordionMonitor(it, launch_bs=32, max_bs=256, threshold=0.5)
+        for _ in range(10):
+            mon.observe_step(1.0)
+        assert not mon.end_epoch()  # first epoch: no baseline yet
+        for _ in range(10):
+            mon.observe_step(1.01)  # stable gradient -> out of critical regime
+        assert mon.end_epoch()
+        assert it.requests == [(True, False)]
+
+    def test_accordion_requests_small_when_critical(self):
+        it = _RecordingIterator()
+        mon = AccordionMonitor(it, launch_bs=256, max_bs=256, threshold=0.5)
+        for _ in range(10):
+            mon.observe_step(1.0)
+        mon.end_epoch()
+        for _ in range(10):
+            mon.observe_step(5.0)  # gradient swinging -> critical regime
+        assert mon.end_epoch()
+        assert it.requests == [(False, True)]
+
+    def test_gns_requests_double_when_noise_dominates(self):
+        it = _RecordingIterator()
+        mon = GNSMonitor(it, small_bs=4, big_bs=32, max_bs=256, window=5)
+        # E|G_b|^2 = |G|^2 + S/b with |G|^2=1, S=400:
+        # small(4) -> 101, big(32) -> 13.5; noise scale 400 >> bs 32.
+        for _ in range(5):
+            mon.observe_step(small_norm_sq=101.0, big_norm_sq=13.5)
+        assert mon.maybe_request_double(current_bs=32)
+        assert it.requests == [(True, False)]
+
+    def test_gns_quiet_when_gradient_dominates(self):
+        it = _RecordingIterator()
+        mon = GNSMonitor(it, small_bs=4, big_bs=32, max_bs=256, window=5)
+        # |G|^2=1, S=4: small(4) -> 2.0, big(32) -> 1.125; noise 4 < bs 32.
+        for _ in range(5):
+            mon.observe_step(small_norm_sq=2.0, big_norm_sq=1.125)
+        assert not mon.maybe_request_double(current_bs=32)
+        assert it.requests == []
+
+
+@pytest.mark.slow
+class TestWorkloadEntrypoints:
+    ENTRIES = [
+        ("image_classification/cifar10/main.py",
+         ["--batch_size", "32", "--num_steps", "3"]),
+        ("image_classification/imagenet/main.py",
+         ["-b", "16", "x", "--num_minibatches", "2"]),
+        ("translation/train.py",
+         ["-data", "x", "-batch_size", "16", "-proj_share_weight", "-step", "2"]),
+        ("language_modeling/main.py",
+         ["--cuda", "--batch_size", "10", "--steps", "3"]),
+        ("recommendation/train.py",
+         ["--data_dir", "x", "--batch_size", "512", "-n", "2"]),
+    ]
+
+    @pytest.mark.parametrize("script,args", ENTRIES,
+                             ids=[e[0].split("/")[-2] for e in ENTRIES])
+    def test_entry_runs(self, script, args, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, os.path.join(WORKLOADS, script), *args,
+             "--checkpoint_dir", str(tmp_path)],
+            capture_output=True, text=True, timeout=900, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "TRAINED" in out.stdout
