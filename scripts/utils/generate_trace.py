@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Generate a synthetic job trace (TSV, 12 fields per line).
+
+Equivalent of the reference's scripts/utils/generate_trace.py, driving
+shockwave_tpu.core.generator. Example:
+
+    python scripts/utils/generate_trace.py --num_jobs 120 --lam 0.2 \
+        --throughputs_file data/tacc_throughputs.json \
+        --scale_factor_mix 0.6 0.3 0.09 0.01 --mode_mix 0 0.5 0.5 \
+        --output_file /tmp/trace.trace
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from shockwave_tpu.core.generator import generate_trace
+from shockwave_tpu.core.oracle import read_throughputs
+from shockwave_tpu.core.trace import job_to_trace_line
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num_jobs", type=int, required=True)
+    p.add_argument("-l", "--lam", type=float, default=0.0,
+                   help="Mean Poisson interarrival time in seconds")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--throughputs_file", type=str, required=True)
+    p.add_argument("-a", "--min_duration", type=float, default=0.2,
+                   help="Minimum job duration in hours")
+    p.add_argument("-b", "--max_duration", type=float, default=5.0,
+                   help="Maximum job duration in hours")
+    p.add_argument("-n", "--num_durations", type=int, default=100)
+    p.add_argument("--duration_logspace", action="store_true", default=True)
+    p.add_argument("--duration_linspace", dest="duration_logspace",
+                   action="store_false")
+    p.add_argument("--generate_multi_gpu_jobs", action="store_true",
+                   default=True)
+    p.add_argument("--generate_dynamic_jobs", action="store_true",
+                   default=True)
+    p.add_argument("--scale_factor_mix", type=float, nargs=4, default=None,
+                   help="P(scale factor = 1, 2, 4, 8)")
+    p.add_argument("--mode_mix", type=float, nargs=3,
+                   default=(0.34, 0.33, 0.33),
+                   help="P(static, accordion, gns)")
+    p.add_argument("--output_file", type=str, required=True)
+    args = p.parse_args()
+
+    throughputs = read_throughputs(args.throughputs_file)
+    jobs, arrivals = generate_trace(
+        num_jobs=args.num_jobs,
+        throughputs=throughputs,
+        lam=args.lam,
+        seed=args.seed,
+        generate_multi_gpu_jobs=args.generate_multi_gpu_jobs,
+        generate_dynamic_jobs=args.generate_dynamic_jobs,
+        scale_factor_mix=args.scale_factor_mix,
+        mode_mix=args.mode_mix,
+        min_duration_hours=args.min_duration,
+        max_duration_hours=args.max_duration,
+        num_durations=args.num_durations,
+        logspace=args.duration_logspace,
+    )
+    with open(args.output_file, "w") as f:
+        for job, arrival in zip(jobs, arrivals):
+            f.write(job_to_trace_line(job, arrival) + "\n")
+    by_mode, by_sf = {}, {}
+    for job in jobs:
+        by_mode[job.mode] = by_mode.get(job.mode, 0) + 1
+        by_sf[job.scale_factor] = by_sf.get(job.scale_factor, 0) + 1
+    print(f"Wrote {len(jobs)} jobs to {args.output_file}")
+    print(f"  modes: {sorted(by_mode.items())}")
+    print(f"  scale factors: {sorted(by_sf.items())}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,7 @@
 from .job import Job, JobIdPair
 from .trace import parse_trace, job_to_trace_line
 from .oracle import read_throughputs, parse_job_type_tuple
+from .throughput_estimator import ThroughputEstimator, als_complete
 from .constants import DATASET_SIZES, MODEL_DATASET, MAX_BS, steps_per_epoch, num_epochs_for
 
 __all__ = [
@@ -10,6 +11,8 @@ __all__ = [
     "job_to_trace_line",
     "read_throughputs",
     "parse_job_type_tuple",
+    "ThroughputEstimator",
+    "als_complete",
     "DATASET_SIZES",
     "MODEL_DATASET",
     "MAX_BS",

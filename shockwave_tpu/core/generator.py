@@ -1,0 +1,257 @@
+"""Synthetic job / trace generation.
+
+Samples jobs from the template table with the Philly-derived scale-factor
+and duration distributions the reference uses (reference:
+scheduler/utils.py:96-275, scripts/utils/generate_trace.py:350-433), plus
+Poisson interarrival times. Pure host-side code; nothing here touches JAX.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .constants import steps_per_epoch
+from .job import Job
+from .job_table import JOB_TABLE
+
+# Shockwave's duration mixture: mostly short jobs with a heavy tail
+# (reference: generate_trace.py:371-403 "70% small, 20% medium, 10% large").
+DURATION_PROBS = (0.72, 0.2, 0.05, 0.03)
+DURATION_BOUNDARIES = (0.2, 0.5, 0.9, 1.0)
+
+
+def philly_scale_factor(rng: random.Random,
+                        mix: Optional[Sequence[float]] = None) -> int:
+    """Scale factor from the Philly distribution: 70% x1, 10% x2, 15% x4,
+    5% x8 by default, or an explicit 4-way mix (reference: utils.py:96-106,
+    generate_trace.py:406-418)."""
+    r = rng.uniform(0, 1)
+    if mix is not None:
+        assert abs(sum(mix) - 1.0) <= 1e-3
+        bounds = np.cumsum(mix)
+        for sf, b in zip((1, 2, 4, 8), bounds):
+            if r <= b:
+                return sf
+        return 8
+    if 0.7 <= r <= 0.8:
+        return 2
+    if 0.8 <= r <= 0.95:
+        return 4
+    if r >= 0.95:
+        return 8
+    return 1
+
+
+def philly_duration(rng: random.Random) -> float:
+    """Duration in seconds from the Philly log-uniform mixture
+    (reference: utils.py:109-115)."""
+    if rng.random() >= 0.8:
+        return 60 * (10 ** rng.uniform(3, 4))
+    return 60 * (10 ** rng.uniform(1.5, 3))
+
+
+def duration_space(min_hours: float, max_hours: float, num: int,
+                   base: float = 1.5, logspace: bool = True) -> np.ndarray:
+    """Candidate duration grid in hours (reference:
+    generate_trace.py:421-433)."""
+    if not logspace:
+        return np.linspace(min_hours, max_hours, num)
+    powers = base ** np.linspace(1, num, num - 1)
+    powers = np.insert(powers, 0, 0.0)
+    powers = powers / powers.max()
+    return np.round(powers * (max_hours - min_hours) + min_hours, 2)
+
+
+def sample_duration(durations: np.ndarray, rng: random.Random,
+                    np_rng: Optional[np.random.RandomState] = None) -> int:
+    """Tiered duration sampling: pick a size class by DURATION_PROBS, then
+    uniformly within that class's slice of the sorted duration grid
+    (reference: generate_trace.py:371-403)."""
+    n = len(durations)
+    cuts = [round(n * b) for b in DURATION_BOUNDARIES]
+    r = rng.uniform(0, 1)
+    if r < DURATION_PROBS[0]:
+        pool = durations[:cuts[0]]
+    elif r < sum(DURATION_PROBS[:2]):
+        pool = durations[cuts[0]:cuts[1]]
+    elif r < sum(DURATION_PROBS[:3]):
+        pool = durations[cuts[1]:cuts[2]]
+    else:
+        pool = durations[cuts[2]:]
+    if len(pool) == 0:
+        pool = durations
+    choice = (np_rng.choice(pool) if np_rng is not None
+              else rng.choice(list(pool)))
+    return round(3600 * float(choice))
+
+
+def sample_mode(rng: random.Random, mix: Sequence[float]) -> str:
+    """static/accordion/gns with the given 3-way mix (reference:
+    generate_trace.py:358-368)."""
+    assert abs(sum(mix) - 1.0) <= 1e-3
+    r = rng.uniform(0, 1)
+    if r < mix[0]:
+        return "static"
+    if r < mix[0] + mix[1]:
+        return "accordion"
+    return "gns"
+
+
+def poisson_interarrival(rng: random.Random, lam: float) -> float:
+    """Exponential interarrival with mean `lam` seconds (reference:
+    generate_trace.py:350-351 — note the reference treats lam as the MEAN,
+    not the rate)."""
+    return -math.log(1.0 - rng.random()) * lam
+
+
+def generate_job(
+    throughputs: dict,
+    reference_worker_type: str = "v100",
+    rng: Optional[random.Random] = None,
+    job_id=None,
+    fixed_job_duration: Optional[float] = None,
+    generate_multi_gpu_jobs: bool = False,
+    generate_multi_priority_jobs: bool = False,
+    generate_dynamic_jobs: bool = False,
+    run_dir: Optional[str] = None,
+    scale_factor_mix: Optional[Sequence[float]] = None,
+    mode_mix: Sequence[float] = (1.0, 0.0, 0.0),
+    single_mode: Optional[str] = None,
+    duration_generator: Optional[Callable[[random.Random], float]] = None,
+    scale_factor_rng: Optional[random.Random] = None,
+    duration_rng: Optional[random.Random] = None,
+    mode_rng: Optional[random.Random] = None,
+    slo_rng: Optional[random.Random] = None,
+    min_epochs: int = 0,
+) -> Job:
+    """Sample one job: template, scale factor, duration, mode, priority, SLO.
+
+    Steps are derived from the duration via the oracle's isolated
+    throughput for (job_type, scale_factor) on the reference worker type
+    (reference: utils.py:118-275).
+    """
+    rng = rng or random.Random()
+    scale_factor_rng = scale_factor_rng or rng
+    duration_rng = duration_rng or rng
+    mode_rng = mode_rng or rng
+
+    while True:
+        template = rng.choice(JOB_TABLE)
+        if generate_multi_gpu_jobs and template.distributed:
+            scale_factor = philly_scale_factor(scale_factor_rng,
+                                               scale_factor_mix)
+        else:
+            scale_factor = 1
+
+        if fixed_job_duration:
+            run_time = fixed_job_duration
+        elif duration_generator is not None:
+            run_time = duration_generator(duration_rng)
+        else:
+            run_time = philly_duration(duration_rng)
+
+        if single_mode is not None:
+            mode = single_mode
+        elif generate_dynamic_jobs:
+            mode = sample_mode(mode_rng, mode_mix)
+        else:
+            mode = "static"
+        # Short accordion jobs shrink into degenerate ones; pin them static
+        # (reference: utils.py:211-213).
+        if run_time < 1000 and mode == "accordion":
+            mode = "static"
+
+        assert run_time > 0 and 1 <= scale_factor <= 8
+        key = (template.model, scale_factor)
+        oracle = throughputs[reference_worker_type].get(key)
+        if oracle is None or oracle["null"] <= 0:
+            continue  # no profile for this (type, scale) on the anchor type
+        num_steps = int(run_time * oracle["null"])
+        if num_steps <= 0:
+            continue
+        job = Job(
+            job_id=job_id,
+            job_type=template.model,
+            command=(template.command % ((run_dir, run_dir)
+                                         if template.command.count("%s") == 2
+                                         else run_dir)
+                     if run_dir is not None else template.command),
+            working_directory=template.working_directory,
+            num_steps_arg=template.num_steps_arg,
+            total_steps=num_steps,
+            duration=run_time,
+            scale_factor=scale_factor,
+            mode=mode,
+            needs_data_dir=template.needs_data_dir,
+        )
+        if min_epochs:
+            epochs = math.ceil(
+                num_steps / steps_per_epoch(job.model, job.batch_size))
+            if epochs < min_epochs:
+                continue
+        break
+
+    if generate_multi_priority_jobs and rng.uniform(0, 1) <= 0.2:
+        job.priority_weight = 5.0
+    if slo_rng is not None:
+        r = slo_rng.uniform(0, 1)
+        job.SLO = 1.2 if r < 0.33 else (2.0 if r < 0.67 else 10.0)
+    return job
+
+
+def generate_trace(
+    num_jobs: int,
+    throughputs: dict,
+    lam: float = 0.0,
+    seed: int = 0,
+    generate_multi_gpu_jobs: bool = True,
+    generate_dynamic_jobs: bool = True,
+    scale_factor_mix: Optional[Sequence[float]] = None,
+    mode_mix: Sequence[float] = (0.34, 0.33, 0.33),
+    min_duration_hours: float = 0.2,
+    max_duration_hours: float = 5.0,
+    num_durations: int = 100,
+    logspace: bool = True,
+    reference_worker_type: str = "v100",
+) -> Tuple[List[Job], List[float]]:
+    """Generate a full trace: jobs + arrival times. Seeded RNG streams per
+    dimension so changing one knob doesn't reshuffle the others
+    (reference: generate_trace.py:434-452)."""
+    job_rng = random.Random(seed)
+    arrival_rng = random.Random(seed + 1)
+    duration_rng = random.Random(seed + 2)
+    sf_rng = random.Random(seed + 3)
+    mode_rng = random.Random(seed + 4)
+    np_rng = np.random.RandomState(seed)
+
+    durations = duration_space(min_duration_hours, max_duration_hours,
+                               num_durations, logspace=logspace)
+    jobs: List[Job] = []
+    arrivals: List[float] = []
+    t = 0.0
+    for i in range(num_jobs):
+        job = generate_job(
+            throughputs,
+            reference_worker_type=reference_worker_type,
+            rng=job_rng,
+            generate_multi_gpu_jobs=generate_multi_gpu_jobs,
+            generate_dynamic_jobs=generate_dynamic_jobs,
+            scale_factor_mix=scale_factor_mix,
+            mode_mix=mode_mix,
+            duration_generator=lambda r: sample_duration(durations, r, np_rng),
+            scale_factor_rng=sf_rng,
+            duration_rng=duration_rng,
+            mode_rng=mode_rng,
+        )
+        jobs.append(job)
+        arrivals.append(t if i > 0 else 0.0)
+        t += poisson_interarrival(arrival_rng, lam) if lam > 0 else 0.0
+    return jobs, arrivals
+
+
+__all__ = ["generate_job", "generate_trace", "philly_scale_factor",
+           "philly_duration", "sample_mode", "sample_duration",
+           "duration_space", "poisson_interarrival"]

@@ -1,0 +1,193 @@
+"""Throughput estimation for space-sharing (packing) decisions.
+
+When a new job arrives, the scheduler has no packed-throughput profile for
+it. The estimator profiles the job against a random subset of *reference*
+job types, fills in the unmeasured entries by low-rank matrix completion,
+and matches the job to the nearest reference job type by cosine distance
+(reference: scheduler/throughput_estimator.py:17-204). The packed
+throughputs of the matched reference type are then used as the new job's
+estimates.
+
+The matrix-completion step replaces the reference's external
+`matrix_completion.pmf_solve` dependency with an in-repo regularized ALS
+solver (`als_complete`) — fully vectorized numpy; the matrices involved
+are tiny (num_reference_types x num_reference_types*num_worker_types), so
+this runs in microseconds on the scheduler host.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+MATRIX_COMPLETION_RANK = 10
+MATRIX_COMPLETION_MU = 1e-2
+
+
+def als_complete(A: np.ndarray, mask: np.ndarray, k: int = MATRIX_COMPLETION_RANK,
+                 mu: float = MATRIX_COMPLETION_MU, max_iterations: int = 100,
+                 epsilon: float = 1e-6, seed: int = 0) -> np.ndarray:
+    """Low-rank completion of `A` where `mask==0`, via alternating least
+    squares on the regularized PMF objective
+
+        min_{U,V} ||mask * (A - U V^T)||_F^2 + mu (||U||^2 + ||V||^2).
+
+    Returns the dense reconstruction U V^T.
+    """
+    n, m = A.shape
+    k = min(k, n, m)
+    rng = np.random.RandomState(seed)
+    U = rng.randn(n, k) * 0.1
+    V = rng.randn(m, k) * 0.1
+    eye = mu * np.eye(k)
+    prev = np.inf
+    for _ in range(max_iterations):
+        # Solve each row of U against the masked columns it observes.
+        for i in range(n):
+            w = mask[i] > 0
+            if not w.any():
+                continue
+            Vw = V[w]
+            U[i] = np.linalg.solve(Vw.T @ Vw + eye, Vw.T @ A[i, w])
+        for j in range(m):
+            w = mask[:, j] > 0
+            if not w.any():
+                continue
+            Uw = U[w]
+            V[j] = np.linalg.solve(Uw.T @ Uw + eye, Uw.T @ A[w, j])
+        recon = U @ V.T
+        err = float(np.linalg.norm(mask * (A - recon)))
+        if abs(prev - err) < epsilon:
+            break
+        prev = err
+    return U @ V.T
+
+
+def cosine_distance(a: np.ndarray, b: np.ndarray) -> float:
+    denom = np.linalg.norm(a) * np.linalg.norm(b)
+    if denom == 0:
+        return 2.0  # maximal distance for degenerate (all-zero) profiles
+    return 1.0 - float(np.dot(a, b) / denom)
+
+
+class ThroughputEstimator:
+    """Match an unprofiled job to the nearest offline-profiled reference
+    job type (reference: throughput_estimator.py:17-38).
+
+    `oracle_throughputs` uses the parsed oracle format of
+    `core.oracle.read_throughputs`: oracle[worker_type][job_type] is a dict
+    with key "null" -> isolated steps/s and other job-type keys ->
+    [tput_self, tput_other] packed throughputs.
+    """
+
+    def __init__(self, oracle_throughputs: Dict[str, dict],
+                 worker_types: Sequence[str], job_types: Sequence,
+                 num_reference_job_types: int,
+                 profiling_percentage: float, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._oracle = oracle_throughputs
+        self._worker_types = list(worker_types)
+        self._job_types = list(job_types)
+        self._profiling_percentage = profiling_percentage
+        self._normalized = self._build_normalized_matrix()
+        self._select_reference_types(num_reference_job_types)
+
+    def _build_normalized_matrix(self) -> np.ndarray:
+        """Row i = job type i; columns = (worker_type, other job type) pairs;
+        value = packed throughput of i when colocated with the other type,
+        normalized by i's isolated throughput (in [0, 1])."""
+        n, m = len(self._job_types), len(self._worker_types)
+        out = np.zeros((n, m * n), dtype=np.float64)
+        for j, worker_type in enumerate(self._worker_types):
+            per_worker = self._oracle[worker_type]
+            for i, job_type in enumerate(self._job_types):
+                entry = per_worker[job_type]
+                isolated = entry["null"]
+                if isolated <= 0:
+                    # Job type infeasible on this worker type (e.g. OOM
+                    # profile entry): packed share is 0 everywhere.
+                    continue
+                for k, other in enumerate(self._job_types):
+                    out[i, j * n + k] = entry[other][0] / isolated
+        # NOTE: unlike Gavel's original oracle, measured packed throughputs
+        # can exceed the isolated throughput (e.g. the TACC V100 profiles),
+        # so normalized values may be > 1; cosine matching handles that fine.
+        if out.size and out.min() < 0.0:
+            raise ValueError("packed throughputs must be non-negative")
+        return out
+
+    def _select_reference_types(self, num_reference_job_types: int) -> None:
+        n = len(self._job_types)
+        idx = sorted(self._rng.sample(range(n), num_reference_job_types))
+        self._reference_job_types = [self._job_types[i] for i in idx]
+        cols = [w * n + i for w in range(len(self._worker_types)) for i in idx]
+        self._reference_matrix = self._normalized[np.ix_(idx, cols)]
+
+    def _profile_job(self, true_job_type) -> Dict[str, dict]:
+        """Simulate partial profiling: each (worker type, reference type)
+        packed measurement is observed with probability
+        `profiling_percentage` (reference: throughput_estimator.py:88-100)."""
+        i = self._job_types.index(true_job_type)
+        n = len(self._job_types)
+        measured: Dict[str, dict] = {}
+        for w, worker_type in enumerate(self._worker_types):
+            measured[worker_type] = {}
+            for ref in self._reference_job_types:
+                if self._rng.uniform(0, 1) <= self._profiling_percentage:
+                    k = self._job_types.index(ref)
+                    measured[worker_type][ref] = self._normalized[i, w * n + k]
+        return measured
+
+    def match_job_to_reference_job(self, true_job_type):
+        """Profile a subset of entries, complete the rest, return the
+        reference job type with smallest cosine distance."""
+        measured = self._profile_job(true_job_type)
+        nref = len(self._reference_job_types)
+        row = np.zeros(self._reference_matrix.shape[1])
+        row_mask = np.zeros_like(row)
+        for w, worker_type in enumerate(self._worker_types):
+            for j, ref in enumerate(self._reference_job_types):
+                if ref in measured[worker_type]:
+                    row[w * nref + j] = measured[worker_type][ref]
+                    row_mask[w * nref + j] = 1.0
+
+        matrix = np.vstack([self._reference_matrix, row])
+        mask = np.vstack([np.ones_like(self._reference_matrix), row_mask])
+        if mask.min() == 0:
+            try:
+                recon = als_complete(matrix, mask)
+            except np.linalg.LinAlgError:
+                return self._rng.choice(self._reference_job_types)
+            hi = float(matrix[mask > 0].max(initial=1.0))
+            matrix = np.where(mask > 0, matrix, np.clip(recon, 0.0, hi))
+
+        target = matrix[-1]
+        if np.linalg.norm(target) == 0:
+            return self._rng.choice(self._reference_job_types)
+        distances = [
+            (cosine_distance(matrix[i], target), i)
+            for i in range(nref)
+        ]
+        _, best = min(distances)
+        return self._reference_job_types[best]
+
+    def get_reference_throughputs(self) -> Dict[str, dict]:
+        """Reference-type-only packed oracle in the standard nested format
+        (normalized; [tput_self, tput_other] per pair)."""
+        n = len(self._reference_job_types)
+        out: Dict[str, dict] = {}
+        for w, worker_type in enumerate(self._worker_types):
+            out[worker_type] = {}
+            for j, ref in enumerate(self._reference_job_types):
+                out[worker_type][ref] = {}
+                for k, other in enumerate(self._reference_job_types):
+                    out[worker_type][ref][other] = [
+                        self._reference_matrix[j, w * n + k],
+                        self._reference_matrix[k, w * n + j],
+                    ]
+        return out
+
+
+__all__ = ["ThroughputEstimator", "als_complete", "cosine_distance",
+           "MATRIX_COMPLETION_RANK", "MATRIX_COMPLETION_MU"]

@@ -90,6 +90,11 @@ class Scheduler:
             read_throughputs(throughputs_file) if throughputs_file else None)
         self._throughput_timeline: Dict[int, "collections.OrderedDict"] = {}
 
+        # Cost / SLO / timeline observability.
+        self._job_cost_so_far: Dict[JobIdPair, float] = {}
+        self._slo_deadlines: Dict[JobIdPair, float] = {}
+        self._job_timelines: Dict[int, List[str]] = {}
+
         self._completed_jobs: Set[JobIdPair] = set()
         self._running_jobs: Set[JobIdPair] = set()
         self._in_progress_updates: Dict[JobIdPair, list] = {}
@@ -160,7 +165,16 @@ class Scheduler:
         self._bs_flags[job_id] = {"big_bs": False, "small_bs": False}
         self._steps_run_in_current_lease[job_id] = 0
 
+        self._job_cost_so_far[job_id] = 0.0
+        if job.SLO is not None and job.duration:
+            # SLO is a multiplier on the job's isolated duration; the
+            # deadline is an absolute timestamp (reference: scheduler.py:724-730).
+            self._slo_deadlines[job_id] = job.SLO * job.duration + ts
+
         int_id = job_id.integer_job_id()
+        self._job_timelines[int_id] = [
+            f"t={ts:.1f} SUBMITTED {job.job_type} sf={job.scale_factor} "
+            f"mode={job.mode}"]
         self.rounds.num_scheduled_rounds[int_id] = 0
         self.rounds.num_queued_rounds[int_id] = 0
         self.rounds.job_start_round[int_id] = self.rounds.num_completed_rounds
@@ -188,6 +202,8 @@ class Scheduler:
         a.completion_times[job_id] = duration
         a.priority_weights_archive[job_id] = a.jobs[job_id].priority_weight
         int_id = job_id.integer_job_id()
+        self._job_timelines.setdefault(int_id, []).append(
+            f"t={a.latest_timestamps[job_id]:.1f} COMPLETED jct={duration:.1f}")
         self.rounds.job_end_round[int_id] = self.rounds.num_completed_rounds
         del a.jobs[job_id]
         del a.steps_run[job_id]
@@ -800,9 +816,17 @@ class Scheduler:
         else:
             if not job_id.is_pair():
                 a.failures[job_id] = 0
+            prices = self._config.per_worker_type_prices
             for m, steps, exec_time in zip(members, agg_steps, agg_times):
                 if not is_active[m]:
                     continue
+                if prices is not None:
+                    self._job_cost_so_far[m] += (
+                        prices[worker_type] * exec_time / 3600.0 * scale_factor)
+                self._job_timelines.setdefault(m.integer_job_id(), []).append(
+                    f"t={self.get_current_timestamp():.1f} MICROTASK "
+                    f"workers={all_worker_ids} steps={steps} "
+                    f"time={exec_time:.1f}")
                 if m in self._running_jobs:
                     self._running_jobs.remove(m)
                     a.steps_run[m][worker_type] += steps
@@ -863,22 +887,77 @@ class Scheduler:
     # Simulation
     # ------------------------------------------------------------------
 
-    def simulate(self, cluster_spec: Dict[str, int],
-                 arrival_times: Sequence[float], jobs: Sequence[Job],
-                 num_chips_per_server: Optional[Dict[str, int]] = None) -> float:
-        """Discrete-event simulation of a trace. Returns the makespan."""
-        for worker_type in sorted(cluster_spec):
-            chips = (num_chips_per_server or {}).get(worker_type, 1)
-            for _ in range(cluster_spec[worker_type] // chips):
-                self.register_worker(worker_type, num_chips=chips)
+    def save_simulation_checkpoint(self, path: str, queued, running,
+                                   remaining_jobs, current_round) -> None:
+        """Pickle the full simulator state — including the in-flight
+        micro-task heap — so a resumed run re-enters the event loop with
+        identical state (reference: scheduler.py:1518-1594)."""
+        import pickle
+        with open(path, "wb") as f:
+            pickle.dump({
+                "scheduler": self.__dict__,
+                "queued": queued,
+                "running": running,
+                "remaining_jobs": remaining_jobs,
+                "current_round": current_round,
+            }, f)
+        logger.info("Saved simulation checkpoint to %s (round %d, %d jobs left)",
+                    path, current_round, remaining_jobs)
 
-        queued = list(zip(arrival_times, jobs))
-        remaining_jobs = len(jobs)
-        running: List[tuple] = []  # heap of (-finish_time, job_id, worker_ids, steps)
-        self._current_timestamp = arrival_times[0] if len(arrival_times) else 0.0
-        current_round = 0
+    def _load_simulation_checkpoint(self, path: str):
+        import pickle
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.__dict__.update(state["scheduler"])
+        return (state["queued"], state["running"], state["remaining_jobs"],
+                state["current_round"])
 
+    def simulate(self, cluster_spec: Optional[Dict[str, int]] = None,
+                 arrival_times: Sequence[float] = (), jobs: Sequence[Job] = (),
+                 num_chips_per_server: Optional[Dict[str, int]] = None,
+                 checkpoint_file: Optional[str] = None,
+                 checkpoint_threshold: Optional[float] = None,
+                 resume_from: Optional[str] = None) -> float:
+        """Discrete-event simulation of a trace. Returns the makespan.
+
+        With `checkpoint_file` + `checkpoint_threshold` in (0, 1), the full
+        simulator state is pickled once that fraction of trace jobs has
+        completed (a threshold of 1.0 never fires: the loop exits when the
+        last job completes). With `resume_from`, the trace arguments are
+        ignored and simulation continues from the pickled state.
+        """
+        if resume_from is not None:
+            queued, running, remaining_jobs, current_round = (
+                self._load_simulation_checkpoint(resume_from))
+        else:
+            for worker_type in sorted(cluster_spec):
+                chips = (num_chips_per_server or {}).get(worker_type, 1)
+                for _ in range(cluster_spec[worker_type] // chips):
+                    self.register_worker(worker_type, num_chips=chips)
+
+            queued = list(zip(arrival_times, jobs))
+            remaining_jobs = len(jobs)
+            self._current_timestamp = (arrival_times[0]
+                                       if len(arrival_times) else 0.0)
+            current_round = 0
+            # heap of (-finish_time, job_id, worker_ids, steps, dispatch_time)
+            running: List[tuple] = []
+        num_trace_jobs = remaining_jobs + len(self._completed_jobs)
+        checkpoint_saved = resume_from is not None
+
+        forced_resolve = False
         while remaining_jobs > 0:
+            # Checkpoint at the top of the iteration so a resumed run
+            # re-enters the loop with byte-identical local state.
+            if (not checkpoint_saved and checkpoint_file is not None
+                    and checkpoint_threshold is not None and num_trace_jobs > 0
+                    and (num_trace_jobs - remaining_jobs) / num_trace_jobs
+                    >= checkpoint_threshold):
+                self.save_simulation_checkpoint(
+                    checkpoint_file, queued, running, remaining_jobs,
+                    current_round)
+                checkpoint_saved = True
+
             next_arrival = queued[0][0] if queued else None
 
             # Advance the clock to the next event.
@@ -887,8 +966,21 @@ class Scheduler:
                 max_ts = -running[0][0]
             if max_ts > 0:
                 self._current_timestamp = max_ts
+                forced_resolve = False
             elif next_arrival is not None:
                 self._current_timestamp = next_arrival
+                forced_resolve = False
+            elif self.acct.jobs and not forced_resolve:
+                # Dead air: jobs are waiting but the allocation-reset
+                # interval hasn't elapsed, so the stale allocation excludes
+                # them all. Force a re-solve rather than deadlocking (the
+                # reference would crash here: its scheduler.py:1913 assigns
+                # a None timestamp).
+                forced_resolve = True
+                self._last_reset_time = (
+                    self._current_timestamp
+                    - self._config.minimum_time_between_allocation_resets)
+                self._need_to_update_allocation = True
             else:
                 logger.warning("no running jobs and no arrivals; stopping")
                 break
@@ -1062,6 +1154,34 @@ class Scheduler:
             themis_cf = max(1.0, float(np.mean(window)) / num_chips) if window else 1.0
             themis_list.append(round(completion_time / (exclusive * themis_cf), 5))
         return static_list, themis_list
+
+    def get_total_cost(self) -> float:
+        """Accumulated $ cost across jobs, priced per worker type per hour
+        (reference: scheduler.py:3060-3067)."""
+        return float(sum(self._job_cost_so_far.values()))
+
+    def get_num_slo_violations(self) -> int:
+        """Jobs whose completion timestamp exceeded SLO * isolated duration
+        + arrival (reference: scheduler.py:3069-3084)."""
+        violations = 0
+        for job_id, deadline in self._slo_deadlines.items():
+            finished_at = self.acct.latest_timestamps.get(job_id)
+            if job_id in self._completed_jobs and finished_at is not None:
+                if finished_at > deadline:
+                    violations += 1
+            elif self.get_current_timestamp() > deadline:
+                violations += 1  # still running past its deadline
+        return violations
+
+    def save_job_timelines(self, timeline_dir: str) -> None:
+        """Dump each job's event timeline (submit / micro-tasks / complete)
+        to <dir>/job_id=N.log (reference: scheduler.py:3109-3128)."""
+        import os
+        os.makedirs(timeline_dir, exist_ok=True)
+        for int_id in sorted(self._job_timelines):
+            path = os.path.join(timeline_dir, f"job_id={int_id}.log")
+            with open(path, "w") as f:
+                f.write("\n".join(self._job_timelines[int_id]) + "\n")
 
     def get_cluster_utilization(self):
         utils = []

@@ -19,40 +19,33 @@ from __future__ import annotations
 import numpy as np
 
 from .lp import LinearProgram, solve_feasibility
-from .policy import Policy
+from .policy import Policy, PolicyWithPacking
 from .simple import IsolatedPolicy
 
 
-class FinishTimeFairnessPolicyWithPerf(Policy):
-    name = "FinishTimeFairness_Perf"
+class _IsolatedTimeTracker:
+    """Cross-round bookkeeping of the isolated-baseline time each job has
+    notionally accumulated, shared by the perf and packing variants."""
 
-    def __init__(self, solver=None):
-        super().__init__(solver)
+    def _init_tracker(self):
         self._isolated = IsolatedPolicy()
         self._cumulative_isolated_time = {}
         self._prev_isolated_throughputs = {}
         self._prev_steps_remaining = {}
 
-    def get_allocation(self, unflattened_throughputs, scale_factors,
-                       unflattened_priority_weights, times_since_start,
-                       num_steps_remaining, cluster_spec):
-        throughputs, index = self.flatten(unflattened_throughputs, cluster_spec)
-        if throughputs is None:
-            self._prev_isolated_throughputs = {}
-            self._prev_steps_remaining = {}
-            return None
-        m, n = throughputs.shape
-        job_ids, worker_types = index
-        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+    def _reset_tracker(self):
+        self._prev_isolated_throughputs = {}
+        self._prev_steps_remaining = {}
 
-        isolated_tputs = self._isolated.get_throughputs(
-            throughputs, index, scale_factors, cluster_spec)
-
-        # Track the isolated time each job has notionally accumulated so rho
-        # compares against a consistent baseline across rounds.
-        expected_isolated = np.zeros(m)
-        remaining = np.zeros(m)
-        elapsed = np.zeros(m)
+    def _isolated_time_arrays(self, job_ids, num_steps_remaining,
+                              times_since_start, isolated_tputs):
+        """(expected_isolated, remaining, elapsed) arrays; also folds the
+        steps completed since the previous allocation into the cumulative
+        isolated-time baseline."""
+        nj = len(job_ids)
+        expected_isolated = np.zeros(nj)
+        remaining = np.zeros(nj)
+        elapsed = np.zeros(nj)
         for i, job_id in enumerate(job_ids):
             self._cumulative_isolated_time.setdefault(job_id, 0.0)
             if job_id in self._prev_steps_remaining:
@@ -64,6 +57,39 @@ class FinishTimeFairnessPolicyWithPerf(Policy):
             elapsed[i] = times_since_start[job_id]
             expected_isolated[i] = (self._cumulative_isolated_time[job_id]
                                     + remaining[i] / isolated_tputs[i, 0])
+        return expected_isolated, remaining, elapsed
+
+    def _commit_tracker(self, job_ids, num_steps_remaining, isolated_tputs):
+        self._prev_steps_remaining = dict(num_steps_remaining)
+        self._prev_isolated_throughputs = {
+            job_ids[i]: float(isolated_tputs[i, 0])
+            for i in range(len(job_ids))}
+
+
+class FinishTimeFairnessPolicyWithPerf(Policy, _IsolatedTimeTracker):
+    name = "FinishTimeFairness_Perf"
+
+    def __init__(self, solver=None):
+        super().__init__(solver)
+        self._init_tracker()
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       unflattened_priority_weights, times_since_start,
+                       num_steps_remaining, cluster_spec):
+        throughputs, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if throughputs is None:
+            self._reset_tracker()
+            return None
+        m, n = throughputs.shape
+        job_ids, worker_types = index
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+
+        isolated_tputs = self._isolated.get_throughputs(
+            throughputs, index, scale_factors, cluster_spec)
+
+        # rho compares against a consistent cross-round isolated baseline.
+        expected_isolated, remaining, elapsed = self._isolated_time_arrays(
+            job_ids, num_steps_remaining, times_since_start, isolated_tputs)
 
         def feasible(rho: float):
             lp = LinearProgram(m * n)
@@ -81,10 +107,11 @@ class FinishTimeFairnessPolicyWithPerf(Policy):
             return solve_feasibility(lp)
 
         lo, hi = 1e-3, 10.0
-        best = None
-        while feasible(hi) is None and hi < 1e7:
+        x = feasible(hi)
+        while x is None and hi < 1e7:
             lo, hi = hi, hi * 10.0
-        if (x := feasible(hi)) is None:
+            x = feasible(hi)
+        if x is None:
             # No rho achievable (e.g. throughput 0 rows): fall back to isolated.
             result = self._isolated.get_allocation(
                 unflattened_throughputs, scale_factors, cluster_spec)
@@ -100,9 +127,91 @@ class FinishTimeFairnessPolicyWithPerf(Policy):
             result = self.unflatten(best[:m * n].reshape((m, n)).clip(0.0, 1.0),
                                     index)
 
-        self._prev_steps_remaining = dict(num_steps_remaining)
-        self._prev_isolated_throughputs = {
-            job_ids[i]: float(isolated_tputs[i, 0]) for i in range(m)}
+        self._commit_tracker(job_ids, num_steps_remaining, isolated_tputs)
+        return result
+
+
+class FinishTimeFairnessPolicyWithPacking(PolicyWithPacking, _IsolatedTimeTracker):
+    """Packed Themis: minimize max rho where each single job's effective
+    throughput sums over the combinations containing it (reference:
+    finish_time_fairness.py:160-279). Same binary-search-on-rho reduction
+    as the perf variant, with packed capacity/time constraints."""
+
+    name = "FinishTimeFairness_Packing"
+
+    def __init__(self, solver=None):
+        super().__init__(solver)
+        self._init_tracker()
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       unflattened_priority_weights, times_since_start,
+                       num_steps_remaining, cluster_spec):
+        tensor, index = self.flatten(unflattened_throughputs, cluster_spec,
+                                     unflattened_priority_weights)
+        if tensor is None or len(tensor) == 0:
+            self._reset_tracker()
+            return None
+        job_ids, single_job_ids, worker_types, relevant = index
+        m, n = tensor[0].shape
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+
+        singles_matrix = np.array(
+            [[unflattened_throughputs[s][wt] for wt in worker_types]
+             for s in single_job_ids], dtype=float)
+        isolated_tputs = self._isolated.get_throughputs(
+            singles_matrix, (single_job_ids, worker_types), scale_factors,
+            cluster_spec)
+
+        expected_isolated, remaining, elapsed = self._isolated_time_arrays(
+            single_job_ids, num_steps_remaining, times_since_start,
+            isolated_tputs)
+
+        def feasible(rho: float):
+            lp = LinearProgram(m * n)
+            for si, s in enumerate(single_job_ids):
+                denom = rho * expected_isolated[si] - elapsed[si]
+                if denom <= 0:
+                    return None
+                row = lp.row()
+                for ci in relevant[s]:
+                    row[ci * n:(ci + 1) * n] = -tensor[si, ci]
+                lp.add_le(row, -remaining[si] / denom)
+            for row, rhs in zip(*self.cluster_capacity_rows(
+                    m, n, sf, self._num_workers)):
+                lp.add_le(row, rhs)
+            for row, rhs in zip(*self.per_job_time_rows(
+                    job_ids, single_job_ids, relevant, n)):
+                lp.add_le(row, rhs)
+            for i in range(m):
+                for j in range(n):
+                    if sf[i, j] == 0:
+                        lp.bounds[i * n + j] = (0, 0)
+            return solve_feasibility(lp)
+
+        lo, hi = 1e-3, 10.0
+        x = feasible(hi)
+        while x is None and hi < 1e7:
+            lo, hi = hi, hi * 10.0
+            x = feasible(hi)
+        if x is None:
+            singles = {s: dict(unflattened_throughputs[s])
+                       for s in single_job_ids}
+            result = self._isolated.get_allocation(
+                singles, scale_factors, cluster_spec)
+        else:
+            best = x
+            while hi > lo * 1.01:
+                mid = (lo + hi) / 2.0
+                x = feasible(mid)
+                if x is not None:
+                    best, hi = x, mid
+                else:
+                    lo = mid
+            result = self.unflatten(
+                best[:m * n].reshape((m, n)).clip(0.0, 1.0), index)
+
+        self._commit_tracker(single_job_ids, num_steps_remaining,
+                             isolated_tputs)
         return result
 
 

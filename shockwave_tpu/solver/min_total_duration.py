@@ -9,7 +9,7 @@ from __future__ import annotations
 import numpy as np
 
 from .lp import LinearProgram, solve_feasibility
-from .policy import Policy
+from .policy import Policy, PolicyWithPacking
 
 
 class MinTotalDurationPolicyWithPerf(Policy):
@@ -38,11 +38,61 @@ class MinTotalDurationPolicyWithPerf(Policy):
             return solve_feasibility(lp)
 
         lo, hi = 100.0, 1e6
-        while feasible(hi) is None:
+        while (best := feasible(hi)) is None:
             lo, hi = hi, hi * 10.0
             if hi > 1e12:
                 return None
-        best = feasible(hi)
+        while hi > lo * 1.05:
+            mid = (lo + hi) / 2.0
+            x = feasible(mid)
+            if x is not None:
+                best, hi = x, mid
+            else:
+                lo = mid
+        return self.unflatten(best.reshape((m, n)).clip(0.0, 1.0), index)
+
+
+class MinTotalDurationPolicyWithPacking(PolicyWithPacking):
+    """Packed variant: each single job's effective throughput sums over all
+    combinations containing it (reference: min_total_duration.py:138-234)."""
+
+    name = "MinTotalDuration_Packing"
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       num_steps_remaining, cluster_spec):
+        tensor, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if tensor is None or len(tensor) == 0:
+            return None
+        job_ids, single_job_ids, worker_types, relevant = index
+        m, n = tensor[0].shape
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+        remaining = np.array([num_steps_remaining[s] for s in single_job_ids],
+                             dtype=float)
+
+        def feasible(T: float):
+            lp = LinearProgram(m * n)
+            for si, s in enumerate(single_job_ids):
+                row = lp.row()
+                for ci in relevant[s]:
+                    row[ci * n:(ci + 1) * n] = -tensor[si, ci]
+                lp.add_le(row, -remaining[si] / T)
+            for row, rhs in zip(*self.cluster_capacity_rows(
+                    m, n, sf, self._num_workers)):
+                lp.add_le(row, rhs)
+            for row, rhs in zip(*self.per_job_time_rows(
+                    job_ids, single_job_ids, relevant, n)):
+                lp.add_le(row, rhs)
+            for i in range(m):
+                for j in range(n):
+                    if sf[i, j] == 0:
+                        lp.bounds[i * n + j] = (0, 0)
+            return solve_feasibility(lp)
+
+        lo, hi = 100.0, 1e6
+        while (best := feasible(hi)) is None:
+            lo, hi = hi, hi * 10.0
+            if hi > 1e12:
+                return None
         while hi > lo * 1.05:
             mid = (lo + hi) / 2.0
             x = feasible(mid)

@@ -7,6 +7,7 @@ from typing import Optional
 from .allox import AlloXPolicy
 from .fifo import FIFOPolicy, FIFOPolicyWithPacking, FIFOPolicyWithPerf
 from .finish_time_fairness import (FinishTimeFairnessPolicy,
+                                   FinishTimeFairnessPolicyWithPacking,
                                    FinishTimeFairnessPolicyWithPerf)
 from .gandiva import GandivaPolicy
 from .max_min_fairness import (MaxMinFairnessPolicy,
@@ -17,6 +18,7 @@ from .max_sum_throughput import (ThroughputNormalizedByCostSumWithPerf,
                                  ThroughputNormalizedByCostSumWithPerfSLOs,
                                  ThroughputSumWithPerf)
 from .min_total_duration import (MinTotalDurationPolicy,
+                                 MinTotalDurationPolicyWithPacking,
                                  MinTotalDurationPolicyWithPerf)
 from .simple import (GandivaFairPolicy, IsolatedPlusPolicy, IsolatedPolicy,
                      ProportionalPolicy)
@@ -47,6 +49,7 @@ def get_policy(policy_name: str, solver: Optional[str] = None,
         "fifo_packed": FIFOPolicyWithPacking,
         "finish_time_fairness": FinishTimeFairnessPolicy,
         "finish_time_fairness_perf": FinishTimeFairnessPolicyWithPerf,
+        "finish_time_fairness_packed": FinishTimeFairnessPolicyWithPacking,
         "gandiva": lambda: GandivaPolicy(seed=seed),
         "gandiva_fair": GandivaFairPolicy,
         "isolated": IsolatedPolicy,
@@ -64,6 +67,7 @@ def get_policy(policy_name: str, solver: Optional[str] = None,
         "max_sum_throughput_normalized_by_cost_perf_SLOs": ThroughputNormalizedByCostSumWithPerfSLOs,
         "min_total_duration": MinTotalDurationPolicy,
         "min_total_duration_perf": MinTotalDurationPolicyWithPerf,
+        "min_total_duration_packed": MinTotalDurationPolicyWithPacking,
         "proportional": ProportionalPolicy,
         "shockwave": ShockwavePolicy,
     }

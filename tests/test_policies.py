@@ -231,6 +231,53 @@ class TestPackedMaxMin:
             assert used <= 1 + 1e-4
 
 
+class TestPackedMakespanAndThemis:
+    def _packed_state(self):
+        singles = [JobIdPair(i) for i in range(3)]
+        tputs = {s: {"v100": 2.0} for s in singles}
+        for i in range(3):
+            for j in range(i + 1, 3):
+                tputs[JobIdPair(i, j)] = {"v100": [1.5, 1.5]}
+        sfs = {s: 1 for s in singles}
+        return singles, tputs, sfs
+
+    def test_min_total_duration_packed_beats_unpacked(self):
+        from shockwave_tpu.solver.min_total_duration import (
+            MinTotalDurationPolicyWithPacking)
+        singles, tputs, sfs = self._packed_state()
+        remaining = {s: 1000 for s in singles}
+        alloc = MinTotalDurationPolicyWithPacking().get_allocation(
+            tputs, sfs, remaining, {"v100": 2})
+        assert alloc is not None
+        # 3 jobs on 2 workers: packing lets every job exceed the 2/3
+        # time-share it would get unpacked, so effective tput > 2*2/3.
+        for s in singles:
+            eff = alloc[s]["v100"] * 2.0 + sum(
+                alloc[k]["v100"] * 1.5 for k in alloc
+                if k.is_pair() and s.overlaps_with(k))
+            assert eff > 2.0 * 2 / 3 - 1e-3
+        # Capacity respected over combinations.
+        used = sum(alloc[k]["v100"] for k in alloc)
+        assert used <= 2 + 1e-4
+
+    def test_finish_time_fairness_packed_runs(self):
+        from shockwave_tpu.solver.finish_time_fairness import (
+            FinishTimeFairnessPolicyWithPacking)
+        singles, tputs, sfs = self._packed_state()
+        prios = {s: 1.0 for s in singles}
+        elapsed = {s: 0.0 for s in singles}
+        remaining = {s: 1000 for s in singles}
+        alloc = FinishTimeFairnessPolicyWithPacking().get_allocation(
+            tputs, sfs, prios, elapsed, remaining, {"v100": 2})
+        assert alloc is not None
+        for s in singles:
+            used = sum(alloc[k]["v100"] for k in alloc
+                       if k == s or (k.is_pair() and s.overlaps_with(k)))
+            assert used <= 1 + 1e-4
+        used = sum(alloc[k]["v100"] for k in alloc)
+        assert used <= 2 + 1e-4
+
+
 class TestRegistry:
     def test_all_names_construct(self):
         names = ["fifo", "fifo_perf", "fifo_packed", "finish_time_fairness",
@@ -241,7 +288,8 @@ class TestRegistry:
                  "max_min_fairness_water_filling",
                  "max_min_fairness_water_filling_perf",
                  "max_sum_throughput_perf", "min_total_duration",
-                 "min_total_duration_perf", "allox", "allox_alpha=0.5",
+                 "min_total_duration_perf", "min_total_duration_packed",
+                 "finish_time_fairness_packed", "allox", "allox_alpha=0.5",
                  "proportional", "shockwave"]
         for name in names:
             assert get_policy(name, seed=0) is not None

@@ -152,3 +152,76 @@ class TestCanonicalParity:
         assert result["makespan"] == pytest.approx(ref_makespan, rel=0.08)
         assert result["avg_jct"] == pytest.approx(ref_jct, rel=0.10)
         assert result["unfair_fraction"] == pytest.approx(ref_unfair, abs=0.08)
+
+
+class TestSimulatorCheckpoint:
+    """Mid-trace checkpoint/restore parity (reference: scheduler.py:1518-1594)."""
+
+    def _make_trace(self):
+        jobs = [make_job(total_steps=(i + 1) * 20000, duration=4000)
+                for i in range(6)]
+        arrivals = [i * 100.0 for i in range(6)]
+        return jobs, arrivals
+
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        jobs, arrivals = self._make_trace()
+        sched_full, makespan_full = run_sim(jobs, arrivals)
+
+        ckpt = str(tmp_path / "sim.ckpt")
+        jobs2, arrivals2 = self._make_trace()
+        policy = get_policy("max_min_fairness", seed=0)
+        sched_a = Scheduler(
+            policy, simulate=True,
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=120.0))
+        makespan_a = sched_a.simulate(
+            {"v100": 2}, arrivals2, jobs2,
+            checkpoint_file=ckpt, checkpoint_threshold=0.5)
+        assert os.path.exists(ckpt)
+        assert makespan_a == pytest.approx(makespan_full)
+
+        # Resume from the checkpoint in a FRESH scheduler; it must finish
+        # the remaining jobs and land on the same makespan.
+        policy_b = get_policy("max_min_fairness", seed=0)
+        sched_b = Scheduler(
+            policy_b, simulate=True,
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=120.0))
+        makespan_b = sched_b.simulate(resume_from=ckpt)
+        assert makespan_b == pytest.approx(makespan_full)
+        assert len(sched_b._completed_jobs) == 6
+        assert sched_b.get_average_jct() == pytest.approx(
+            sched_full.get_average_jct())
+
+
+class TestCostSLOTimelines:
+    """Cost accrual, SLO violation counting, timeline dumps
+    (reference: scheduler.py:3060-3128)."""
+
+    def test_cost_accrual(self):
+        jobs = [make_job(total_steps=20000, duration=2000) for _ in range(2)]
+        sched, makespan = run_sim(
+            jobs, [0.0, 0.0],
+            per_worker_type_prices={"v100": 3.6})  # $3.6/hr = $0.001/s
+        cost = sched.get_total_cost()
+        # Two 1-chip jobs, ~465s each of execution: about 0.93 dollars total.
+        busy = sum(sched.workers.cumulative_time.values())
+        assert cost == pytest.approx(busy * 3.6 / 3600.0, rel=1e-6)
+        assert cost > 0
+
+    def test_slo_violations(self):
+        fast = make_job(total_steps=2000, duration=2000)
+        slow = make_job(total_steps=200000, duration=100)  # impossible SLO
+        fast.SLO = 100.0   # generous: 100x duration
+        slow.SLO = 1.01    # tight: ~101s deadline for a ~4600s job
+        sched, _ = run_sim([fast, slow], [0.0, 0.0])
+        assert sched.get_num_slo_violations() == 1
+
+    def test_timeline_dump(self, tmp_path):
+        jobs = [make_job(total_steps=20000, duration=2000)]
+        sched, _ = run_sim(jobs, [0.0])
+        sched.save_job_timelines(str(tmp_path))
+        log = (tmp_path / "job_id=0.log").read_text()
+        assert "SUBMITTED" in log
+        assert "MICROTASK" in log
+        assert "COMPLETED" in log
