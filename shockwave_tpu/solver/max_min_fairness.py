@@ -106,22 +106,16 @@ class MaxMinFairnessPolicyWithPacking(PolicyWithPacking):
         num_singles, m, n = tensor.shape
 
         sf = self.scale_factors_array(scale_factors, job_ids, m, n)
-
-        iso = np.array([
-            [unflattened_throughputs[s][wt] for wt in worker_types]
-            for s in single_job_ids
-        ])
-        proportional = self._proportional.get_throughputs(
-            iso, (single_job_ids, worker_types), cluster_spec)
+        E, fixed = self.normalized_effective_rows(
+            tensor, index, sf, unflattened_throughputs, cluster_spec,
+            self._proportional)
 
         lp = LinearProgram(m * n + 1)
         t = m * n
         lp.bounds[t] = (None, None)
         for si in range(num_singles):
             row = lp.row()
-            for ci in relevant[single_job_ids[si]]:
-                row[ci * n:(ci + 1) * n] -= (
-                    tensor[si, ci] * sf[ci] / proportional[si, 0])
+            row[:m * n] = -E[si]
             row[t] = 1.0
             lp.add_le(row, 0.0)
         for row, rhs in zip(*self.cluster_capacity_rows(m, n, sf, self._num_workers, 1)):
@@ -130,10 +124,8 @@ class MaxMinFairnessPolicyWithPacking(PolicyWithPacking):
                                                     relevant, n, 1)):
             lp.add_le(row, rhs)
         # Zero out combos with mismatched scale factors.
-        for i in range(m):
-            for j in range(n):
-                if sf[i, j] == 0:
-                    lp.bounds[i * n + j] = (0, 0)
+        for v in fixed:
+            lp.bounds[v] = (0, 0)
         c = np.zeros(m * n + 1)
         c[t] = -1.0
         res = lp.minimize(c).solve()

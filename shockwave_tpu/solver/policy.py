@@ -137,6 +137,29 @@ class PolicyWithPacking(Policy):
             arr[i, :] = sfs.pop() if len(sfs) == 1 else 0
         return arr
 
+    def normalized_effective_rows(self, tensor, index, sf,
+                                  unflattened_throughputs, cluster_spec,
+                                  proportional_policy):
+        """E[si] . x = single job si's effective throughput normalized by
+        its proportional share, plus the (combo, worker) vars to pin to 0
+        because the combo's members have mismatched scale factors."""
+        job_ids, single_job_ids, worker_types, relevant = index
+        num_singles, m, n = tensor.shape
+        iso = np.array([
+            [unflattened_throughputs[s][wt] for wt in worker_types]
+            for s in single_job_ids
+        ])
+        proportional = proportional_policy.get_throughputs(
+            iso, (single_job_ids, worker_types), cluster_spec)
+        E = np.zeros((num_singles, m * n))
+        for si, s in enumerate(single_job_ids):
+            for ci in relevant[s]:
+                E[si, ci * n:(ci + 1) * n] = (
+                    tensor[si, ci] * sf[ci] / proportional[si, 0])
+        fixed = [i * n + j for i in range(m) for j in range(n)
+                 if sf[i, j] == 0]
+        return E, fixed
+
     @staticmethod
     def per_job_time_rows(job_ids, single_job_ids, relevant, n: int,
                           num_extra_vars: int = 0):

@@ -23,6 +23,7 @@ from .min_total_duration import (MinTotalDurationPolicy,
 from .simple import (GandivaFairPolicy, IsolatedPlusPolicy, IsolatedPolicy,
                      ProportionalPolicy)
 from .water_filling import (MaxMinFairnessWaterFillingPolicy,
+                            MaxMinFairnessWaterFillingPolicyWithPacking,
                             MaxMinFairnessWaterFillingPolicyWithPerf)
 
 
@@ -61,6 +62,8 @@ def get_policy(policy_name: str, solver: Optional[str] = None,
         "max_min_fairness_water_filling": lambda: MaxMinFairnessWaterFillingPolicy(
             priority_reweighting_policies),
         "max_min_fairness_water_filling_perf": lambda: MaxMinFairnessWaterFillingPolicyWithPerf(
+            priority_reweighting_policies),
+        "max_min_fairness_water_filling_packed": lambda: MaxMinFairnessWaterFillingPolicyWithPacking(
             priority_reweighting_policies),
         "max_sum_throughput_perf": ThroughputSumWithPerf,
         "max_sum_throughput_normalized_by_cost_perf": ThroughputNormalizedByCostSumWithPerf,

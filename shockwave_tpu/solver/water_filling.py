@@ -8,17 +8,23 @@ parameterized LP + MILP pair (reference:
 scheduler/policies/max_min_fairness_water_filling.py); here saturation is
 detected with per-job probe LPs, which is equivalent and solver-free.
 
+The algorithm is expressed over generic effective-throughput rows
+E[i] . x so the same code serves both the per-job ("perf") variant and
+the packing variant, where x ranges over job *combinations* and a single
+job's effective throughput sums over every combination containing it
+(reference: max_min_fairness_water_filling.py:569-706).
+
 Supports entity-based priority reweighting ("fairness" and "fifo"
 policies) for multi-entity clusters.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 import numpy as np
 
 from .lp import LinearProgram
-from .policy import Policy
+from .policy import Policy, PolicyWithPacking
 from .simple import ProportionalPolicy
 
 _EPS = 1e-5
@@ -56,77 +62,87 @@ class WaterFillingAlgorithm:
                 raise ValueError(f"unknown priority reweighting policy {policy!r}")
         return out
 
-    def _solve_level(self, coeff, sf, num_workers, weights, saturated_levels, m, n,
-                     objective_job=None):
+    def _solve_level(self, E, weights, saturated_levels, shared_rows, num_x,
+                     fixed_vars, objective_job=None):
         """Max water level t (or one job's throughput) s.t. frozen jobs keep
-        their levels and unsaturated jobs get >= w_i * t."""
-        lp = LinearProgram(m * n + 1)
-        t = m * n
+        their levels and unsaturated jobs get >= w_i * t.
+
+        E: (num_levels, num_x) effective-throughput rows; shared_rows:
+        prebuilt (row, rhs) <= constraints over x (capacity + time);
+        fixed_vars: variable indices pinned to 0 (e.g. mismatched-scale
+        combos in the packing variant)."""
+        num_levels = E.shape[0]
+        lp = LinearProgram(num_x + 1)
+        t = num_x
         lp.bounds[t] = (None, None)
-        for i in range(m):
+        for v in fixed_vars:
+            lp.bounds[v] = (0, 0)
+        for i in range(num_levels):
             row = lp.row()
-            row[i * n:(i + 1) * n] = -coeff[i]
+            row[:num_x] = -E[i]
             if i in saturated_levels:
                 lp.add_le(row, -saturated_levels[i])
             elif weights[i] > 0:
                 row[t] = weights[i]
                 lp.add_le(row, 0.0)
-        for row, rhs in zip(*Policy.cluster_capacity_rows(m, n, sf, num_workers, 1)):
-            lp.add_le(row, rhs)
-        for row, rhs in zip(*Policy.job_time_rows(m, n, 1)):
-            lp.add_le(row, rhs)
-        c = np.zeros(m * n + 1)
+        for row, rhs in shared_rows:
+            lp.add_le(row, rhs)  # rows are built with one extra var for t
+        c = np.zeros(num_x + 1)
         if objective_job is None:
             c[t] = -1.0
         else:
-            c[objective_job * n:(objective_job + 1) * n] = -coeff[objective_job]
+            c[:num_x] = -E[objective_job]
         res = lp.minimize(c).solve()
         return res
 
-    def run(self, coeff, sf, num_workers, priority_weights, m, n,
-            entity_weights=None, entity_to_job_mapping=None, job_ids=None):
-        """coeff[i, j]: normalized effective throughput per unit time share."""
+    def run(self, E, shared_rows, priority_weights, num_x,
+            entity_weights=None, entity_to_job_mapping=None, job_ids=None,
+            fixed_vars=()):
+        """E[i] . x is level-job i's normalized effective throughput."""
+        num_levels = E.shape[0]
         saturated_levels: Dict[int, float] = {}
         saturated_ids = set()
         x = None
-        for _ in range(m):
-            if len(saturated_levels) == m:
+        for _ in range(num_levels):
+            if len(saturated_levels) == num_levels:
                 break
             if entity_to_job_mapping is not None:
                 pw = self._reweight(entity_weights, priority_weights,
                                     entity_to_job_mapping, saturated_ids, job_ids)
-                weights = np.array([float(pw[job_ids[i]]) for i in range(m)])
+                weights = np.array([float(pw[job_ids[i]])
+                                    for i in range(num_levels)])
             else:
                 weights = np.array([
-                    0.0 if i in saturated_levels else float(priority_weights[job_ids[i]])
-                    for i in range(m)])
+                    0.0 if i in saturated_levels
+                    else float(priority_weights[job_ids[i]])
+                    for i in range(num_levels)])
             if weights.sum() <= 0:
                 break
-            res = self._solve_level(coeff, sf, num_workers, weights,
-                                    saturated_levels, m, n)
+            res = self._solve_level(E, weights, saturated_levels, shared_rows,
+                                    num_x, fixed_vars)
             if not res.success:
                 break
             level = -res.fun
-            x = res.x[:m * n].reshape((m, n))
+            x = res.x[:num_x]
             # Probe each unsaturated job: can it exceed its waterline?
             newly = []
-            for i in range(m):
+            for i in range(num_levels):
                 if i in saturated_levels or weights[i] <= 0:
                     continue
                 trial = dict(saturated_levels)
-                for k in range(m):
+                for k in range(num_levels):
                     if k != i and k not in trial and weights[k] > 0:
                         trial[k] = level * weights[k]
-                probe = self._solve_level(coeff, sf, num_workers, weights, trial,
-                                          m, n, objective_job=i)
+                probe = self._solve_level(E, weights, trial, shared_rows,
+                                          num_x, fixed_vars, objective_job=i)
                 best = -probe.fun if probe.success else level * weights[i]
                 if best <= level * weights[i] * (1 + _EPS) + _EPS:
                     newly.append((i, level * weights[i]))
             if not newly:
                 # Numerical fallback: freeze the argmin to guarantee progress.
-                rates = (coeff * x).sum(axis=1)
-                active = [i for i in range(m) if i not in saturated_levels
-                          and weights[i] > 0]
+                rates = E @ x
+                active = [i for i in range(num_levels)
+                          if i not in saturated_levels and weights[i] > 0]
                 i = min(active, key=lambda k: rates[k] / weights[k])
                 newly = [(i, level * weights[i])]
             for i, lvl in newly:
@@ -157,13 +173,19 @@ class MaxMinFairnessWaterFillingPolicyWithPerf(Policy):
         proportional = self._proportional.get_throughputs(throughputs, index,
                                                           cluster_spec)
         coeff = throughputs * sf / proportional.reshape((m, 1))
+        E = np.zeros((m, m * n))
+        for i in range(m):
+            E[i, i * n:(i + 1) * n] = coeff[i]
+        shared_rows = list(zip(*Policy.cluster_capacity_rows(
+            m, n, sf, self._num_workers, 1)))
+        shared_rows += list(zip(*Policy.job_time_rows(m, n, 1)))
         x = self._algorithm.run(
-            coeff, sf, self._num_workers, unflattened_priority_weights, m, n,
+            E, shared_rows, unflattened_priority_weights, m * n,
             entity_weights=entity_weights,
             entity_to_job_mapping=entity_to_job_mapping, job_ids=job_ids)
         if x is None:
             return None
-        return self.unflatten(x.clip(0.0, 1.0), index)
+        return self.unflatten(x.reshape((m, n)).clip(0.0, 1.0), index)
 
 
 class MaxMinFairnessWaterFillingPolicy(Policy):
@@ -186,3 +208,43 @@ class MaxMinFairnessWaterFillingPolicy(Policy):
             return None
         return self._perf.get_allocation(ones, scale_factors, priority_weights,
                                          cluster_spec, **kwargs)
+
+
+class MaxMinFairnessWaterFillingPolicyWithPacking(PolicyWithPacking):
+    """Water filling over job combinations: x ranges over (combo, worker
+    type) shares; a single job's level is the sum of its normalized
+    throughput inside every combination that contains it (reference:
+    max_min_fairness_water_filling.py:569-706)."""
+
+    name = "MaxMinFairnessWaterFilling_Packing"
+
+    def __init__(self, priority_reweighting_policies=None):
+        super().__init__()
+        self._algorithm = WaterFillingAlgorithm(priority_reweighting_policies)
+        self._proportional = ProportionalPolicy()
+
+    def get_allocation(self, unflattened_throughputs, scale_factors,
+                       unflattened_priority_weights, cluster_spec,
+                       entity_weights=None, entity_to_job_mapping=None,
+                       verbose=False, return_effective_throughputs=False):
+        tensor, index = self.flatten(unflattened_throughputs, cluster_spec)
+        if tensor is None or len(tensor) == 0:
+            return None
+        job_ids, single_job_ids, worker_types, relevant = index
+        num_singles, m, n = tensor.shape
+        sf = self.scale_factors_array(scale_factors, job_ids, m, n)
+        E, fixed = self.normalized_effective_rows(
+            tensor, index, sf, unflattened_throughputs, cluster_spec,
+            self._proportional)
+        shared_rows = list(zip(*self.cluster_capacity_rows(
+            m, n, sf, self._num_workers, 1)))
+        shared_rows += list(zip(*self.per_job_time_rows(
+            job_ids, single_job_ids, relevant, n, 1)))
+        x = self._algorithm.run(
+            E, shared_rows, unflattened_priority_weights, m * n,
+            entity_weights=entity_weights,
+            entity_to_job_mapping=entity_to_job_mapping,
+            job_ids=single_job_ids, fixed_vars=fixed)
+        if x is None:
+            return None
+        return self.unflatten(x.reshape((m, n)).clip(0.0, 1.0), index)

@@ -277,6 +277,43 @@ class TestPackedMakespanAndThemis:
         used = sum(alloc[k]["v100"] for k in alloc)
         assert used <= 2 + 1e-4
 
+    def test_water_filling_packed_beats_unpacked(self):
+        from shockwave_tpu.solver.water_filling import (
+            MaxMinFairnessWaterFillingPolicyWithPacking)
+        singles, tputs, sfs = self._packed_state()
+        prios = {s: 1.0 for s in singles}
+        alloc = MaxMinFairnessWaterFillingPolicyWithPacking().get_allocation(
+            tputs, sfs, prios, {"v100": 2})
+        assert alloc is not None
+        # Proportional share = 2/3 worker each -> normalized tput 1 would
+        # need 2/3 time at tput 2.0; packing (1.5 each, both run) lets all
+        # three exceed their proportional effective throughput.
+        for s in singles:
+            eff = alloc[s]["v100"] * 2.0 + sum(
+                alloc[k]["v100"] * 1.5 for k in alloc
+                if k.is_pair() and s.overlaps_with(k))
+            assert eff > 2.0 * 2 / 3 - 1e-3
+            used = sum(alloc[k]["v100"] for k in alloc
+                       if k == s or (k.is_pair() and s.overlaps_with(k)))
+            assert used <= 1 + 1e-4
+        used = sum(alloc[k]["v100"] for k in alloc)
+        assert used <= 2 + 1e-4
+
+    def test_water_filling_packed_matches_perf_without_pairs(self):
+        from shockwave_tpu.solver.water_filling import (
+            MaxMinFairnessWaterFillingPolicyWithPacking,
+            MaxMinFairnessWaterFillingPolicyWithPerf)
+        singles = [JobIdPair(i) for i in range(3)]
+        tputs = {s: {"v100": float(i + 1)} for i, s in enumerate(singles)}
+        sfs = {s: 1 for s in singles}
+        prios = {s: 1.0 for s in singles}
+        packed = MaxMinFairnessWaterFillingPolicyWithPacking().get_allocation(
+            tputs, sfs, prios, {"v100": 2})
+        perf = MaxMinFairnessWaterFillingPolicyWithPerf().get_allocation(
+            tputs, sfs, prios, {"v100": 2})
+        for s in singles:
+            assert packed[s]["v100"] == pytest.approx(perf[s]["v100"], abs=1e-3)
+
 
 class TestRegistry:
     def test_all_names_construct(self):
@@ -287,6 +324,7 @@ class TestRegistry:
                  "max_min_fairness_strategy_proof",
                  "max_min_fairness_water_filling",
                  "max_min_fairness_water_filling_perf",
+                 "max_min_fairness_water_filling_packed",
                  "max_sum_throughput_perf", "min_total_duration",
                  "min_total_duration_perf", "min_total_duration_packed",
                  "finish_time_fairness_packed", "allox", "allox_alpha=0.5",
