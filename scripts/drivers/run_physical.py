@@ -1,0 +1,155 @@
+#!/usr/bin/env python3
+"""Trace-driven physical-cluster driver.
+
+Runs the real round-based scheduler: starts the gRPC control plane, waits
+for `--expected_num_workers` worker daemons to register, submits the
+trace's jobs at their arrival offsets in wall-clock time, and drives
+rounds until every job completes
+(reference: scheduler/scripts/drivers/run_scheduler_with_trace.py).
+
+Example (single-host loopback):
+    python scripts/drivers/run_physical.py \
+        --trace data/canonical_120job.trace \
+        --policy max_min_fairness \
+        --throughputs data/tacc_throughputs.json \
+        --expected_num_workers 1 --round_duration 360 &
+    python -m shockwave_tpu.runtime.worker --worker_type v100 \
+        --sched_addr 127.0.0.1 --sched_port 50070 --worker_port 50061
+"""
+import argparse
+import json
+import logging
+import os
+import pickle
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.core.oracle import read_throughputs
+from shockwave_tpu.core.profiles import build_profiles
+from shockwave_tpu.core.trace import parse_trace
+from shockwave_tpu.sched import SchedulerConfig
+from shockwave_tpu.sched.physical import PhysicalScheduler
+from shockwave_tpu.solver import get_policy
+
+
+def submit_jobs(sched, jobs, arrival_times, start_time):
+    """Feed the trace to the scheduler in real time."""
+    for job, arrival in zip(jobs, arrival_times):
+        delay = start_time + arrival - time.time()
+        if delay > 0:
+            time.sleep(delay)
+        sched.add_job(job)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--trace", required=True)
+    p.add_argument("--policy", default="max_min_fairness")
+    p.add_argument("--throughputs", required=True)
+    p.add_argument("--expected_num_workers", type=int, default=None,
+                   help="block until this many chips have registered")
+    p.add_argument("--round_duration", type=float, default=360.0)
+    p.add_argument("--port", type=int, default=50070)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max_rounds", type=int, default=None)
+    p.add_argument("--timeout", type=float, default=None,
+                   help="hard wall-clock cap in seconds")
+    p.add_argument("--config", default=None,
+                   help="JSON file of shockwave hyperparameters")
+    p.add_argument("--output", default=None, help="metrics pickle path")
+    p.add_argument("--timeline_dir", default=None)
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(name)s:%(levelname)s %(message)s")
+
+    jobs, arrival_times = parse_trace(args.trace)
+    throughputs = read_throughputs(args.throughputs)
+    profiles = build_profiles(jobs, throughputs)
+
+    shockwave_config = None
+    if args.config:
+        with open(args.config) as f:
+            shockwave_config = json.load(f)
+    elif args.policy == "shockwave":
+        shockwave_config = {}
+    if shockwave_config is not None:
+        if args.expected_num_workers:
+            shockwave_config.setdefault("num_gpus", args.expected_num_workers)
+        shockwave_config["time_per_iteration"] = args.round_duration
+
+    policy = get_policy(args.policy, seed=args.seed)
+    sched = PhysicalScheduler(
+        policy, throughputs_file=args.throughputs, profiles=profiles,
+        expected_num_workers=args.expected_num_workers, port=args.port,
+        config=SchedulerConfig(
+            time_per_iteration=args.round_duration, seed=args.seed,
+            max_rounds=args.max_rounds, shockwave=shockwave_config))
+
+    start_time = time.time()
+    submitter = threading.Thread(
+        target=submit_jobs, args=(sched, jobs, arrival_times, start_time),
+        daemon=True)
+    submitter.start()
+
+    if args.timeout is not None:
+        def _deadline():
+            time.sleep(args.timeout)
+            logging.warning("timeout reached; shutting down")
+            sched.shutdown()
+            os._exit(3)
+        threading.Thread(target=_deadline, daemon=True).start()
+
+    sched.run()
+    makespan = time.time() - start_time
+
+    jct = sched.get_average_jct()
+    ftf_static, ftf_themis = sched.get_finish_time_fairness()
+    util, util_list = sched.get_cluster_utilization()
+    ext_pct, ext, opp = sched.get_num_lease_extensions()
+
+    metrics = {
+        "trace_file": args.trace,
+        "policy": args.policy,
+        "makespan": makespan,
+        "avg_jct": jct[0] if jct else None,
+        "geometric_mean_jct": jct[1] if jct else None,
+        "harmonic_mean_jct": jct[2] if jct else None,
+        "jct_list": jct[3] if jct else [],
+        "finish_time_fairness_list": ftf_static,
+        "finish_time_fairness_themis_list": ftf_themis,
+        "cluster_util": util,
+        "utilization_list": util_list,
+        "extension_percentage": ext_pct,
+        "num_lease_extensions": ext,
+        "num_lease_extension_opportunities": opp,
+        "per_round_schedule": sched.rounds.per_round_schedule,
+        "time_per_iteration": args.round_duration,
+        "throughput_timeline": sched.get_throughput_timeline(),
+    }
+    if args.output:
+        with open(args.output, "wb") as f:
+            pickle.dump(metrics, f)
+    if args.timeline_dir:
+        sched.save_job_timelines(args.timeline_dir)
+
+    unfair = (sum(1 for r in ftf_static if r > 1.1) / len(ftf_static)
+              if ftf_static else 0.0)
+    print(json.dumps({
+        "policy": args.policy,
+        "makespan": round(makespan, 2),
+        "avg_jct": round(metrics["avg_jct"], 2) if metrics["avg_jct"] else None,
+        "unfair_fraction": round(unfair, 4),
+        "cluster_util": round(util, 4),
+        "lease_extension_pct": round(ext_pct, 2),
+    }))
+    sched.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -37,7 +37,23 @@ MAX_BS = {
     "ResNet-50": 128,
     "Transformer": 128,
     "Recommendation": 8192,
+    "A3C": 4,
+    "CycleGAN": 1,
 }
+
+# Families whose job_type carries no "(batch size N)" suffix; the value is
+# the implicit batch size their profiles are keyed under.
+DEFAULT_BS = {
+    "A3C": 4,
+    "CycleGAN": 1,
+}
+
+
+def oracle_job_type(model: str, batch_size: int) -> str:
+    """The job_type string used as the throughput-oracle key."""
+    if model in DEFAULT_BS:
+        return model
+    return f"{model} (batch size {batch_size})"
 
 def dataset_size(model: str) -> int:
     return DATASET_SIZES[MODEL_DATASET[model]]

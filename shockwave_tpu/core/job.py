@@ -145,6 +145,9 @@ class Job:
     def batch_size(self) -> int:
         m = re.search(r"batch size (\d+)\)", self.job_type)
         if m is None:
+            from .constants import DEFAULT_BS
+            if self.model in DEFAULT_BS:
+                return DEFAULT_BS[self.model]
             raise ValueError(f"job_type has no batch size: {self.job_type!r}")
         return int(m.group(1))
 

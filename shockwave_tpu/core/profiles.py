@@ -12,7 +12,8 @@ import pickle
 from typing import Dict, List, Optional, Sequence
 
 from .adaptation import bs_schedule_for_mode
-from .constants import MODEL_DATASET, dataset_size, num_epochs_for, steps_per_epoch
+from .constants import (MODEL_DATASET, dataset_size, num_epochs_for,
+                        oracle_job_type, steps_per_epoch)
 from .job import Job
 
 # Profiled per-(model, batch size) device memory footprint in MB.
@@ -45,7 +46,7 @@ def epoch_duration(model: str, batch_size: int, scale_factor: int,
     Uses fractional steps-per-epoch (dataset_size / batch_size without
     rounding) to match the reference profiler (utils.py:700-704).
     """
-    job_type = f"{model} (batch size {batch_size})"
+    job_type = oracle_job_type(model, batch_size)
     tput = throughputs[worker_type][(job_type, scale_factor)]["null"]
     return (dataset_size(model) / batch_size) / tput
 
@@ -56,6 +57,17 @@ def build_job_profile(job: Job, throughputs: dict, worker_type: str = "v100") ->
     bs0 = job.batch_size
     n_epochs = num_epochs_for(model, bs0, job.total_steps)
     bs_every_epoch = bs_schedule_for_mode(job.mode, model, bs0, n_epochs, job.scale_factor)
+
+    def safe_epoch_duration(bs: int) -> float:
+        # Families outside the profiled table (or with a zeroed oracle
+        # entry) fall back to the trace's expected duration spread
+        # uniformly over epochs.
+        try:
+            return epoch_duration(model, bs, job.scale_factor, throughputs,
+                                  worker_type)
+        except (KeyError, ZeroDivisionError):
+            return float(job.duration) / n_epochs
+
     return {
         "model": model,
         "dataset": MODEL_DATASET[model],
@@ -65,8 +77,7 @@ def build_job_profile(job: Job, throughputs: dict, worker_type: str = "v100") ->
         "mem_every_epoch": [MEM_MB[model][bs] for bs in bs_every_epoch],
         "util_every_epoch": [UTIL_PCT[model][bs] for bs in bs_every_epoch],
         "duration_every_epoch": [
-            epoch_duration(model, bs, job.scale_factor, throughputs, worker_type)
-            for bs in bs_every_epoch
+            safe_epoch_duration(bs) for bs in bs_every_epoch
         ],
         "scale_factor": job.scale_factor,
         "duration": job.duration,

@@ -1,0 +1,171 @@
+"""A3C actor-critic with a pure-JAX vectorized environment.
+
+The reference trains A3C on Pong with 4 asynchronous CPU actor processes
+sharing a model (workloads/pytorch/rl/{main,train,model}.py,
+shared_optim.py). Asynchronous Hogwild updates are a poor fit for TPU —
+the idiomatic redesign runs the actors as a *batch dimension*: a
+vectorized Catch/Pong-style environment written in JAX, an n-step
+actor-critic unroll under `lax.scan`, and one fused update per tick, so
+the whole act->learn loop is a single compiled XLA program (actors are
+synchronous-parallel instead of asynchronous; same algorithm family,
+MXU-friendly execution).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+GRID_H = 16
+GRID_W = 16
+NUM_ACTIONS = 3  # left, stay, right
+
+
+class EnvState(NamedTuple):
+    ball_y: jnp.ndarray   # [B] int32
+    ball_x: jnp.ndarray   # [B] int32
+    ball_dx: jnp.ndarray  # [B] int32 in {-1, 0, 1}
+    paddle_x: jnp.ndarray  # [B] int32
+    rng: jnp.ndarray      # [B, 2] uint32 per-env keys
+
+
+def env_reset(rng: jnp.ndarray, batch: int) -> EnvState:
+    col_key, dx_key, env_keys = jax.random.split(rng, 3)
+    keys = jax.random.split(env_keys, batch)
+    cols = jax.random.randint(col_key, (batch,), 0, GRID_W)
+    dxs = jax.random.randint(dx_key, (batch,), -1, 2)
+    return EnvState(ball_y=jnp.zeros((batch,), jnp.int32),
+                    ball_x=cols.astype(jnp.int32),
+                    ball_dx=dxs.astype(jnp.int32),
+                    paddle_x=jnp.full((batch,), GRID_W // 2, jnp.int32),
+                    rng=keys)
+
+
+def env_observe(state: EnvState) -> jnp.ndarray:
+    """[B, H, W, 2] float32 one-hot planes (ball, paddle)."""
+    b = state.ball_y.shape[0]
+    ball = jnp.zeros((b, GRID_H, GRID_W))
+    ball = ball.at[jnp.arange(b), state.ball_y, state.ball_x].set(1.0)
+    paddle = jnp.zeros((b, GRID_H, GRID_W))
+    paddle = paddle.at[jnp.arange(b), GRID_H - 1, state.paddle_x].set(1.0)
+    return jnp.stack([ball, paddle], axis=-1)
+
+
+def env_step(state: EnvState, action: jnp.ndarray) -> Tuple[EnvState, jnp.ndarray, jnp.ndarray]:
+    """Batched transition. Returns (next_state, reward, done)."""
+    paddle = jnp.clip(state.paddle_x + action - 1, 0, GRID_W - 1)
+    ball_x = jnp.clip(state.ball_x + state.ball_dx, 0, GRID_W - 1)
+    ball_y = state.ball_y + 1
+    done = ball_y >= GRID_H - 1
+    reward = jnp.where(done,
+                       jnp.where(ball_x == paddle, 1.0, -1.0),
+                       0.0)
+    # Per-env auto-reset on done.
+    next_keys = jax.vmap(lambda k: jax.random.split(k, 3))(state.rng)
+    reset_col = jax.vmap(lambda k: jax.random.randint(k, (), 0, GRID_W))(
+        next_keys[:, 0])
+    reset_dx = jax.vmap(lambda k: jax.random.randint(k, (), -1, 2))(
+        next_keys[:, 1])
+    new_rng = jnp.where(done[:, None], next_keys[:, 2], state.rng)
+    return (EnvState(
+        ball_y=jnp.where(done, 0, ball_y).astype(jnp.int32),
+        ball_x=jnp.where(done, reset_col, ball_x).astype(jnp.int32),
+        ball_dx=jnp.where(done, reset_dx, state.ball_dx).astype(jnp.int32),
+        paddle_x=paddle.astype(jnp.int32),
+        rng=new_rng,
+    ), reward, done)
+
+
+class ActorCritic(nn.Module):
+    """Conv torso + policy/value heads (stand-in for the reference's
+    A3Clstm; recurrence is unnecessary for a fully observed grid)."""
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, obs):
+        x = nn.Conv(16, (3, 3), padding="SAME")(obs)
+        x = nn.relu(x)
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="SAME")(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        logits = nn.Dense(NUM_ACTIONS)(x)
+        value = nn.Dense(1)(x)
+        return logits, value[..., 0]
+
+
+def build_a3c_update(model: ActorCritic, tx, unroll: int = 20,
+                     gamma: float = 0.99, tau: float = 1.0,
+                     value_coef: float = 0.5, entropy_coef: float = 0.01):
+    """One A3C tick: unroll `unroll` env steps with the current policy,
+    compute GAE advantages, apply one gradient update. jit-able."""
+
+    def rollout(params, env_state, rng):
+        def step(carry, _):
+            env_state, rng = carry
+            obs = env_observe(env_state)
+            logits, value = model.apply({"params": params}, obs)
+            rng, sub = jax.random.split(rng)
+            action = jax.random.categorical(sub, logits)
+            next_state, reward, done = env_step(env_state, action)
+            out = (obs, action, reward, done, value)
+            return (next_state, rng), out
+        (env_state, rng), traj = jax.lax.scan(
+            step, (env_state, rng), None, length=unroll)
+        return env_state, rng, traj
+
+    def loss_fn(params, traj, last_value):
+        obs, actions, rewards, dones, values = traj
+        not_done = 1.0 - dones.astype(jnp.float32)
+        # GAE over the unroll (time-major [T, B]).
+        def scan_adv(carry, t):
+            gae, next_value = carry
+            delta = (rewards[t] + gamma * next_value * not_done[t]
+                     - values[t])
+            gae = delta + gamma * tau * not_done[t] * gae
+            return (gae, values[t]), gae
+        ts = jnp.arange(rewards.shape[0] - 1, -1, -1)
+        (_, _), advs = jax.lax.scan(
+            scan_adv, (jnp.zeros_like(last_value), last_value), ts)
+        advs = advs[::-1]
+        returns = advs + values
+        # Re-evaluate policy on the stored observations (fresh grads).
+        flat_obs = obs.reshape((-1,) + obs.shape[2:])
+        logits, value = model.apply({"params": params}, flat_obs)
+        logp = jax.nn.log_softmax(logits)
+        value = value.reshape(rewards.shape)
+        logp = logp.reshape(rewards.shape + (NUM_ACTIONS,))
+        taken = jnp.take_along_axis(
+            logp, actions[..., None], axis=-1)[..., 0]
+        adv = jax.lax.stop_gradient(advs)
+        policy_loss = -(taken * adv).mean()
+        value_loss = ((value - jax.lax.stop_gradient(returns)) ** 2).mean()
+        entropy = -(jnp.exp(logp) * logp).sum(-1).mean()
+        loss = (policy_loss + value_coef * value_loss
+                - entropy_coef * entropy)
+        return loss, {"policy_loss": policy_loss, "value_loss": value_loss,
+                      "entropy": entropy,
+                      "reward": rewards.sum(0).mean()}
+
+    def update(train_state, env_state):
+        params, opt_state, rng, step_no = (
+            train_state["params"], train_state["opt_state"],
+            train_state["rng"], train_state["step"])
+        env_state, rng, traj = rollout(params, env_state, rng)
+        _, last_value = model.apply({"params": params},
+                                    env_observe(env_state))
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, traj, jax.lax.stop_gradient(last_value))
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics["loss"] = loss
+        new_train_state = dict(train_state, params=params,
+                               opt_state=opt_state, rng=rng,
+                               step=step_no + 1)
+        return new_train_state, env_state, metrics
+
+    return jax.jit(update, donate_argnums=(0, 1))

@@ -1,0 +1,94 @@
+"""CycleGAN generators and discriminators in flax.linen.
+
+Zhu et al. '17 architecture (ResNet-block generator, 70x70 PatchGAN
+discriminator), NHWC layout with bfloat16 compute / fp32 params so the
+convolutions tile onto the MXU. Capability parity with the reference's
+monet2photo workload (workloads/pytorch/cyclegan/cyclegan.py); instance
+norm replaces batch norm exactly as in the original paper.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class InstanceNorm(nn.Module):
+    """Per-sample, per-channel normalization (no running statistics)."""
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # Statistics in fp32: bf16's 8-bit mantissa is not enough to
+        # reduce 128x128 spatial planes accurately.
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=(1, 2), keepdims=True)
+        var = jnp.var(x32, axis=(1, 2), keepdims=True)
+        y = (x32 - mean) / jnp.sqrt(var + self.epsilon)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],),
+                          jnp.float32)
+        return (y * scale + bias).astype(self.dtype)
+
+
+class ResidualBlock(nn.Module):
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Conv(self.features, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        y = InstanceNorm(dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME", dtype=self.dtype)(y)
+        y = InstanceNorm(dtype=self.dtype)(y)
+        return x + y
+
+
+class Generator(nn.Module):
+    """c7s1-64, d128, d256, R256 x num_blocks, u128, u64, c7s1-3."""
+    base_features: int = 64
+    num_blocks: int = 6
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        f = self.base_features
+        x = x.astype(self.dtype)
+        x = nn.Conv(f, (7, 7), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(InstanceNorm(dtype=self.dtype)(x))
+        for mult in (2, 4):  # downsample
+            x = nn.Conv(f * mult, (3, 3), strides=(2, 2), padding="SAME",
+                        dtype=self.dtype)(x)
+            x = nn.relu(InstanceNorm(dtype=self.dtype)(x))
+        for _ in range(self.num_blocks):
+            x = ResidualBlock(f * 4, dtype=self.dtype)(x)
+        for mult in (2, 1):  # upsample
+            x = nn.ConvTranspose(f * mult, (3, 3), strides=(2, 2),
+                                 padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(InstanceNorm(dtype=self.dtype)(x))
+        x = nn.Conv(3, (7, 7), padding="SAME", dtype=self.dtype)(x)
+        return jnp.tanh(x).astype(jnp.float32)
+
+
+class Discriminator(nn.Module):
+    """70x70 PatchGAN: C64-C128-C256-C512 -> 1-channel patch logits."""
+    base_features: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        f = self.base_features
+        for i, mult in enumerate((1, 2, 4, 8)):
+            strides = (2, 2) if i < 3 else (1, 1)
+            x = nn.Conv(f * mult, (4, 4), strides=strides, padding="SAME",
+                        dtype=self.dtype)(x)
+            if i > 0:
+                x = InstanceNorm(dtype=self.dtype)(x)
+            x = nn.leaky_relu(x, 0.2)
+        x = nn.Conv(1, (4, 4), padding="SAME", dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -61,6 +61,16 @@ def wikitext2(batch_size: int, seq_len: int = 35, vocab: int = 33278,
     return SyntheticBatches(make, dataset_size // batch_size, seed)
 
 
+def monet2photo(batch_size: int, image_size: int = 128,
+                dataset_size: int = 1193, seed: int = 0):
+    """Unpaired image batches for CycleGAN (domains A=paintings, B=photos)."""
+    def make(rng):
+        a = (rng.rand(batch_size, image_size, image_size, 3) * 2 - 1)
+        b = (rng.rand(batch_size, image_size, image_size, 3) * 2 - 1)
+        return a.astype(np.float32), b.astype(np.float32)
+    return SyntheticBatches(make, dataset_size // batch_size, seed)
+
+
 def ml20m(batch_size: int, num_items: int = 20108, dataset_size: int = 117907,
           seed: int = 0):
     def make(rng):

@@ -144,7 +144,12 @@ class Dispatcher:
                     logger.error("[job %d] exited %d:\n%s", job["job_id"],
                                  proc.returncode,
                                  output.decode(errors="replace")[-2000:])
-                if duration <= 0:
+                if duration <= 0 and steps > 0:
+                    # Iterator made progress but its duration line is
+                    # missing; fall back to wall clock. A (0 steps, 0 s)
+                    # report must stay zeroed — it is the scheduler's
+                    # micro-task-failure signal (reference:
+                    # scheduler.py:4536-4568).
                     duration = elapsed
                 results.append((job["job_id"], steps, duration, iterator_log))
         finally:

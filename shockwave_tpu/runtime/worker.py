@@ -85,9 +85,9 @@ def main(argv=None):
     p.add_argument("--worker_port", "-w", type=int, default=50061)
     p.add_argument("--num_chips", "-g", type=int, default=None,
                    help="default: autodetect via jax.devices()")
-    p.add_argument("--static_run_dir", default="shockwave_tpu/models")
-    p.add_argument("--accordion_run_dir", default="shockwave_tpu/models")
-    p.add_argument("--gns_run_dir", default="shockwave_tpu/models")
+    p.add_argument("--static_run_dir", default="shockwave_tpu/workloads")
+    p.add_argument("--accordion_run_dir", default="shockwave_tpu/workloads")
+    p.add_argument("--gns_run_dir", default="shockwave_tpu/workloads")
     p.add_argument("--data_dir", default=None)
     p.add_argument("--checkpoint_dir", default="/tmp/swtpu_checkpoints")
     args = p.parse_args(argv)

@@ -48,6 +48,7 @@ class PhysicalScheduler(Scheduler):
         self._worker_connections: Dict[int, object] = {}
         self._available_workers: "queue.Queue[int]" = queue.Queue()
         self._lease_update_requests: Dict[JobIdPair, list] = {}
+        self._last_heartbeat: Dict[JobIdPair, float] = {}
         self._max_steps_consensus: Dict[JobIdPair, Optional[int]] = {}
         self._completion_events: Dict[JobIdPair, threading.Timer] = {}
         self._redispatch_assignments: "collections.OrderedDict" = collections.OrderedDict()
@@ -81,6 +82,15 @@ class PhysicalScheduler(Scheduler):
             self._max_steps_consensus[job_id] = None
             self._cv.notify_all()
             return job_id
+
+    def _remove_job(self, job_id: JobIdPair) -> None:
+        super()._remove_job(job_id)
+        # Drop per-job protocol state so a long-running scheduler does not
+        # grow without bound (and a straggler RPC cannot resurrect it).
+        for m in job_id.singletons():
+            self._last_heartbeat.pop(m, None)
+            self._lease_update_requests.pop(m, None)
+            self._max_steps_consensus.pop(m, None)
 
     # ------------------------------------------------------------------
     # RPC callbacks
@@ -126,6 +136,7 @@ class PhysicalScheduler(Scheduler):
             self.acct.latest_timestamps[job_id] = self.get_current_timestamp()
             for m in job_id.singletons():
                 self._running_jobs.add(m)
+                self._last_heartbeat[m] = self.get_current_timestamp()
 
             job = self.acct.jobs[job_id]
             remaining = int(math.ceil(
@@ -159,6 +170,7 @@ class PhysicalScheduler(Scheduler):
             update_id = len(self._lease_update_requests[job_id])
             self._lease_update_requests[job_id].append(
                 (steps, duration, max_steps, max_duration))
+            self._last_heartbeat[job_id] = self.get_current_timestamp()
 
             scale_factor = job.scale_factor
             remaining = int(math.ceil(
@@ -229,6 +241,7 @@ class PhysicalScheduler(Scheduler):
             for m in job_id.singletons():
                 if m in self.acct.jobs:
                     self.acct.latest_timestamps[m] = self.get_current_timestamp()
+                    self._last_heartbeat[m] = self.get_current_timestamp()
             self._available_workers.put(worker_id)
 
             timer = self._completion_events.pop(job_id, None)
@@ -289,6 +302,10 @@ class PhysicalScheduler(Scheduler):
             self._port_offset = (self._port_offset + 1) % (MAX_PORT - BASE_JOB_PORT)
             coordinator = f"{head.addr}:{port}"
 
+        for m in job_id.singletons():
+            # The liveness clock starts at dispatch: process launch +
+            # imports + jit compile all happen before the first RPC.
+            self._last_heartbeat[m] = self.get_current_timestamp()
         for rank, worker_id in enumerate(worker_ids):
             descriptions = []
             for m in job_id.singletons():
@@ -475,11 +492,19 @@ class PhysicalScheduler(Scheduler):
         with self._cv:
             if not any(m in self.acct.jobs for m in job_id.singletons()):
                 return
-            job = self.acct.jobs[job_id.singletons()[0]]
-            num_updates = [len(self._lease_update_requests.get(m, []))
-                           for m in job_id.singletons()]
-            if min(num_updates) < job.scale_factor:
-                # No lease renewals arrived this round: job is unresponsive.
+            # Liveness by heartbeat age, not by per-round renewal count:
+            # InitJob / UpdateLease / Done all stamp a heartbeat. On TPU
+            # the first dispatch can spend most of a round inside jit
+            # compilation before the first step, and a renewed lease's 75%
+            # checkpoint can legitimately skip a round boundary, so the
+            # reference's "no renewal this round => dead" rule
+            # (scheduler.py:4313-4339) produces spurious kills here.
+            now = self.get_current_timestamp()
+            oldest = min(self._last_heartbeat.get(m, 0.0)
+                         for m in job_id.singletons())
+            if now - oldest > (self._time_per_iteration
+                               + JOB_COMPLETION_BUFFER_TIME):
+                # No signal for over a round: job is unresponsive.
                 kill = True
             elif job_id in self._completion_events:
                 self.rounds.completed_in_round.add(job_id)

@@ -272,8 +272,19 @@ class Scheduler:
         job = self.acct.jobs[job_id]
         key = (job.job_type, job.scale_factor)
         oracle = (self._oracle_throughputs or {}).get(worker_type)
-        if oracle is not None and key in oracle:
+        if (oracle is not None and key in oracle
+                and oracle[key]["null"] > 0.0):
             self._throughputs[job_id][worker_type] = oracle[key]["null"]
+        elif not self._simulate and oracle is not None and key in oracle:
+            # A zeroed oracle entry (the reference ships 0.0 for A3C /
+            # CycleGAN) would starve the job in every throughput-driven
+            # policy; seed from the trace's expected rate and let the EMA
+            # learn the real value.
+            nominal = job.total_steps / max(float(job.duration), 1.0)
+            logger.warning("zero oracle throughput for %s on %s; seeding "
+                           "%.4f steps/s from expected duration", key,
+                           worker_type, nominal)
+            self._throughputs[job_id][worker_type] = nominal
         elif self._simulate and self._oracle_throughputs is not None:
             # Simulation has no measured path to recover from a missing
             # oracle entry; fail loudly rather than fabricate throughput.

@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""CycleGAN monet2photo workload (trace: "CycleGAN").
+
+CLI parity with the reference's cyclegan.py — the trace command is
+`python3 cyclegan.py --dataset_path %s/monet2photo --decay_epoch 0` with
+`--n_steps` appended by the dispatcher
+(reference: workloads/pytorch/cyclegan/cyclegan.py).
+
+GAN training needs two optimizers (generators vs discriminators), so this
+workload drives the lease iterator directly instead of the shared Trainer:
+one jit'd step updates G_AB/G_BA then D_A/D_B, batch sharded over the dp
+mesh axis, params replicated (XLA all-reduces grads on ICI).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                *[".."] * 3))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from shockwave_tpu.models import data
+from shockwave_tpu.models.cyclegan import Discriminator, Generator
+from shockwave_tpu.models.train_common import (checkpoint_path, common_parser,
+                                               enable_compile_cache,
+                                               load_checkpoint,
+                                               save_checkpoint)
+from shockwave_tpu.parallel.mesh import (data_parallel_sharding, make_mesh,
+                                         maybe_initialize_distributed)
+from shockwave_tpu.runtime.iterator import LeaseIterator
+
+
+def build_step(models, g_tx, d_tx, lambda_cyc=10.0, lambda_id=5.0):
+    g_ab, g_ba, d_a, d_b = models
+
+    def mse(x, target):
+        return jnp.mean((x - target) ** 2)
+
+    def g_loss_fn(g_params, d_params, real_a, real_b):
+        fake_b = g_ab.apply({"params": g_params["g_ab"]}, real_a)
+        fake_a = g_ba.apply({"params": g_params["g_ba"]}, real_b)
+        rec_a = g_ba.apply({"params": g_params["g_ba"]}, fake_b)
+        rec_b = g_ab.apply({"params": g_params["g_ab"]}, fake_a)
+        id_a = g_ba.apply({"params": g_params["g_ba"]}, real_a)
+        id_b = g_ab.apply({"params": g_params["g_ab"]}, real_b)
+        adv = (mse(d_b.apply({"params": d_params["d_b"]}, fake_b), 1.0)
+               + mse(d_a.apply({"params": d_params["d_a"]}, fake_a), 1.0))
+        cyc = jnp.mean(jnp.abs(rec_a - real_a)) + jnp.mean(jnp.abs(rec_b - real_b))
+        ident = jnp.mean(jnp.abs(id_a - real_a)) + jnp.mean(jnp.abs(id_b - real_b))
+        loss = adv + lambda_cyc * cyc + lambda_id * ident
+        return loss, (fake_a, fake_b)
+
+    def d_loss_fn(d_params, real_a, real_b, fake_a, fake_b):
+        loss_a = (mse(d_a.apply({"params": d_params["d_a"]}, real_a), 1.0)
+                  + mse(d_a.apply({"params": d_params["d_a"]}, fake_a), 0.0))
+        loss_b = (mse(d_b.apply({"params": d_params["d_b"]}, real_b), 1.0)
+                  + mse(d_b.apply({"params": d_params["d_b"]}, fake_b), 0.0))
+        return 0.5 * (loss_a + loss_b)
+
+    def step(state, real_a, real_b):
+        (g_loss, (fake_a, fake_b)), g_grads = jax.value_and_grad(
+            g_loss_fn, has_aux=True)(state["g_params"], state["d_params"],
+                                     real_a, real_b)
+        g_updates, g_opt = g_tx.update(g_grads, state["g_opt"],
+                                       state["g_params"])
+        g_params = optax.apply_updates(state["g_params"], g_updates)
+
+        fake_a = jax.lax.stop_gradient(fake_a)
+        fake_b = jax.lax.stop_gradient(fake_b)
+        d_loss, d_grads = jax.value_and_grad(d_loss_fn)(
+            state["d_params"], real_a, real_b, fake_a, fake_b)
+        d_updates, d_opt = d_tx.update(d_grads, state["d_opt"],
+                                      state["d_params"])
+        d_params = optax.apply_updates(state["d_params"], d_updates)
+        new_state = dict(state, g_params=g_params, d_params=d_params,
+                         g_opt=g_opt, d_opt=d_opt, step=state["step"] + 1)
+        return new_state, {"g_loss": g_loss, "d_loss": d_loss}
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def main():
+    p = common_parser("CycleGAN monet2photo", steps_args=("--n_steps",))
+    p.add_argument("--dataset_path", default=None)
+    p.add_argument("--batch_size", type=int, default=1)
+    p.add_argument("--img_size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--decay_epoch", type=int, default=0)
+    args = p.parse_args()
+    enable_compile_cache()
+
+    maybe_initialize_distributed(args.coordinator, args.num_processes,
+                                 args.process_id)
+    mesh = make_mesh()
+    batch_sharding, repl_sharding = data_parallel_sharding(mesh)
+
+    g_ab, g_ba = Generator(), Generator()
+    d_a, d_b = Discriminator(), Discriminator()
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, args.img_size, args.img_size, 3), jnp.float32)
+    g_params = {"g_ab": g_ab.init(rng, sample)["params"],
+                "g_ba": g_ba.init(rng, sample)["params"]}
+    d_params = {"d_a": d_a.init(rng, sample)["params"],
+                "d_b": d_b.init(rng, sample)["params"]}
+    g_tx = optax.adam(args.lr, b1=0.5)
+    d_tx = optax.adam(args.lr, b1=0.5)
+    state = {"g_params": g_params, "d_params": d_params,
+             "g_opt": g_tx.init(g_params), "d_opt": d_tx.init(d_params),
+             "step": jnp.zeros((), jnp.int32)}
+    state = jax.device_put(state, repl_sharding)
+    step_fn = build_step((g_ab, g_ba, d_a, d_b), g_tx, d_tx)
+
+    loader = data.monet2photo(args.batch_size, args.img_size)
+    ckpt = checkpoint_path(args.checkpoint_dir)
+
+    def load(path):
+        return load_checkpoint(path, jax.device_get(state))
+
+    if args.enable_lease_iterator:
+        iterator = LeaseIterator(loader, args.checkpoint_dir,
+                                 load_checkpoint_func=load,
+                                 save_checkpoint_func=save_checkpoint,
+                                 synthetic_data=args.synthetic_data)
+        restored = iterator.load_checkpoint(ckpt)
+    else:
+        iterator = None
+        restored = load(ckpt)
+    if restored is not None:
+        state = jax.device_put(restored, repl_sharding)
+    start_step = int(state["step"])
+    budget = args.num_steps
+
+    steps_done, window_steps = 0, 0
+    loss = None
+    try:
+        while True:
+            for batch in (iterator if iterator is not None else loader):
+                real_a, real_b = jax.device_put(batch, batch_sharding)
+                state, metrics = step_fn(state, real_a, real_b)
+                loss = metrics["g_loss"]
+                if iterator is not None:
+                    iterator.set_sync_ref(loss)
+                steps_done += 1
+                window_steps += 1
+                if window_steps >= args.throughput_estimation_interval:
+                    jax.block_until_ready(loss)
+                    print(f"[THROUGHPUT_ESTIMATION]\t{time.time()}\t"
+                          f"{start_step + steps_done}", flush=True)
+                    window_steps = 0
+                if budget is not None and start_step + steps_done >= budget:
+                    if iterator is not None:
+                        iterator.complete()
+                    break
+            budget_reached = (budget is not None
+                              and start_step + steps_done >= budget)
+            if iterator is not None and (iterator.done or budget_reached):
+                break
+            if iterator is None and (budget is None or budget_reached):
+                break
+    finally:
+        if loss is not None:
+            jax.block_until_ready(loss)
+        if iterator is not None:
+            iterator.save_checkpoint(ckpt, state)
+        else:
+            save_checkpoint(ckpt, state)
+    print(f"TRAINED {steps_done} steps (cumulative {start_step + steps_done})",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

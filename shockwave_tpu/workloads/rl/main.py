@@ -1,0 +1,122 @@
+#!/usr/bin/env python3
+"""A3C RL workload (trace: "A3C").
+
+CLI parity with the reference's rl/main.py — the trace command is
+`python3 main.py --env PongDeterministic-v4 --workers 4 --amsgrad True`
+with `--max-steps` appended by the dispatcher
+(reference: workloads/pytorch/rl/main.py).
+
+The reference runs `--workers` asynchronous actor processes; here the
+actors are a batch dimension of a vectorized pure-JAX environment and one
+tick = one n-step unroll + update, fully compiled (see models/a3c.py).
+Like the reference (rl/main.py:184-187), the lease iterator wraps the
+tick counter: one iterator step == one update.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                *[".."] * 3))
+
+import jax
+import optax
+
+from shockwave_tpu.models.a3c import (ActorCritic, build_a3c_update,
+                                      env_observe, env_reset)
+from shockwave_tpu.models.train_common import (checkpoint_path, common_parser,
+                                               enable_compile_cache,
+                                               load_checkpoint,
+                                               save_checkpoint)
+from shockwave_tpu.runtime.iterator import LeaseIterator
+
+INFINITY = 10 ** 9
+
+
+class _TickLoader:
+    """An 'epoch' of update ticks for the lease iterator to meter."""
+
+    def __init__(self, n: int):
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __iter__(self):
+        return iter(range(self._n))
+
+
+def main():
+    p = common_parser("A3C", steps_args=("--max-steps",))
+    p.add_argument("--env", default="PongDeterministic-v4",
+                   help="kept for trace-command parity; the built-in "
+                        "vectorized catch/pong environment is always used")
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--amsgrad", default="True")
+    p.add_argument("--unroll", type=int, default=20)
+    p.add_argument("--seed", type=int, default=1)
+    args = p.parse_args()
+    enable_compile_cache()
+
+    model = ActorCritic()
+    rng = jax.random.PRNGKey(args.seed)
+    env_state = env_reset(rng, args.workers)
+    params = model.init(rng, env_observe(env_state))["params"]
+    tx = optax.adam(args.lr)
+    train_state = {"params": params, "opt_state": tx.init(params),
+                   "rng": rng, "step": jax.numpy.zeros((), jax.numpy.int32)}
+    update = build_a3c_update(model, tx, unroll=args.unroll)
+
+    budget = args.num_steps if args.num_steps is not None else INFINITY
+    ckpt = checkpoint_path(args.checkpoint_dir)
+
+    def load(path):
+        return load_checkpoint(path, jax.device_get(train_state))
+
+    if args.enable_lease_iterator:
+        iterator = LeaseIterator(_TickLoader(budget), args.checkpoint_dir,
+                                 load_checkpoint_func=load,
+                                 save_checkpoint_func=save_checkpoint,
+                                 synthetic_data=args.synthetic_data)
+        restored = iterator.load_checkpoint(ckpt)
+    else:
+        iterator = None
+        restored = load(ckpt)
+    if restored is not None:
+        restored["rng"] = jax.numpy.asarray(restored["rng"],
+                                            train_state["rng"].dtype)
+        train_state = restored
+    start_step = int(train_state["step"])
+
+    steps_done, window_steps = 0, 0
+    metrics = None
+    try:
+        for _ in (iterator if iterator is not None else range(budget)):
+            train_state, env_state, metrics = update(train_state, env_state)
+            if iterator is not None:
+                iterator.set_sync_ref(metrics["loss"])
+            steps_done += 1
+            window_steps += 1
+            if window_steps >= args.throughput_estimation_interval:
+                jax.block_until_ready(metrics["loss"])
+                print(f"[THROUGHPUT_ESTIMATION]\t{time.time()}\t"
+                      f"{start_step + steps_done}", flush=True)
+                window_steps = 0
+            if start_step + steps_done >= budget:
+                if iterator is not None:
+                    iterator.complete()
+                break
+    finally:
+        if metrics is not None:
+            jax.block_until_ready(metrics["loss"])
+        if iterator is not None:
+            iterator.save_checkpoint(ckpt, train_state)
+        else:
+            save_checkpoint(ckpt, train_state)
+    print(f"TRAINED {steps_done} steps (cumulative {start_step + steps_done})",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
