@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""Aggregate reproduce pickles into the paper's comparison table.
+
+Reads every `<policy>.pkl` written by reproduce/*.sh and prints one row
+per policy: makespan, avg/geo JCT, unfair-job fraction (rho > 1.1),
+utilization, and lease-extension rate
+(reference: reproduce/aggregate_result.py).
+"""
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from shockwave_tpu.core.metrics import unfair_fraction
+
+PAPER_NAMES = {
+    "shockwave": "Shockwave",
+    "min_total_duration": "OSSP",
+    "finish_time_fairness": "Themis",
+    "max_min_fairness": "Gavel",
+    "allox": "AlloX",
+    "max_sum_throughput_perf": "MST",
+    "gandiva_fair": "Gandiva-Fair",
+}
+
+
+def summarize(metrics: dict) -> dict:
+    unfair = unfair_fraction(metrics.get("finish_time_fairness_list") or [])
+    return {
+        "makespan_h": metrics["makespan"] / 3600.0,
+        "avg_jct_h": (metrics.get("avg_jct") or 0.0) / 3600.0,
+        "geo_jct_h": (metrics.get("geometric_mean_jct") or 0.0) / 3600.0,
+        "unfair_frac": unfair,
+        "util": metrics.get("cluster_util") or 0.0,
+        "lease_ext_pct": metrics.get("extension_percentage") or 0.0,
+    }
+
+
+def main():
+    pickle_dir = sys.argv[1] if len(sys.argv) > 1 else "reproduce/pickles"
+    rows = []
+    for policy, paper in PAPER_NAMES.items():
+        path = os.path.join(pickle_dir, f"{policy}.pkl")
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as f:
+            metrics = pickle.load(f)
+        rows.append((paper, summarize(metrics)))
+    if not rows:
+        print(f"no pickles found in {pickle_dir}", file=sys.stderr)
+        sys.exit(1)
+
+    hdr = (f"{'policy':<14}{'makespan(h)':>12}{'avg JCT(h)':>12}"
+           f"{'geo JCT(h)':>12}{'unfair%':>9}{'util':>7}{'lease%':>8}")
+    print(hdr)
+    print("-" * len(hdr))
+    for paper, s in rows:
+        print(f"{paper:<14}{s['makespan_h']:>12.2f}{s['avg_jct_h']:>12.2f}"
+              f"{s['geo_jct_h']:>12.2f}{100 * s['unfair_frac']:>8.1f}%"
+              f"{s['util']:>7.2f}{s['lease_ext_pct']:>7.1f}%")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Simulation-fidelity analysis: physical run vs simulation, same trace.
+
+Compares the metric pickles of a physical run (run_physical.py) and a
+simulation (simulate.py) of the same trace + policy and reports the
+relative deltas of makespan, average JCT, and unfair-job fraction — the
+paper's Table 3 methodology (reference: reproduce/analyze_fidelity.py:20-56).
+
+Usage:
+    python reproduce/analyze_fidelity.py physical.pkl simulated.pkl \
+        [--tolerance 0.1]
+Exit code 1 if any delta exceeds --tolerance.
+"""
+import argparse
+import json
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from shockwave_tpu.core.metrics import unfair_fraction as _unfair_list
+
+
+def unfair_fraction(metrics: dict) -> float:
+    return _unfair_list(metrics.get("finish_time_fairness_list") or [])
+
+
+def rel_delta(physical: float, simulated: float) -> float:
+    if physical == 0:
+        return 0.0 if simulated == 0 else float("inf")
+    return abs(physical - simulated) / abs(physical)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("physical_pickle")
+    p.add_argument("simulated_pickle")
+    p.add_argument("--tolerance", type=float, default=0.10,
+                   help="max relative delta before flagging (paper reports "
+                        "single-digit-percent fidelity)")
+    args = p.parse_args()
+
+    with open(args.physical_pickle, "rb") as f:
+        phys = pickle.load(f)
+    with open(args.simulated_pickle, "rb") as f:
+        sim = pickle.load(f)
+
+    deltas = {
+        "makespan": rel_delta(phys["makespan"], sim["makespan"]),
+        "avg_jct": rel_delta(phys.get("avg_jct") or 0.0,
+                             sim.get("avg_jct") or 0.0),
+        "unfair_fraction": abs(unfair_fraction(phys) - unfair_fraction(sim)),
+    }
+    report = {
+        "physical": {"makespan": phys["makespan"],
+                     "avg_jct": phys.get("avg_jct"),
+                     "unfair_fraction": unfair_fraction(phys)},
+        "simulated": {"makespan": sim["makespan"],
+                      "avg_jct": sim.get("avg_jct"),
+                      "unfair_fraction": unfair_fraction(sim)},
+        "relative_deltas": {k: round(v, 4) for k, v in deltas.items()},
+        "tolerance": args.tolerance,
+    }
+    print(json.dumps(report, indent=1))
+    if max(deltas.values()) > args.tolerance:
+        print("FIDELITY CHECK FAILED", file=sys.stderr)
+        sys.exit(1)
+    print("fidelity within tolerance")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Scale microbenchmark: generated workloads on 64/128/256-chip simulated
+# clusters (reference: reproduce/scale_{64,128,256}gpus.sh; paper Fig 9).
+# Usage: reproduce/scale_gpus.sh <num_chips> [output_dir]
+set -u
+cd "$(dirname "$0")/.."
+CHIPS=${1:?usage: scale_gpus.sh <num_chips> [output_dir]}
+OUT=${2:-reproduce/pickles/scale_${CHIPS}}
+JOBS=$((CHIPS * 120 / 32))   # keep load proportional to the canonical run
+mkdir -p "$OUT"
+
+for POLICY in shockwave max_min_fairness finish_time_fairness
+do
+    echo "=== ${CHIPS} chips / $POLICY ==="
+    python3 scripts/drivers/simulate_generated.py \
+        --num_jobs "$JOBS" \
+        --policy "$POLICY" \
+        --throughputs data/tacc_throughputs.json \
+        --cluster_spec "v100:${CHIPS}" \
+        --round_duration 120 \
+        --seed 0 \
+        --config configs/tacc_32gpus.json \
+        --output "$OUT/${POLICY}.pkl" \
+        | tee "$OUT/${POLICY}.json"
+done

@@ -1,0 +1,33 @@
+#!/bin/bash
+# Canonical experiment: all 7 paper policies on the 120-job trace,
+# 32-chip cluster, 120 s rounds (reference: reproduce/tacc_32gpus.sh).
+#
+# policy -> figure legend mapping (same as the paper):
+#   shockwave: Shockwave          min_total_duration: OSSP
+#   finish_time_fairness: Themis  max_min_fairness: Gavel
+#   allox: AlloX                  max_sum_throughput_perf: MST
+#   gandiva_fair: Gandiva-Fair
+#
+# Shockwave's MILP dominates runtime (~minutes); the rest take seconds.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-reproduce/pickles}
+mkdir -p "$OUT"
+
+for POLICY in shockwave min_total_duration finish_time_fairness \
+              max_min_fairness allox max_sum_throughput_perf gandiva_fair
+do
+    echo "=== $POLICY ==="
+    python3 scripts/drivers/simulate.py \
+        --trace data/canonical_120job.trace \
+        --policy "$POLICY" \
+        --throughputs data/tacc_throughputs.json \
+        --cluster_spec v100:32 \
+        --round_duration 120 \
+        --seed 0 \
+        --config configs/tacc_32gpus.json \
+        --output "$OUT/${POLICY}.pkl" \
+        | tee "$OUT/${POLICY}.json"
+done
+
+python3 reproduce/aggregate_result.py "$OUT"

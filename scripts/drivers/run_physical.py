@@ -27,6 +27,7 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+from shockwave_tpu.core.metrics import unfair_fraction
 from shockwave_tpu.core.oracle import read_throughputs
 from shockwave_tpu.core.profiles import build_profiles
 from shockwave_tpu.core.trace import parse_trace
@@ -138,8 +139,7 @@ def main():
     if args.timeline_dir:
         sched.save_job_timelines(args.timeline_dir)
 
-    unfair = (sum(1 for r in ftf_static if r > 1.1) / len(ftf_static)
-              if ftf_static else 0.0)
+    unfair = unfair_fraction(ftf_static)
     print(json.dumps({
         "policy": args.policy,
         "makespan": round(makespan, 2),

@@ -20,19 +20,13 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+from shockwave_tpu.core.metrics import (parse_cluster_spec,
+                                        unfair_fraction)
 from shockwave_tpu.core.oracle import read_throughputs
 from shockwave_tpu.core.profiles import build_profiles
 from shockwave_tpu.core.trace import parse_trace
 from shockwave_tpu.sched import Scheduler, SchedulerConfig
 from shockwave_tpu.solver import get_policy
-
-
-def parse_cluster_spec(spec: str):
-    cluster = {}
-    for part in spec.split(","):
-        worker_type, count = part.split(":")
-        cluster[worker_type] = int(count)
-    return cluster
 
 
 def main():
@@ -108,8 +102,7 @@ def main():
         "throughput_timeline": sched.get_throughput_timeline(),
     }
 
-    unfair = (sum(1 for r in ftf_static if r > 1.1) / len(ftf_static)
-              if ftf_static else 0.0)
+    unfair = unfair_fraction(ftf_static)
     print(json.dumps({
         "policy": args.policy,
         "makespan": round(makespan, 2),

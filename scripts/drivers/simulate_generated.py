@@ -1,0 +1,124 @@
+#!/usr/bin/env python3
+"""Simulation driver over generated jobs with Poisson arrivals.
+
+Instead of replaying a fixed trace, samples `--num_jobs` jobs from the
+template table (Philly scale-factor/duration mixes) with exponential
+interarrival gaps, then runs the same simulator loop as simulate.py
+(reference: scheduler/scripts/drivers/simulate_scheduler_with_generated_jobs.py).
+
+Example:
+    python scripts/drivers/simulate_generated.py \
+        --num_jobs 64 --lam 600 --policy max_min_fairness \
+        --throughputs data/tacc_throughputs.json --cluster_spec v100:16
+"""
+import argparse
+import json
+import logging
+import os
+import pickle
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.core.generator import generate_trace
+from shockwave_tpu.core.metrics import (parse_cluster_spec,
+                                        unfair_fraction)
+from shockwave_tpu.core.oracle import read_throughputs
+from shockwave_tpu.core.profiles import build_profiles
+from shockwave_tpu.sched import Scheduler, SchedulerConfig
+from shockwave_tpu.solver import get_policy
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num_jobs", type=int, default=64)
+    p.add_argument("--lam", type=float, default=0.0,
+                   help="mean interarrival seconds (0 = all arrive at t=0)")
+    p.add_argument("--policy", default="max_min_fairness")
+    p.add_argument("--throughputs", required=True)
+    p.add_argument("--cluster_spec", default="v100:32")
+    p.add_argument("--round_duration", type=float, default=360.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max_rounds", type=int, default=None)
+    p.add_argument("--multi_gpu", action="store_true", default=True)
+    p.add_argument("--no_multi_gpu", dest="multi_gpu", action="store_false")
+    p.add_argument("--dynamic", action="store_true", default=True,
+                   help="include accordion/gns jobs")
+    p.add_argument("--static_only", dest="dynamic", action="store_false")
+    p.add_argument("--min_duration_hours", type=float, default=0.2)
+    p.add_argument("--max_duration_hours", type=float, default=5.0)
+    p.add_argument("--config", default=None,
+                   help="JSON file of shockwave hyperparameters")
+    p.add_argument("--output", default=None, help="metrics pickle path")
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(name)s:%(levelname)s %(message)s")
+
+    throughputs = read_throughputs(args.throughputs)
+    jobs, arrival_times = generate_trace(
+        args.num_jobs, throughputs, lam=args.lam, seed=args.seed,
+        generate_multi_gpu_jobs=args.multi_gpu,
+        generate_dynamic_jobs=args.dynamic,
+        min_duration_hours=args.min_duration_hours,
+        max_duration_hours=args.max_duration_hours)
+    profiles = build_profiles(jobs, throughputs)
+    cluster_spec = parse_cluster_spec(args.cluster_spec)
+
+    shockwave_config = None
+    if args.config:
+        with open(args.config) as f:
+            shockwave_config = json.load(f)
+    elif args.policy == "shockwave":
+        shockwave_config = {}
+    if shockwave_config is not None:
+        shockwave_config["num_gpus"] = sum(cluster_spec.values())
+        shockwave_config["time_per_iteration"] = args.round_duration
+
+    policy = get_policy(args.policy, seed=args.seed)
+    sched = Scheduler(
+        policy, simulate=True, throughputs_file=args.throughputs,
+        profiles=profiles,
+        config=SchedulerConfig(
+            time_per_iteration=args.round_duration, seed=args.seed,
+            max_rounds=args.max_rounds, shockwave=shockwave_config))
+
+    makespan = sched.simulate(cluster_spec, arrival_times, jobs)
+
+    jct = sched.get_average_jct()
+    ftf_static, ftf_themis = sched.get_finish_time_fairness()
+    util, util_list = sched.get_cluster_utilization()
+    unfair = unfair_fraction(ftf_static)
+    if args.output:
+        with open(args.output, "wb") as f:
+            ext_pct, ext, opp = sched.get_num_lease_extensions()
+            pickle.dump({
+                "policy": args.policy, "num_jobs": args.num_jobs,
+                "lam": args.lam, "seed": args.seed, "makespan": makespan,
+                "avg_jct": jct[0] if jct else None,
+                "geometric_mean_jct": jct[1] if jct else None,
+                "harmonic_mean_jct": jct[2] if jct else None,
+                "jct_list": jct[3] if jct else [],
+                "finish_time_fairness_list": ftf_static,
+                "finish_time_fairness_themis_list": ftf_themis,
+                "cluster_util": util,
+                "utilization_list": util_list,
+                "extension_percentage": ext_pct,
+                "per_round_schedule": sched.rounds.per_round_schedule,
+                "time_per_iteration": args.round_duration,
+            }, f)
+    print(json.dumps({
+        "policy": args.policy,
+        "num_jobs": args.num_jobs,
+        "lam": args.lam,
+        "makespan": round(makespan, 2),
+        "avg_jct": round(jct[0], 2) if jct else None,
+        "unfair_fraction": round(unfair, 4),
+        "cluster_util": round(util, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

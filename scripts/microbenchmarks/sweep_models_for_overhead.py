@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Per-workload dispatch-overhead microbenchmark.
+
+Measures, for each workload family, the wall-clock cost of one dispatch
+cycle — process start + imports + jit compile + one step + checkpoint —
+cold and then warm (XLA persistent compile cache hit). This is the
+preemption/restore overhead the round mechanism pays whenever a job is
+rescheduled, and what the simulator models as a fixed per-preemption
+penalty (reference: scheduler/scripts/microbenchmarks/
+sweep_models_for_overhead.py; the simulator's 20 s constant is
+scheduler.py:1936-1968).
+
+Example:
+    python scripts/microbenchmarks/sweep_models_for_overhead.py \
+        --families cifar10 lm --output /tmp/overhead.json
+"""
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+WORKLOADS = os.path.join(REPO, "shockwave_tpu", "workloads")
+
+ENTRIES = {
+    "cifar10": ("image_classification/cifar10/main.py",
+                ["--batch_size", "32", "--num_steps", "1"]),
+    "imagenet": ("image_classification/imagenet/main.py",
+                 ["-b", "16", "x", "--num_minibatches", "1"]),
+    "translation": ("translation/train.py",
+                    ["-data", "x", "-batch_size", "16", "-step", "1"]),
+    "lm": ("language_modeling/main.py",
+           ["--batch_size", "10", "--steps", "1"]),
+    "recommendation": ("recommendation/train.py",
+                       ["--data_dir", "x", "--batch_size", "512", "-n", "1"]),
+    "rl": ("rl/main.py", ["--workers", "2", "--unroll", "4",
+                          "--max-steps", "1"]),
+    "cyclegan": ("cyclegan/cyclegan.py",
+                 ["--batch_size", "1", "--img_size", "64", "--n_steps", "1"]),
+}
+
+
+def one_dispatch(script, extra_args, ckpt_dir, cache_dir):
+    env = dict(os.environ, SWTPU_COMPILE_CACHE=cache_dir)
+    start = time.time()
+    out = subprocess.run(
+        [sys.executable, os.path.join(WORKLOADS, script), *extra_args,
+         "--checkpoint_dir", ckpt_dir],
+        capture_output=True, text=True, timeout=1800, env=env)
+    elapsed = time.time() - start
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr[-1000:])
+    return elapsed
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--families", nargs="*", default=list(ENTRIES))
+    p.add_argument("--output", default=None)
+    args = p.parse_args()
+
+    results = []
+    for family in args.families:
+        script, extra = ENTRIES[family]
+        workdir = tempfile.mkdtemp(prefix=f"swtpu_overhead_{family}_")
+        ckpt, cache = os.path.join(workdir, "ckpt"), os.path.join(workdir, "cache")
+        try:
+            cold = one_dispatch(script, extra, ckpt, cache)
+            warm = one_dispatch(script, extra, ckpt, cache)
+            row = {"family": family, "cold_dispatch_s": round(cold, 2),
+                   "warm_dispatch_s": round(warm, 2),
+                   "compile_cache_saving_s": round(cold - warm, 2)}
+        except Exception as e:  # noqa: BLE001 - report and continue sweep
+            row = {"family": family, "error": str(e)[:300]}
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Policy solve-time scaling microbenchmark.
+
+Times `policy.get_allocation` over a grid of (num_jobs, cluster size)
+with realistic throughput spreads, answering "how expensive is each
+LP/MILP as the cluster grows" — the per-round scheduling overhead
+(reference: scheduler/scripts/microbenchmarks/sweep_policy_runtimes.py).
+
+Example:
+    python scripts/microbenchmarks/sweep_policy_runtimes.py \
+        --policies max_min_fairness finish_time_fairness isolated \
+        --num_jobs 16 64 128 --cluster_sizes 16 64
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.core.job import JobIdPair
+from shockwave_tpu.solver import get_policy
+
+# Multi-worker-type throughput spread: jobs run fastest on the first
+# type, mirroring the v100/p100/k80 spreads in the shipped oracle.
+TYPE_SPEEDUPS = {"v100": 1.0, "p100": 0.55, "k80": 0.25}
+
+
+def synth_state(num_jobs, cluster_size, num_worker_types, seed):
+    rng = random.Random(seed)
+    worker_types = list(TYPE_SPEEDUPS)[:num_worker_types]
+    job_ids = [JobIdPair(i) for i in range(num_jobs)]
+    throughputs, scale_factors, priorities = {}, {}, {}
+    for j in job_ids:
+        base = rng.uniform(0.5, 50.0)
+        throughputs[j] = {wt: base * TYPE_SPEEDUPS[wt] for wt in worker_types}
+        scale_factors[j] = rng.choices([1, 2, 4, 8],
+                                       weights=[0.7, 0.1, 0.15, 0.05])[0]
+        priorities[j] = 1.0
+    per_type = max(1, cluster_size // num_worker_types)
+    cluster = {wt: per_type for wt in worker_types}
+    return throughputs, scale_factors, priorities, cluster
+
+
+def time_policy(policy_name, num_jobs, cluster_size, num_worker_types,
+                trials, seed):
+    times = []
+    for t in range(trials):
+        throughputs, sfs, prios, cluster = synth_state(
+            num_jobs, cluster_size, num_worker_types, seed + t)
+        policy = get_policy(policy_name, seed=seed + t)
+        start = time.time()
+        times_since_start = {j: 0.0 for j in sfs}
+        num_steps = {j: 10000 for j in sfs}
+        if policy_name == "proportional":
+            policy.get_allocation(throughputs, cluster)
+        elif policy_name in ("isolated", "isolated_plus", "gandiva",
+                             "gandiva_fair") \
+                or policy_name.startswith("fifo"):
+            policy.get_allocation(throughputs, sfs, cluster)
+        elif policy_name.startswith("allox"):
+            policy.get_allocation(throughputs, sfs, times_since_start,
+                                  num_steps, [], cluster)
+        elif policy_name.startswith("min_total_duration"):
+            policy.get_allocation(throughputs, sfs, num_steps, cluster)
+        elif policy_name == "max_sum_throughput_perf":
+            policy.get_allocation(throughputs, sfs, cluster)
+        elif policy_name.startswith("max_sum_throughput"):
+            policy.get_allocation(throughputs, sfs, cluster,
+                                  num_steps_remaining=num_steps)
+        elif policy_name.startswith("finish_time_fairness"):
+            policy.get_allocation(throughputs, sfs, prios,
+                                  times_since_start, num_steps, cluster)
+        else:
+            policy.get_allocation(throughputs, sfs, prios, cluster)
+        times.append(time.time() - start)
+    return min(times), sum(times) / len(times)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--policies", nargs="*", default=[
+        "isolated", "max_min_fairness", "max_min_fairness_perf",
+        "finish_time_fairness", "min_total_duration",
+        "max_sum_throughput_perf", "gandiva", "fifo"])
+    p.add_argument("--num_jobs", nargs="*", type=int, default=[16, 64, 128])
+    p.add_argument("--cluster_sizes", nargs="*", type=int, default=[16, 64])
+    p.add_argument("--num_worker_types", type=int, default=1)
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--output", default=None, help="JSON results path")
+    args = p.parse_args()
+
+    results = []
+    for policy_name in args.policies:
+        for n in args.num_jobs:
+            for c in args.cluster_sizes:
+                best, mean = time_policy(policy_name, n, c,
+                                         args.num_worker_types,
+                                         args.trials, args.seed)
+                row = {"policy": policy_name, "num_jobs": n,
+                       "cluster_size": c, "best_s": round(best, 4),
+                       "mean_s": round(mean, 4)}
+                results.append(row)
+                print(json.dumps(row), flush=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,255 @@
+#!/usr/bin/env python3
+"""Throughput profiler: measure isolated steps/sec per (job_type, sf).
+
+Times the actual jitted train step of every workload family in-process
+(warmup + timed window with block_until_ready, so async dispatch cannot
+inflate the numbers) and writes the result in the throughput-oracle JSON
+format the scheduler consumes
+(reference: scheduler/scripts/profiling/measure_throughput.py — there a
+standalone gRPC profiler on real GPUs; on TPU the honest-timing concern
+is device sync, not process isolation, so in-process timing is both
+simpler and more accurate).
+
+scale_factor > 1 rows are measured by sharding the batch over a dp mesh
+of `sf` local devices; combinations with fewer attached devices are
+skipped (run with XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu to profile the multi-chip shapes virtually).
+
+Example:
+    python scripts/profiling/measure_throughput.py \
+        --worker_type v5e --output data/v5e_throughputs.json \
+        --families ResNet-18 LM --steps 30
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from shockwave_tpu.core.constants import DEFAULT_BS, oracle_job_type
+from shockwave_tpu.models import data
+from shockwave_tpu.parallel.mesh import data_parallel_sharding, make_mesh
+
+# (family -> profiled batch sizes) mirrors the job template table
+# (reference: scheduler/job_table.py:110-130).
+FAMILY_BATCH_SIZES = {
+    "ResNet-18": [16, 32, 64, 128, 256],
+    "ResNet-50": [16, 32, 64, 128],
+    "Transformer": [16, 32, 64, 128],
+    "LM": [5, 10, 20, 40, 80],
+    "Recommendation": [512, 1024, 2048, 4096, 8192],
+    "A3C": [4],
+    "CycleGAN": [1],
+}
+
+
+def build_family(model_name: str, bs: int):
+    """Returns (state, step_fn, batch) with step_fn jit-compiled."""
+    rng = jax.random.PRNGKey(0)
+
+    if model_name == "A3C":
+        from shockwave_tpu.models.a3c import (ActorCritic, build_a3c_update,
+                                              env_observe, env_reset)
+        model = ActorCritic()
+        env_state = env_reset(rng, bs)
+        params = model.init(rng, env_observe(env_state))["params"]
+        tx = optax.adam(1e-4)
+        ts = {"params": params, "opt_state": tx.init(params), "rng": rng,
+              "step": jnp.zeros((), jnp.int32)}
+        update = build_a3c_update(model, tx)
+
+        def step(state, batch):
+            ts, env_state = state
+            ts, env_state, metrics = update(ts, env_state)
+            return (ts, env_state), metrics["loss"]
+        return (ts, env_state), step, ()
+
+    if model_name == "CycleGAN":
+        from shockwave_tpu.models.cyclegan import Discriminator, Generator
+        from shockwave_tpu.workloads.cyclegan.cyclegan import build_step
+        g_ab, g_ba = Generator(), Generator()
+        d_a, d_b = Discriminator(), Discriminator()
+        sample = jnp.zeros((1, 128, 128, 3), jnp.float32)
+        g_params = {"g_ab": g_ab.init(rng, sample)["params"],
+                    "g_ba": g_ba.init(rng, sample)["params"]}
+        d_params = {"d_a": d_a.init(rng, sample)["params"],
+                    "d_b": d_b.init(rng, sample)["params"]}
+        g_tx, d_tx = optax.adam(2e-4, b1=0.5), optax.adam(2e-4, b1=0.5)
+        state = {"g_params": g_params, "d_params": d_params,
+                 "g_opt": g_tx.init(g_params), "d_opt": d_tx.init(d_params),
+                 "step": jnp.zeros((), jnp.int32)}
+        fused = build_step((g_ab, g_ba, d_a, d_b), g_tx, d_tx)
+        batch = next(iter(data.monet2photo(bs)))
+
+        def step(state, batch):
+            state, metrics = fused(state, *batch)
+            return state, metrics["g_loss"]
+        return state, step, batch
+
+    if model_name == "ResNet-18":
+        from shockwave_tpu.models.resnet import ResNet18
+        model = ResNet18()
+        sample = jnp.zeros((1, 32, 32, 3), jnp.float32)
+        variables = model.init(rng, sample, train=True)
+        state = {"params": variables["params"],
+                 "batch_stats": variables["batch_stats"]}
+
+        def loss_fn(params, state, images, labels):
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": state["batch_stats"]},
+                images, train=True, mutable=["batch_stats"])
+            return (optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean(), {"batch_stats": mutated["batch_stats"]})
+        batch = next(iter(data.cifar10(bs)))
+    elif model_name == "ResNet-50":
+        from shockwave_tpu.models.resnet import ResNet50
+        model = ResNet50()
+        sample = jnp.zeros((1, 224, 224, 3), jnp.float32)
+        variables = model.init(rng, sample, train=True)
+        state = {"params": variables["params"],
+                 "batch_stats": variables["batch_stats"]}
+
+        def loss_fn(params, state, images, labels):
+            logits, mutated = model.apply(
+                {"params": params, "batch_stats": state["batch_stats"]},
+                images, train=True, mutable=["batch_stats"])
+            return (optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean(), {"batch_stats": mutated["batch_stats"]})
+        batch = next(iter(data.imagenet(bs)))
+    elif model_name == "Transformer":
+        from shockwave_tpu.models.transformer import Seq2SeqTransformer
+        model = Seq2SeqTransformer()
+        src = jnp.zeros((1, 32), jnp.int32)
+        state = {"params": model.init(rng, src, src)["params"]}
+
+        def loss_fn(params, state, src_tokens, tgt_tokens):
+            logits = model.apply({"params": params}, src_tokens,
+                                 tgt_tokens[:, :-1])
+            targets = tgt_tokens[:, 1:]
+            mask = (targets != 0).astype(jnp.float32)
+            losses = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets)
+            return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0), {}
+        batch = next(iter(data.multi30k(bs, tgt_len=33)))
+    elif model_name == "LM":
+        from shockwave_tpu.models.lm import LSTMLanguageModel
+        model = LSTMLanguageModel()
+        sample = jnp.zeros((1, 35), jnp.int32)
+        state = {"params": model.init(rng, sample)["params"]}
+
+        def loss_fn(params, state, tokens, targets):
+            logits = model.apply({"params": params}, tokens)
+            return (optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean(), {})
+        batch = next(iter(data.wikitext2(bs)))
+    elif model_name == "Recommendation":
+        from shockwave_tpu.models.recommendation import (AutoEncoder,
+                                                         multinomial_nll)
+        model = AutoEncoder()
+        sample = jnp.zeros((1, model.num_items), jnp.float32)
+        state = {"params": model.init(rng, sample)["params"]}
+
+        def loss_fn(params, state, interactions):
+            logits = model.apply({"params": params}, interactions)
+            return multinomial_nll(logits, interactions), {}
+        batch = next(iter(data.ml20m(bs)))
+    else:
+        raise ValueError(model_name)
+
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = dict(state, opt_state=tx.init(state["params"]))
+
+    def step(state, batch):
+        def scalar_loss(params):
+            return loss_fn(params, state, *batch)
+        (loss, aux), grads = jax.value_and_grad(
+            scalar_loss, has_aux=True)(state["params"])
+        updates, new_opt = tx.update(grads, state["opt_state"],
+                                     state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        new_state = dict(state, params=new_params, opt_state=new_opt)
+        if "batch_stats" in aux:
+            new_state["batch_stats"] = aux["batch_stats"]
+        return new_state, loss
+
+    return state, jax.jit(step), batch
+
+
+def measure(model_name: str, bs: int, sf: int, steps: int, warmup: int):
+    """steps/sec for one (family, batch size, scale factor) combination."""
+    devices = jax.devices()[:sf]
+    if len(devices) < sf:
+        return None
+    mesh = make_mesh(dp=sf, devices=devices)
+    batch_sharding, repl_sharding = data_parallel_sharding(mesh)
+
+    # batch_size is per-chip (the reference's DDP semantics: --batch_size
+    # is each process's local batch); the global batch is bs * sf.
+    state, step_fn, batch = build_family(model_name, bs * sf)
+    if model_name != "A3C":  # A3C state carries per-env RNG, not shardable
+        state = jax.device_put(state, repl_sharding)
+        batch = jax.device_put(batch, batch_sharding)
+
+    loss = None
+    for _ in range(warmup):
+        state, loss = step_fn(state, batch)
+    jax.block_until_ready(loss)
+    start = time.time()
+    for _ in range(steps):
+        state, loss = step_fn(state, batch)
+    jax.block_until_ready(loss)
+    return steps / (time.time() - start)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--worker_type", default="v5e")
+    p.add_argument("--output", required=True)
+    p.add_argument("--families", nargs="*", default=list(FAMILY_BATCH_SIZES))
+    p.add_argument("--scale_factors", nargs="*", type=int, default=[1, 2, 4, 8])
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--merge", action="store_true",
+                   help="merge into an existing oracle file")
+    args = p.parse_args()
+
+    oracle = {}
+    if args.merge and os.path.exists(args.output):
+        with open(args.output) as f:
+            oracle = json.load(f)
+    table = oracle.setdefault(args.worker_type, {})
+
+    n_devices = len(jax.devices())
+    for family in args.families:
+        for bs in FAMILY_BATCH_SIZES[family]:
+            for sf in args.scale_factors:
+                if sf > n_devices:
+                    print(f"skip {family} bs={bs} sf={sf}: "
+                          f"only {n_devices} devices", file=sys.stderr)
+                    continue
+                if family in DEFAULT_BS and sf > 1:
+                    continue  # A3C / CycleGAN are single-chip families
+                tput = measure(family, bs, sf, args.steps, args.warmup)
+                if tput is None:
+                    continue
+                job_type = oracle_job_type(family, bs)
+                key = str((job_type, sf))
+                table.setdefault(key, {})["null"] = round(tput, 4)
+                print(f"{args.worker_type} {key}: {tput:.3f} steps/s",
+                      flush=True)
+
+    os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
+    with open(args.output, "w") as f:
+        json.dump(oracle, f, indent=1, sort_keys=True)
+    print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()

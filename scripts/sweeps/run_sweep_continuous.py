@@ -1,0 +1,33 @@
+#!/usr/bin/env python3
+"""Continuous-arrival sweep: policies x Poisson load levels.
+
+Sweeps the mean interarrival time (`--lams`, seconds) at a fixed job
+count — the "vary cluster load, watch JCT/fairness degrade" experiment
+(reference: scheduler/scripts/sweeps/run_sweep_continuous.py).
+
+Example:
+    python scripts/sweeps/run_sweep_continuous.py \
+        --policies max_min_fairness fifo --num_jobs 64 --lams 1800 600 300
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from sweep_common import add_common_args, run_sweep
+
+
+def main():
+    p = add_common_args(argparse.ArgumentParser(description=__doc__))
+    p.add_argument("--num_jobs", type=int, default=64)
+    p.add_argument("--lams", nargs="*", type=float,
+                   default=[3600.0, 1800.0, 900.0, 450.0])
+    args = p.parse_args()
+    run_sweep(args.policies, [args.num_jobs], args.lams, args.seeds,
+              args.throughputs, args.cluster_spec, args.round_duration,
+              args.config, args.output)
+
+
+if __name__ == "__main__":
+    main()

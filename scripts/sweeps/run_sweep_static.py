@@ -1,0 +1,32 @@
+#!/usr/bin/env python3
+"""Static-trace sweep: policies x job-count, all jobs arriving at t=0.
+
+The "fixed batch of jobs, vary the batch size" experiment — isolates
+scheduling quality from arrival dynamics
+(reference: scheduler/scripts/sweeps/run_sweep_static.py).
+
+Example:
+    python scripts/sweeps/run_sweep_static.py \
+        --policies max_min_fairness isolated --num_jobs_list 32 64 128
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from sweep_common import add_common_args, run_sweep
+
+
+def main():
+    p = add_common_args(argparse.ArgumentParser(description=__doc__))
+    p.add_argument("--num_jobs_list", nargs="*", type=int,
+                   default=[32, 64, 128])
+    args = p.parse_args()
+    run_sweep(args.policies, args.num_jobs_list, [0.0], args.seeds,
+              args.throughputs, args.cluster_spec, args.round_duration,
+              args.config, args.output)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Shared machinery for policy x load sweep scripts.
+
+Each sweep point is one simulation run (a subprocess of the
+simulate_generated.py driver so a solver crash in one point cannot take
+down the sweep); results stream to stdout as JSON lines and accumulate
+into an optional JSON file (reference: scheduler/scripts/sweeps/
+run_sweep_{continuous,static}.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+DRIVER = os.path.join(REPO, "scripts", "drivers", "simulate_generated.py")
+
+
+def run_point(policy: str, num_jobs: int, lam: float, throughputs: str,
+              cluster_spec: str, round_duration: float, seed: int,
+              config: Optional[str] = None, timeout: int = 3600) -> dict:
+    cmd = [sys.executable, DRIVER,
+           "--num_jobs", str(num_jobs), "--lam", str(lam),
+           "--policy", policy, "--throughputs", throughputs,
+           "--cluster_spec", cluster_spec,
+           "--round_duration", str(round_duration), "--seed", str(seed)]
+    if config:
+        cmd += ["--config", config]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"policy": policy, "num_jobs": num_jobs, "lam": lam,
+                "seed": seed, "error": f"timeout after {timeout}s"}
+    if out.returncode != 0:
+        return {"policy": policy, "num_jobs": num_jobs, "lam": lam,
+                "seed": seed, "error": out.stderr[-300:]}
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    row["seed"] = seed
+    return row
+
+
+def run_sweep(policies: List[str], num_jobs_list: List[int],
+              lams: List[float], seeds: List[int], throughputs: str,
+              cluster_spec: str, round_duration: float,
+              config: Optional[str], output: Optional[str]) -> List[dict]:
+    results = []
+    for policy in policies:
+        for num_jobs in num_jobs_list:
+            for lam in lams:
+                for seed in seeds:
+                    row = run_point(policy, num_jobs, lam, throughputs,
+                                    cluster_spec, round_duration, seed,
+                                    config)
+                    results.append(row)
+                    print(json.dumps(row), flush=True)
+                    if output:
+                        with open(output, "w") as f:
+                            json.dump(results, f, indent=1)
+    return results
+
+
+def add_common_args(p):
+    p.add_argument("--policies", nargs="*",
+                   default=["max_min_fairness", "finish_time_fairness",
+                            "isolated", "fifo"])
+    p.add_argument("--throughputs",
+                   default=os.path.join(REPO, "data", "tacc_throughputs.json"))
+    p.add_argument("--cluster_spec", default="v100:32")
+    p.add_argument("--round_duration", type=float, default=360.0)
+    p.add_argument("--seeds", nargs="*", type=int, default=[0, 1])
+    p.add_argument("--config", default=None)
+    p.add_argument("--output", default=None)
+    return p

@@ -1,0 +1,25 @@
+"""Shared reporting helpers used by drivers and reproduce tooling."""
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+# A job is "unfair" when its finish-time-fairness rho exceeds this; the
+# paper's reporting threshold (reference: reproduce/analyze_fidelity.py).
+UNFAIR_RHO_THRESHOLD = 1.1
+
+
+def unfair_fraction(ftf_list: Sequence[float],
+                    threshold: float = UNFAIR_RHO_THRESHOLD) -> float:
+    """Fraction of jobs whose rho exceeds the unfairness threshold."""
+    if not ftf_list:
+        return 0.0
+    return sum(1 for r in ftf_list if r > threshold) / len(ftf_list)
+
+
+def parse_cluster_spec(spec: str) -> Dict[str, int]:
+    """Parse "worker_type:count[,worker_type:count...]" CLI specs."""
+    cluster: Dict[str, int] = {}
+    for part in spec.split(","):
+        worker_type, count = part.split(":")
+        cluster[worker_type] = int(count)
+    return cluster

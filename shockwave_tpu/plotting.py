@@ -1,0 +1,177 @@
+"""Result plotting: JCT / fairness CDFs, policy bar charts, and per-round
+schedule heatmaps from metric pickles (reference: scheduler/plotting.py).
+
+Every function takes `{label: metrics_dict}` where each metrics dict is
+one driver-output pickle (simulate.py / run_physical.py / the sweep
+scripts), and writes a PNG. Usable as a CLI:
+
+    python -m shockwave_tpu.plotting --metric jct \
+        --pickles shockwave=out/shockwave.pkl gavel=out/mmf.pkl \
+        --output jct_cdf.png
+"""
+from __future__ import annotations
+
+import argparse
+import pickle
+from typing import Dict, List, Optional
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _cdf_axes(ax, xlabel: str):
+    ax.set_ylabel("CDF")
+    ax.set_xlabel(xlabel)
+    ax.set_ylim(0, 1)
+    ax.grid(alpha=0.3)
+    ax.legend()
+
+
+def _plot_cdf(ax, values: List[float], label: str):
+    xs = np.sort(np.asarray(values, dtype=float))
+    ys = np.arange(1, len(xs) + 1) / len(xs)
+    ax.plot(xs, ys, label=label, drawstyle="steps-post")
+
+
+def plot_jct_cdf(results: Dict[str, dict], output: str,
+                 hours: bool = True) -> str:
+    """CDF of job completion times per policy (reference: plotting.py's
+    JCT CDF figures)."""
+    fig, ax = plt.subplots(figsize=(5, 3.5))
+    for label, metrics in results.items():
+        jcts = np.asarray(metrics["jct_list"], dtype=float)
+        _plot_cdf(ax, jcts / 3600.0 if hours else jcts, label)
+    _cdf_axes(ax, "JCT (hours)" if hours else "JCT (s)")
+    fig.tight_layout()
+    fig.savefig(output, dpi=150)
+    plt.close(fig)
+    return output
+
+
+def plot_ftf_cdf(results: Dict[str, dict], output: str,
+                 themis: bool = False) -> str:
+    """CDF of finish-time-fairness rho per policy; rho > 1 means the job
+    did worse than its fair share (reference: plotting.py rho CDFs)."""
+    key = ("finish_time_fairness_themis_list" if themis
+           else "finish_time_fairness_list")
+    fig, ax = plt.subplots(figsize=(5, 3.5))
+    for label, metrics in results.items():
+        _plot_cdf(ax, metrics[key], label)
+    ax.axvline(1.0, color="k", linestyle="--", linewidth=0.8)
+    _cdf_axes(ax, "finish-time fairness " + r"$\rho$")
+    fig.tight_layout()
+    fig.savefig(output, dpi=150)
+    plt.close(fig)
+    return output
+
+
+def plot_policy_bars(results: Dict[str, dict], output: str,
+                     metric: str = "makespan", hours: bool = True) -> str:
+    """Bar chart of a scalar metric (makespan / avg_jct / cluster_util)
+    across policies."""
+    labels = list(results)
+    values = [float(results[k][metric]) for k in labels]
+    if hours and metric in ("makespan", "avg_jct"):
+        values = [v / 3600.0 for v in values]
+        unit = " (hours)"
+    else:
+        unit = ""
+    fig, ax = plt.subplots(figsize=(5, 3.5))
+    ax.bar(labels, values)
+    ax.set_ylabel(metric + unit)
+    ax.grid(alpha=0.3, axis="y")
+    plt.xticks(rotation=20, ha="right")
+    fig.tight_layout()
+    fig.savefig(output, dpi=150)
+    plt.close(fig)
+    return output
+
+
+def plot_schedule_heatmap(metrics: dict, output: str,
+                          max_rounds: Optional[int] = None) -> str:
+    """Rounds x jobs occupancy map from `per_round_schedule`
+    (reference: plotting.py per-round schedule heatmaps)."""
+    schedule = metrics["per_round_schedule"]
+    if max_rounds:
+        schedule = schedule[:max_rounds]
+    job_ids = sorted({int(j) for rnd in schedule for j in rnd})
+    if not job_ids:
+        raise ValueError("empty per_round_schedule")
+    col = {j: i for i, j in enumerate(job_ids)}
+    grid = np.zeros((len(schedule), len(job_ids)))
+    for r, rnd in enumerate(schedule):
+        for j, worker_ids in rnd.items():
+            # Values are the assigned worker-id tuples; plot chip counts.
+            grid[r, col[int(j)]] = (len(worker_ids)
+                                    if hasattr(worker_ids, "__len__")
+                                    else worker_ids)
+    fig, ax = plt.subplots(figsize=(6, 4))
+    im = ax.imshow(grid.T, aspect="auto", interpolation="nearest",
+                   cmap="viridis", origin="lower")
+    ax.set_xlabel("round")
+    ax.set_ylabel("job")
+    fig.colorbar(im, label="chips allocated")
+    fig.tight_layout()
+    fig.savefig(output, dpi=150)
+    plt.close(fig)
+    return output
+
+
+def plot_utilization(results: Dict[str, dict], output: str) -> str:
+    """Per-round cluster utilization timeline per policy."""
+    fig, ax = plt.subplots(figsize=(6, 3.5))
+    for label, metrics in results.items():
+        util = metrics.get("utilization_list") or []
+        ax.plot(range(len(util)), util, label=label, linewidth=0.9)
+    ax.set_xlabel("round")
+    ax.set_ylabel("cluster utilization")
+    ax.set_ylim(0, 1.05)
+    ax.grid(alpha=0.3)
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(output, dpi=150)
+    plt.close(fig)
+    return output
+
+
+def _load(pairs: List[str]) -> Dict[str, dict]:
+    results = {}
+    for pair in pairs:
+        label, path = pair.split("=", 1)
+        with open(path, "rb") as f:
+            results[label] = pickle.load(f)
+    return results
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--metric", required=True,
+                   choices=["jct", "ftf", "ftf_themis", "bars", "heatmap",
+                            "utilization"])
+    p.add_argument("--pickles", nargs="+", required=True,
+                   help="label=path pairs of driver metric pickles")
+    p.add_argument("--bar_metric", default="makespan")
+    p.add_argument("--output", required=True)
+    args = p.parse_args()
+
+    results = _load(args.pickles)
+    if args.metric == "jct":
+        plot_jct_cdf(results, args.output)
+    elif args.metric == "ftf":
+        plot_ftf_cdf(results, args.output)
+    elif args.metric == "ftf_themis":
+        plot_ftf_cdf(results, args.output, themis=True)
+    elif args.metric == "bars":
+        plot_policy_bars(results, args.output, metric=args.bar_metric)
+    elif args.metric == "heatmap":
+        plot_schedule_heatmap(next(iter(results.values())), args.output)
+    elif args.metric == "utilization":
+        plot_utilization(results, args.output)
+    print(args.output)
+
+
+if __name__ == "__main__":
+    main()

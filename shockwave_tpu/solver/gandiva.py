@@ -29,7 +29,11 @@ class GandivaPolicy(PolicyWithPacking):
             return 0.0
         total = 0.0
         for wt in worker_types:
-            packed = throughputs[combo][wt]
+            packed = throughputs.get(combo, {}).get(wt)
+            if packed is None:
+                # No measured pair throughput: treat as not paying off so
+                # the combination is retired (and re-explored later).
+                return 0.0
             for i, member in enumerate(combo.singletons()):
                 if packed[i] <= 0.0:
                     return 0.0
@@ -43,8 +47,17 @@ class GandivaPolicy(PolicyWithPacking):
                                       len(job_ids), len(worker_types))
         x = np.zeros((len(job_ids), len(worker_types)))
         for combo in combos_to_schedule:
-            i = job_ids.index(combo)
-            x[i] = np.array([cluster_spec[wt] / m for wt in worker_types]) / sf[i]
+            share = np.array([cluster_spec[wt] / m for wt in worker_types])
+            if combo in job_ids:
+                i = job_ids.index(combo)
+                x[i] = share / sf[i]
+            else:
+                # No measured pair throughput for this combination yet, so
+                # it has no flattened row; space-sharing gives each member
+                # the combo's full time fraction.
+                for member in combo.singletons():
+                    i = job_ids.index(member)
+                    x[i] = share / sf[i]
         row_sums = np.maximum(x.sum(axis=1), 1.0)
         return x / row_sums[:, None]
 

@@ -21,8 +21,7 @@ from shockwave_tpu.models.train_common import Trainer, common_parser
 
 def main():
     p = common_parser("LSTM LM on Wikitext-2", steps_args=("--steps",))
-    p.add_argument("--cuda", action="store_true",
-                   help="accepted for trace-command compatibility; ignored")
+    # --cuda (trace-command compatibility) comes from common_parser.
     p.add_argument("--data", default=None)
     p.add_argument("--batch_size", type=int, default=20)
     args = p.parse_args()

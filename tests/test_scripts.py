@@ -1,0 +1,124 @@
+"""Smoke tests for the driver / sweep / plotting / reproduce tooling."""
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+THROUGHPUTS = os.path.join(REPO, "data", "tacc_throughputs.json")
+
+
+def run_script(args, timeout=600):
+    out = subprocess.run([sys.executable, *args], capture_output=True,
+                         text=True, timeout=timeout, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout
+
+
+def fake_metrics(makespan=1000.0, n=10):
+    return {
+        "makespan": makespan,
+        "avg_jct": makespan / 2,
+        "geometric_mean_jct": makespan / 3,
+        "jct_list": [makespan / 2 + 10 * i for i in range(n)],
+        "finish_time_fairness_list": [0.8 + 0.05 * i for i in range(n)],
+        "finish_time_fairness_themis_list": [0.9 + 0.05 * i for i in range(n)],
+        "cluster_util": 0.7,
+        "utilization_list": [0.5, 0.7, 0.9],
+        "extension_percentage": 42.0,
+        "per_round_schedule": [{0: (0,), 1: (1, 2)}, {1: (1, 2)}],
+    }
+
+
+class TestGeneratedJobsDriver:
+    def test_runs_and_reports(self):
+        out = run_script(["scripts/drivers/simulate_generated.py",
+                          "--num_jobs", "8", "--policy", "isolated",
+                          "--throughputs", THROUGHPUTS,
+                          "--cluster_spec", "v100:8",
+                          "--round_duration", "120"])
+        result = json.loads(out.strip().splitlines()[-1])
+        assert result["makespan"] > 0
+        assert result["num_jobs"] == 8
+
+    def test_seeded_determinism(self):
+        args = ["scripts/drivers/simulate_generated.py", "--num_jobs", "6",
+                "--policy", "fifo", "--throughputs", THROUGHPUTS,
+                "--cluster_spec", "v100:4", "--round_duration", "120",
+                "--seed", "7"]
+        a = json.loads(run_script(args).strip().splitlines()[-1])
+        b = json.loads(run_script(args).strip().splitlines()[-1])
+        assert a == b
+
+
+class TestPolicyRuntimeSweep:
+    def test_all_default_policies_solve(self):
+        out = run_script(["scripts/microbenchmarks/sweep_policy_runtimes.py",
+                          "--num_jobs", "8", "--cluster_sizes", "8",
+                          "--trials", "1"])
+        rows = [json.loads(line) for line in out.strip().splitlines()]
+        assert len(rows) == 8  # default policy list
+        assert all("best_s" in r for r in rows)
+
+    def test_multi_worker_types(self):
+        out = run_script(["scripts/microbenchmarks/sweep_policy_runtimes.py",
+                          "--policies", "max_min_fairness_perf",
+                          "--num_jobs", "8", "--cluster_sizes", "6",
+                          "--num_worker_types", "3", "--trials", "1"])
+        assert "best_s" in out
+
+
+class TestPlotting:
+    def test_all_plot_kinds(self, tmp_path):
+        from shockwave_tpu import plotting
+        results = {"a": fake_metrics(1000.0), "b": fake_metrics(1500.0)}
+        assert os.path.exists(plotting.plot_jct_cdf(
+            results, str(tmp_path / "jct.png")))
+        assert os.path.exists(plotting.plot_ftf_cdf(
+            results, str(tmp_path / "ftf.png")))
+        assert os.path.exists(plotting.plot_policy_bars(
+            results, str(tmp_path / "bars.png")))
+        assert os.path.exists(plotting.plot_utilization(
+            results, str(tmp_path / "util.png")))
+        assert os.path.exists(plotting.plot_schedule_heatmap(
+            fake_metrics(), str(tmp_path / "heat.png")))
+
+
+class TestReproduceTooling:
+    def test_aggregate_result(self, tmp_path):
+        for policy in ("shockwave", "max_min_fairness"):
+            with open(tmp_path / f"{policy}.pkl", "wb") as f:
+                pickle.dump(fake_metrics(), f)
+        out = run_script(["reproduce/aggregate_result.py", str(tmp_path)])
+        assert "Shockwave" in out and "Gavel" in out
+
+    def test_fidelity_pass_and_fail(self, tmp_path):
+        phys, sim = tmp_path / "p.pkl", tmp_path / "s.pkl"
+        with open(phys, "wb") as f:
+            pickle.dump(fake_metrics(1000.0), f)
+        with open(sim, "wb") as f:
+            pickle.dump(fake_metrics(1040.0), f)
+        out = run_script(["reproduce/analyze_fidelity.py", str(phys),
+                          str(sim), "--tolerance", "0.10"])
+        assert "within tolerance" in out
+        bad = subprocess.run(
+            [sys.executable, "reproduce/analyze_fidelity.py", str(phys),
+             str(sim), "--tolerance", "0.01"],
+            capture_output=True, text=True, cwd=REPO)
+        assert bad.returncode == 1
+
+
+@pytest.mark.slow
+class TestProfiler:
+    def test_profiles_lm(self, tmp_path):
+        out_path = tmp_path / "oracle.json"
+        run_script(["scripts/profiling/measure_throughput.py",
+                    "--worker_type", "test", "--output", str(out_path),
+                    "--families", "LM", "--scale_factors", "1",
+                    "--steps", "3", "--warmup", "1"], timeout=1200)
+        from shockwave_tpu.core.oracle import read_throughputs
+        oracle = read_throughputs(str(out_path))
+        assert oracle["test"][("LM (batch size 5)", 1)]["null"] > 0
