@@ -26,23 +26,46 @@ def sinusoidal_positions(length: int, dim: int) -> np.ndarray:
 
 
 class MultiHeadAttention(nn.Module):
+    """Attention expressed as (causal, key_padding_mask) so it can lower
+    to the fused Pallas flash-attention kernel (ops/flash_attention.py)
+    when `use_flash`; otherwise einsum attention that XLA maps to the MXU.
+    """
     num_heads: int
     dim: int
     dtype: Any = jnp.bfloat16
+    use_flash: bool = False
 
     @nn.compact
-    def __call__(self, q_in, kv_in, mask: Optional[jnp.ndarray] = None):
+    def __call__(self, q_in, kv_in, causal: bool = False,
+                 key_padding_mask: Optional[jnp.ndarray] = None):
         head_dim = self.dim // self.num_heads
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
             (self.num_heads, head_dim), axis=-1, dtype=self.dtype, name=name)
         q = dense("query")(q_in)
         k = dense("key")(kv_in)
         v = dense("value")(kv_in)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim)
-        if mask is not None:
-            scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
-        weights = nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        tq, tk = q.shape[1], k.shape[1]
+        # Pallas VMEM blocks need the second-minor dim on the sublane tile
+        # (16 for bf16, 8 for f32); unaligned lengths fall back to einsum.
+        align = 16 if self.dtype == jnp.bfloat16 else 8
+        flash_ok = (self.use_flash and not (causal and tq != tk)
+                    and tq % align == 0 and tk % align == 0)
+        if flash_ok:
+            from ..ops import flash_attention
+            out = flash_attention(q, k, v, causal=causal,
+                                  key_padding_mask=key_padding_mask)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim)
+            if causal:
+                cmask = jnp.tril(jnp.ones((tq, tk), bool))[None, None]
+                scores = jnp.where(cmask, scores,
+                                   jnp.finfo(jnp.float32).min)
+            if key_padding_mask is not None:
+                kmask = key_padding_mask[:, None, None, :]
+                scores = jnp.where(kmask, scores,
+                                   jnp.finfo(jnp.float32).min)
+            weights = nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
         return nn.DenseGeneral(self.dim, axis=(-2, -1), dtype=self.dtype,
                                name="out")(out)
 
@@ -53,16 +76,20 @@ class TransformerLayer(nn.Module):
     mlp_dim: int
     decoder: bool = False
     dtype: Any = jnp.bfloat16
+    use_flash: bool = False
 
     @nn.compact
-    def __call__(self, x, enc_out=None, self_mask=None, cross_mask=None):
+    def __call__(self, x, enc_out=None, self_padding=None,
+                 cross_padding=None):
+        attn = lambda name: MultiHeadAttention(  # noqa: E731
+            self.num_heads, self.dim, self.dtype, self.use_flash, name=name)
         y = nn.LayerNorm(dtype=jnp.float32)(x)
-        x = x + MultiHeadAttention(self.num_heads, self.dim, self.dtype,
-                                   name="self_attn")(y, y, self_mask)
+        x = x + attn("self_attn")(y, y, causal=self.decoder,
+                                  key_padding_mask=self_padding)
         if self.decoder:
             y = nn.LayerNorm(dtype=jnp.float32)(x)
-            x = x + MultiHeadAttention(self.num_heads, self.dim, self.dtype,
-                                       name="cross_attn")(y, enc_out, cross_mask)
+            x = x + attn("cross_attn")(y, enc_out,
+                                       key_padding_mask=cross_padding)
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         y = nn.Dense(self.mlp_dim, dtype=self.dtype)(y)
         y = nn.gelu(y)
@@ -78,6 +105,7 @@ class Seq2SeqTransformer(nn.Module):
     mlp_dim: int = 2048
     max_len: int = 64
     dtype: Any = jnp.bfloat16
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, src_tokens, tgt_tokens):
@@ -88,23 +116,25 @@ class Seq2SeqTransformer(nn.Module):
 
         src = embed(src_tokens).astype(self.dtype)
         src = src + positions[: src_tokens.shape[1]]
-        src_mask = (src_tokens != 0)[:, None, None, :]
+        src_padding = src_tokens != 0
         for i in range(self.num_layers):
             src = TransformerLayer(self.num_heads, self.dim, self.mlp_dim,
-                                   dtype=self.dtype, name=f"enc_{i}")(
-                src, self_mask=src_mask)
+                                   dtype=self.dtype,
+                                   use_flash=self.use_flash,
+                                   name=f"enc_{i}")(
+                src, self_padding=src_padding)
         src = nn.LayerNorm(dtype=jnp.float32, name="enc_norm")(src)
 
         tgt = embed(tgt_tokens).astype(self.dtype)
         tgt = tgt + positions[: tgt_tokens.shape[1]]
-        tgt_len = tgt_tokens.shape[1]
-        causal = jnp.tril(jnp.ones((tgt_len, tgt_len), bool))[None, None]
-        tgt_mask = causal & (tgt_tokens != 0)[:, None, None, :]
+        tgt_padding = tgt_tokens != 0
         for i in range(self.num_layers):
             tgt = TransformerLayer(self.num_heads, self.dim, self.mlp_dim,
                                    decoder=True, dtype=self.dtype,
+                                   use_flash=self.use_flash,
                                    name=f"dec_{i}")(
-                tgt, enc_out=src, self_mask=tgt_mask, cross_mask=src_mask)
+                tgt, enc_out=src, self_padding=tgt_padding,
+                cross_padding=src_padding)
         tgt = nn.LayerNorm(dtype=jnp.float32, name="dec_norm")(tgt)
         # Tied output projection (-proj_share_weight).
         logits = jnp.einsum("bld,vd->blv", tgt.astype(jnp.float32),

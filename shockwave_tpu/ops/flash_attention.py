@@ -1,0 +1,277 @@
+"""Fused flash attention as a Pallas TPU kernel.
+
+Forward pass is a blocked online-softmax kernel: the grid walks
+(batch*heads, q-block, k-block) with the k-block dimension innermost, so
+the f32 accumulator and running max/normalizer live in VMEM scratch
+across k-steps and the full (T x T) score matrix never materializes in
+HBM. Scores hit the MXU via `jnp.dot(..., preferred_element_type=f32)`.
+
+Backward recomputes the (m, l) softmax statistics and the attention
+probabilities blockwise with `lax.scan` in plain JAX — per-step
+transients are O(BH * Tq * block_k), never the full score matrix —
+using the standard flash-attention gradient formulas (Dao et al. '22).
+
+The single-chip complement to parallel/ring_attention.py (which shards
+the sequence across chips); the reference has no attention kernel at all
+(vanilla torch softmax attention, workloads/pytorch/translation/
+transformer/SubLayers.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, kmask_ref, o_ref, m_scr, l_scr,
+               acc_scr, *, scale: float, causal: bool, block_q: int,
+               block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # With causal masking, k-blocks strictly above the diagonal contribute
+    # nothing; skip their FLOPs entirely.
+    should_run = True
+    if causal:
+        should_run = ki * block_k <= qi * block_q + (block_q - 1)
+
+    @pl.when(should_run)
+    def _step():
+        q = q_ref[0]  # (block_q, d)
+        k = k_ref[0]  # (block_k, d)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        # Key-padding mask: kmask_ref is (1, block_k) with 1 = attend.
+        s = jnp.where(kmask_ref[:] > 0, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                       # (block_q, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                      # (block_q, block_k)
+        correction = jnp.exp(m_prev - m_new)        # (block_q, 1)
+        l_new = l_scr[:, :1] * correction + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]  # (block_q, 1)
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pad_axis(x, axis: int, to: int):
+    pad = (-x.shape[axis]) % to
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _forward_impl(q, k, v, kv_mask, scale, causal, block_q, block_k,
+                  interpret):
+    """q: (BH, Tq, D); k,v: (BH, Tk, D); kv_mask: (BH, Tk) int8."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq, nk = tq // block_q, tk // block_k
+    grid = (bh, nq, nk)
+
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running normalizer
+            pltpu.VMEM((block_q, d), jnp.float32),      # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v, kv_mask)
+    return out
+
+
+def _blockwise_stats(q, k, kv_mask, scale, causal, block_k):
+    """Recompute per-row (m, l) softmax statistics with the same blocked
+    online-softmax recurrence as the forward kernel, so the transient is
+    O(BH * Tq * block_k), never the full score matrix."""
+    tq = q.shape[1]
+    tk = k.shape[1]
+    nk = tk // block_k
+
+    def per_bh(qb, kb, maskb):
+        kb_blocks = kb.reshape(nk, block_k, -1)
+        mask_blocks = maskb.reshape(nk, block_k)
+
+        def body(carry, blk):
+            m, l = carry
+            kj, maskj, j = blk
+            s = (qb @ kj.T).astype(jnp.float32) * scale
+            if causal:
+                q_pos = lax.broadcasted_iota(jnp.int32, (tq, block_k), 0)
+                k_pos = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (tq, block_k), 1)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = jnp.where(maskj[None, :] > 0, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1))
+            l = l * jnp.exp(m - m_new) + jnp.sum(
+                jnp.exp(s - m_new[:, None]), axis=1)
+            return (m_new, l), None
+
+        (m, l), _ = lax.scan(
+            body,
+            (jnp.full((tq,), NEG_INF, jnp.float32),
+             jnp.zeros((tq,), jnp.float32)),
+            (kb_blocks, mask_blocks, jnp.arange(nk)))
+        return m, l
+
+    return jax.vmap(per_bh)(q.astype(jnp.float32), k, kv_mask)
+
+
+def _backward_impl(q, k, v, kv_mask, out, g, scale, causal, block_k):
+    """Flash-attention gradients by blockwise recompute (Dao et al.)."""
+    bh, t, d = q.shape
+    tk = k.shape[1]
+    if causal:
+        assert q.shape[1] == k.shape[1], "causal requires Tq == Tk"
+    m, l = _blockwise_stats(q, k, kv_mask, scale, causal, block_k)
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
+
+    nk = tk // block_k
+    q32, g32 = q.astype(jnp.float32), g.astype(jnp.float32)
+
+    def per_bh(qb, kb, vb, gb, mb, lb, db, maskb):
+        kb_blocks = kb.reshape(nk, block_k, d)
+        vb_blocks = vb.reshape(nk, block_k, d)
+        mask_blocks = maskb.reshape(nk, block_k)
+
+        def body(dq, blk):
+            kj, vj, maskj, j = blk
+            s = (qb @ kj.T).astype(jnp.float32) * scale  # (T, block_k)
+            if causal:
+                q_pos = lax.broadcasted_iota(jnp.int32, (t, block_k), 0)
+                k_pos = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (t, block_k), 1)
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = jnp.where(maskj[None, :] > 0, s, NEG_INF)
+            p = jnp.exp(s - mb[:, None]) / jnp.maximum(lb, 1e-30)[:, None]
+            dp = gb @ vj.T.astype(jnp.float32)           # (T, block_k)
+            ds = p * (dp - db[:, None]) * scale          # (T, block_k)
+            dq = dq + ds @ kj.astype(jnp.float32)
+            dkj = ds.T @ qb.astype(jnp.float32)          # (block_k, d)
+            dvj = p.T @ gb                               # (block_k, d)
+            return dq, (dkj, dvj)
+
+        dq, (dk_blocks, dv_blocks) = lax.scan(
+            body, jnp.zeros((t, d), jnp.float32),
+            (kb_blocks, vb_blocks, mask_blocks, jnp.arange(nk)))
+        return dq, dk_blocks.reshape(tk, d), dv_blocks.reshape(tk, d)
+
+    dq, dk, dv = jax.vmap(per_bh)(q32, k, v, g32, m, l, delta, kv_mask)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_bhtd(q, k, v, kv_mask, scale, causal, block_q, block_k):
+    interpret = jax.default_backend() != "tpu"
+    return _forward_impl(q, k, v, kv_mask, scale, causal, block_q, block_k,
+                         interpret)
+
+
+def _flash_bhtd_fwd(q, k, v, kv_mask, scale, causal, block_q, block_k):
+    out = _flash_bhtd(q, k, v, kv_mask, scale, causal, block_q, block_k)
+    return out, (q, k, v, kv_mask, out)
+
+
+def _flash_bhtd_bwd(scale, causal, block_q, block_k, residuals, g):
+    q, k, v, kv_mask, out = residuals
+    dq, dk, dv = _backward_impl(q, k, v, kv_mask, out, g, scale, causal,
+                                block_k)
+    return dq, dk, dv, None
+
+
+_flash_bhtd.defvjp(_flash_bhtd_fwd, _flash_bhtd_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    key_padding_mask: Optional[jnp.ndarray] = None,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Fused attention for (batch, seq, heads, head_dim) inputs.
+
+    head_dim is zero-padded to the 128-lane tile (zero columns change
+    neither scores nor the sliced-away output dims). Sequence lengths must
+    be divisible by the block size (shrunk to T for short sequences); mask
+    ragged sequences upstream. key_padding_mask is (B, Tk) with True =
+    attend. Cross-attention (Tq != Tk) is supported for causal=False.
+    Runs the Pallas TPU kernel on TPU and the Pallas interpreter elsewhere
+    (tests/CI on CPU).
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if causal and tq != tk:
+        raise ValueError("causal flash attention requires Tq == Tk")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(
+            f"flash_attention requires seq lens divisible by the block "
+            f"size; got Tq={tq}, Tk={tk}, blocks=({block_q}, {block_k})")
+
+    def to_bhtd(x):
+        t = x.shape[1]
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, -1)
+        return _pad_axis(x, 2, LANES)
+
+    qf, kf, vf = to_bhtd(q), to_bhtd(k), to_bhtd(v)
+    if key_padding_mask is None:
+        kv_mask = jnp.ones((b, tk), jnp.int8)
+    else:
+        kv_mask = key_padding_mask.astype(jnp.int8)  # (B, Tk), 1 = attend
+    kv_mask = jnp.repeat(kv_mask, h, axis=0)  # (B*H, Tk), head-major rows
+    out = _flash_bhtd(qf, kf, vf, kv_mask, float(scale), causal,
+                      block_q, block_k)
+    out = out[:, :tq, :d].reshape(b, h, tq, d)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

@@ -6,6 +6,7 @@ CLI parity with the reference's translation train.py — the trace command
 is `python3 train.py -data %s/... -batch_size N -proj_share_weight` with
 `-step` appended by the dispatcher.
 """
+import argparse
 import os
 import sys
 
@@ -25,9 +26,15 @@ def main():
     p.add_argument("-data", dest="data", default=None)
     p.add_argument("-batch_size", dest="batch_size", type=int, default=64)
     p.add_argument("-proj_share_weight", action="store_true")
+    p.add_argument("--use_flash", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="fused pallas attention (default: on for TPU; "
+                        "--no-use_flash forces the einsum path)")
     args = p.parse_args()
 
-    model = Seq2SeqTransformer()
+    use_flash = (jax.default_backend() == "tpu"
+                 if args.use_flash is None else args.use_flash)
+    model = Seq2SeqTransformer(use_flash=use_flash)
     rng = jax.random.PRNGKey(0)
     src = jnp.zeros((1, 32), jnp.int32)
     variables = model.init(rng, src, src)
