@@ -233,7 +233,7 @@ class PhysicalScheduler(Scheduler):
                 if (job_id not in self.rounds.current_assignments
                         and self.rounds.next_assignments is not None
                         and job_id not in self.rounds.next_assignments):
-                    logger.warning("discarding completion for unscheduled job %s",
+                    self.log.warning("discarding completion for unscheduled job %s",
                                    job_id)
                     return
                 self._cv.wait()
@@ -351,10 +351,10 @@ class PhysicalScheduler(Scheduler):
                 self._max_steps_consensus[m] = None
         for job_id, worker_ids in self._redispatch_assignments.items():
             if any(m in self.acct.jobs for m in job_id.singletons()):
-                logger.info("re-dispatching early-finished job %s", job_id)
+                self.log.info("re-dispatching early-finished job %s", job_id)
                 self._try_dispatch_job(job_id, worker_ids)
         self._redispatch_assignments = collections.OrderedDict()
-        logger.info("*** START ROUND %d ***", self.rounds.num_completed_rounds)
+        self.log.info("*** START ROUND %d ***", self.rounds.num_completed_rounds)
 
     def _is_final_round(self):
         return (self._config.max_rounds is not None
@@ -452,7 +452,7 @@ class PhysicalScheduler(Scheduler):
             collections.OrderedDict())
         self.rounds.next_assignments = None
         self._cv.notify_all()
-        logger.info("*** END ROUND %d ***", self.rounds.num_completed_rounds - 1)
+        self.log.info("*** END ROUND %d ***", self.rounds.num_completed_rounds - 1)
 
     def _kill_job(self, job_id: JobIdPair):
         with self._cv:
@@ -462,7 +462,7 @@ class PhysicalScheduler(Scheduler):
                 if (job_id in self.rounds.completed_in_round
                         and job_id not in self.rounds.extended_leases):
                     return
-            logger.warning("killing unresponsive job %s", job_id)
+            self.log.warning("killing unresponsive job %s", job_id)
             worker_ids = self.rounds.current_assignments[job_id]
             servers = set()
             for worker_id in worker_ids:

@@ -32,6 +32,24 @@ from .state import JobAccounting, RoundState, WorkerState
 
 logger = logging.getLogger("shockwave_tpu.sched")
 
+class SchedulerClockAdapter(logging.LoggerAdapter):
+    """Prefixes every message with the scheduler clock — simulated seconds
+    in simulation, wall-clock offset in physical mode (reference:
+    scheduler/custom_logging.py SchedulerAdapter)."""
+
+    def process(self, msg, kwargs):
+        sched = self.extra["scheduler"]
+        try:
+            # Rebase physical wall-clock to run start; simulation time
+            # already starts at zero.
+            ts = (sched.get_current_timestamp()
+                  - getattr(sched, "_start_time", 0.0))
+        except Exception:  # noqa: BLE001 - never let logging raise
+            ts = 0.0
+        return f"[{ts:11.2f}] {msg}", kwargs
+
+
+
 INFINITY = int(1e9)
 DEFAULT_THROUGHPUT = 1.0
 EMA_ALPHA = 0.5
@@ -66,6 +84,7 @@ class Scheduler:
                  config: Optional[SchedulerConfig] = None):
         self._policy = policy
         self._simulate = simulate
+        self.log = SchedulerClockAdapter(logger, {"scheduler": self})
         self._job_packing = "Packing" in policy.name
         self._config = config or SchedulerConfig()
         self._time_per_iteration = self._config.time_per_iteration
@@ -191,7 +210,7 @@ class Scheduler:
         else:
             self._throughput_timeline[job_id.integer_job_id()] = collections.OrderedDict()
 
-        logger.info("[Job dispatched] job %s (%s, sf=%d, mode=%s)",
+        self.log.info("[Job dispatched] job %s (%s, sf=%d, mode=%s)",
                     job_id, job.job_type, job.scale_factor, job.mode)
         return job_id
 
@@ -226,7 +245,7 @@ class Scheduler:
             self._shockwave_job_completed = True
         self._remove_from_priorities(job_id)
         self._need_to_update_allocation = True
-        logger.info("[Job completed] job %s after %.1fs (%d active)",
+        self.log.info("[Job completed] job %s after %.1fs (%d active)",
                     job_id, duration, len(a.jobs))
 
     # ------------------------------------------------------------------
@@ -281,7 +300,7 @@ class Scheduler:
             # policy; seed from the trace's expected rate and let the EMA
             # learn the real value.
             nominal = job.total_steps / max(float(job.duration), 1.0)
-            logger.warning("zero oracle throughput for %s on %s; seeding "
+            self.log.warning("zero oracle throughput for %s on %s; seeding "
                            "%.4f steps/s from expected duration", key,
                            worker_type, nominal)
             self._throughputs[job_id][worker_type] = nominal
@@ -293,7 +312,7 @@ class Scheduler:
         else:
             # Unprofiled hardware (e.g. a TPU worker against a GPU-profiled
             # oracle): start from the default and let the EMA learn it.
-            logger.warning("no profiled throughput for %s on %s; starting "
+            self.log.warning("no profiled throughput for %s on %s; starting "
                            "from default and learning online", key, worker_type)
             self._throughputs[job_id][worker_type] = DEFAULT_THROUGHPUT
 
@@ -495,7 +514,7 @@ class Scheduler:
             for int_id in job_ids:
                 job_id = JobIdPair(int_id)
                 if job_id not in self.acct.jobs:
-                    logger.warning("job %s in round schedule but completed", int_id)
+                    self.log.warning("job %s in round schedule but completed", int_id)
                     continue
                 sf = self.acct.jobs[job_id].scale_factor
                 for wt in worker_types:
@@ -504,7 +523,7 @@ class Scheduler:
                         capacity[wt] -= sf
                         break
                 else:
-                    logger.warning("no capacity for planned job %s (sf=%d)",
+                    self.log.warning("no capacity for planned job %s (sf=%d)",
                                    int_id, sf)
             return scheduled
 
@@ -730,7 +749,7 @@ class Scheduler:
         # mode can learn unprofiled types online.
         needed = (len(self.workers.worker_types) if self._simulate else 1)
         if self._oracle_throughputs is not None and len(profiled_types) < needed:
-            logger.error("job %s requested unprofiled bs %s; reverting",
+            self.log.error("job %s requested unprofiled bs %s; reverting",
                          job_id, key)
             job.update_bs(old_bs)
             flags["big_bs"] = flags["small_bs"] = False
@@ -755,7 +774,7 @@ class Scheduler:
         self.acct.total_steps_run[job_id] = new_steps_run
         for wt in self.acct.steps_run[job_id]:
             self.acct.steps_run[job_id][wt] = new_steps_run
-        logger.info("[BS rescale] job %s: bs %d->%d, steps -> %d",
+        self.log.info("[BS rescale] job %s: bs %d->%d, steps -> %d",
                     job_id, old_bs, new_bs, new_total_steps)
         flags["big_bs"] = flags["small_bs"] = False
 
@@ -816,11 +835,11 @@ class Scheduler:
                 agg_times[j] = max(agg_times[j], times_u[j])
 
         if not micro_task_succeeded:
-            logger.info("[Micro-task failed] job %s", job_id)
+            self.log.info("[Micro-task failed] job %s", job_id)
             if not job_id.is_pair() and is_active[job_id]:
                 a.failures[job_id] += 1
                 if a.failures[job_id] >= MAX_FAILED_ATTEMPTS:
-                    logger.info("[Job failed] job %s dropped after %d attempts",
+                    self.log.info("[Job failed] job %s dropped after %d attempts",
                                 job_id, a.failures[job_id])
                     to_remove.append(job_id)
             self._need_to_update_allocation = True
@@ -912,7 +931,7 @@ class Scheduler:
                 "remaining_jobs": remaining_jobs,
                 "current_round": current_round,
             }, f)
-        logger.info("Saved simulation checkpoint to %s (round %d, %d jobs left)",
+        self.log.info("Saved simulation checkpoint to %s (round %d, %d jobs left)",
                     path, current_round, remaining_jobs)
 
     def _load_simulation_checkpoint(self, path: str):
@@ -993,7 +1012,7 @@ class Scheduler:
                     - self._config.minimum_time_between_allocation_resets)
                 self._need_to_update_allocation = True
             else:
-                logger.warning("no running jobs and no arrivals; stopping")
+                self.log.warning("no running jobs and no arrivals; stopping")
                 break
 
             # Drain jobs finishing this round.
@@ -1092,7 +1111,7 @@ class Scheduler:
                     and self.rounds.num_completed_rounds >= self._config.max_rounds):
                 break
 
-        logger.info("Simulation done: makespan %.1fs (%.2fh)",
+        self.log.info("Simulation done: makespan %.1fs (%.2fh)",
                     self._current_timestamp, self._current_timestamp / 3600)
         return self._current_timestamp
 
