@@ -745,17 +745,25 @@ class Scheduler:
             wt for wt in self.workers.worker_types
             if key in (self._oracle_throughputs or {}).get(wt, {})]
         # Simulation has no way to measure the new batch size on worker
-        # types the oracle missed, so require full coverage there; physical
-        # mode can learn unprofiled types online.
-        needed = (len(self.workers.worker_types) if self._simulate else 1)
-        if self._oracle_throughputs is not None and len(profiled_types) < needed:
+        # types the oracle missed, so require full coverage there. Physical
+        # mode learns online: unprofiled types (e.g. TPU workers against a
+        # GPU-profiled oracle) get a seed extrapolated from the measured
+        # throughput (steps/s roughly inversely proportional to bs) and the
+        # EMA corrects it from the next round's report.
+        if self._simulate and self._oracle_throughputs is not None \
+                and len(profiled_types) < len(self.workers.worker_types):
             self.log.error("job %s requested unprofiled bs %s; reverting",
                          job_id, key)
             job.update_bs(old_bs)
             flags["big_bs"] = flags["small_bs"] = False
             return
-        for wt in profiled_types:
-            self._throughputs[job_id][wt] = self._oracle_throughputs[wt][key]["null"]
+        for wt in self.workers.worker_types:
+            if wt in profiled_types:
+                self._throughputs[job_id][wt] = \
+                    self._oracle_throughputs[wt][key]["null"]
+            else:
+                measured = self._throughputs[job_id].get(wt, DEFAULT_THROUGHPUT)
+                self._throughputs[job_id][wt] = measured * old_bs / new_bs
         if self._job_packing:
             # Pair entries are keyed by job_type and are now stale.
             self._populate_pair_throughputs(job_id)

@@ -233,3 +233,62 @@ class TestPhysicalRounds:
             sched._done_event.set()
             worker.stop()
             sched._server.stop(grace=0)
+
+    def test_accordion_rescale_through_rpc(self):
+        """UpdateResourceRequirement -> done -> bs rescale -> redispatch at
+        the new batch size (the physical half of dynamic adaptation)."""
+        sched_port = free_port()
+        worker_port = free_port()
+        policy = get_policy("max_min_fairness")
+        sched = PhysicalScheduler(
+            policy, throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=2.0, max_rounds=4),
+            expected_num_workers=1, port=sched_port)
+
+        seen_bs = []
+
+        class AdaptiveStub(StubWorkerDaemon):
+            def _run_job(self, jobs, worker_id, round_id):
+                def execute():
+                    try:
+                        for j in jobs:
+                            seen_bs.append(j["command"].rsplit(" ", 1)[-1])
+                            it = IteratorToSchedulerClient(
+                                j["job_id"], worker_id, "localhost",
+                                self.sched_port)
+                            it.init()
+                            if round_id == 0:
+                                # First run discovers it can use the max bs.
+                                it.update_resource_requirement(big_bs=True,
+                                                               small_bs=False)
+                        time.sleep(self.execution_time)
+                        self._client.notify_done(
+                            [j["job_id"] for j in jobs], worker_id,
+                            [60] * len(jobs),
+                            [self.execution_time] * len(jobs))
+                    except Exception:  # noqa: BLE001 - teardown race
+                        pass
+                threading.Thread(target=execute, daemon=True).start()
+
+        worker = AdaptiveStub(sched_port, worker_port, num_chips=1,
+                              throughput=100.0)
+        try:
+            job = Job(None, "ResNet-18 (batch size 32)",
+                      "python3 main.py --batch_size 32",
+                      "image_classification/cifar10", "--num_steps",
+                      total_steps=100000, duration=10000, mode="accordion")
+            sched.add_job(job)
+            runner = threading.Thread(target=sched.run, daemon=True)
+            runner.start()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if "256" in seen_bs:
+                    break
+                time.sleep(0.2)
+            assert seen_bs and seen_bs[0] == "32"
+            assert "256" in seen_bs, f"no rescaled dispatch seen: {seen_bs}"
+            assert sched.acct.jobs[JobIdPair(0)].batch_size == 256
+        finally:
+            sched._done_event.set()
+            worker.stop()
+            sched._server.stop(grace=0)
