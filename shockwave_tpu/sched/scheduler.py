@@ -72,6 +72,9 @@ class SchedulerConfig:
     shockwave: Optional[dict] = None
     # Per-worker-type $/hour, for cost-normalized policies.
     per_worker_type_prices: Optional[Dict[str, float]] = None
+    # Physical-mode deadlock watchdog: dump all thread tracebacks every
+    # N seconds (reference: faulthandler at scheduler.py:451-455).
+    watchdog_interval: Optional[float] = None
 
 
 class Scheduler:

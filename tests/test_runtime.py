@@ -292,3 +292,72 @@ class TestPhysicalRounds:
             sched._done_event.set()
             worker.stop()
             sched._server.stop(grace=0)
+
+    def test_gang_job_consensus_and_completion(self):
+        """A scale_factor=2 job is gang-dispatched to both chips; the two
+        ranks' lease renewals agree on one step budget (first-requester-
+        computes) and the job completes from aggregated reports."""
+        sched_port = free_port()
+        worker_port = free_port()
+        policy = get_policy("max_min_fairness")
+        sched = PhysicalScheduler(
+            policy, throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=2.0, max_rounds=3),
+            expected_num_workers=2, port=sched_port)
+
+        consensus_budgets = []
+        commands = []
+
+        class GangStub(StubWorkerDaemon):
+            def _run_job(self, jobs, worker_id, round_id):
+                def execute():
+                    try:
+                        for j in jobs:
+                            commands.append(j["command"])
+                            it = IteratorToSchedulerClient(
+                                j["job_id"], worker_id, "localhost",
+                                self.sched_port)
+                            it.init()
+                            max_steps, _, _, _ = it.update_lease(
+                                steps=40, duration=0.5, max_steps=10**9,
+                                max_duration=10**9)
+                            consensus_budgets.append(max_steps)
+                        time.sleep(self.execution_time)
+                        self._client.notify_done(
+                            [j["job_id"] for j in jobs], worker_id,
+                            [75] * len(jobs),
+                            [self.execution_time] * len(jobs))
+                    except Exception:  # noqa: BLE001 - teardown race
+                        pass
+                threading.Thread(target=execute, daemon=True).start()
+
+        worker = GangStub(sched_port, worker_port, num_chips=2,
+                          throughput=100.0)
+        try:
+            # 150 total steps over 2 chips: each rank reports 75.
+            job = Job(None, "ResNet-18 (batch size 32)",
+                      "python3 main.py --batch_size 32",
+                      "image_classification/cifar10", "--num_steps",
+                      total_steps=150, duration=10000, scale_factor=2)
+            sched.add_job(job)
+            runner = threading.Thread(target=sched.run, daemon=True)
+            runner.start()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if len(sched._completed_jobs) == 1:
+                    break
+                time.sleep(0.2)
+            assert len(sched._completed_jobs) == 1, "gang job did not complete"
+            # Both ranks were dispatched with rendezvous info.
+            assert len(commands) >= 2
+            assert all("--coordinator" in c and "--num_processes 2" in c
+                       for c in commands[:2])
+            ranks = sorted(int(c.rsplit("--process_id ", 1)[1].split()[0])
+                           for c in commands[:2])
+            assert ranks == [0, 1]
+            # First-requester-computes: both ranks got the same budget.
+            assert len(set(consensus_budgets[:2])) == 1
+        finally:
+            sched._done_event.set()
+            worker.stop()
+            sched._server.stop(grace=0)
