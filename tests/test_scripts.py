@@ -122,3 +122,18 @@ class TestProfiler:
         from shockwave_tpu.core.oracle import read_throughputs
         oracle = read_throughputs(str(out_path))
         assert oracle["test"][("LM (batch size 5)", 1)]["null"] > 0
+
+
+class TestGraftEntry:
+    def test_dryrun_multichip_with_unset_jax_platforms(self):
+        """The driver leaves JAX_PLATFORMS unset and an accelerator plugin
+        may auto-register via PYTHONPATH; the dryrun must still build its
+        8-device virtual CPU mesh (round-1/2 gate failure regression)."""
+        env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from __graft_entry__ import dryrun_multichip; "
+             "dryrun_multichip(8)"],
+            capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "dryrun_multichip(8)" in out.stdout
