@@ -29,9 +29,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 LANES = 128
+SUBLANES = 8  # f32 tile height: mask/bias operands pad to this
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, kmask_ref, o_ref, m_scr, l_scr,
+def _fa_kernel(q_ref, k_ref, v_ref, kbias_ref, o_ref, m_scr, l_scr,
                acc_scr, *, scale: float, causal: bool, block_q: int,
                block_k: int):
     qi = pl.program_id(1)
@@ -64,8 +65,10 @@ def _fa_kernel(q_ref, k_ref, v_ref, kmask_ref, o_ref, m_scr, l_scr,
             k_pos = ki * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        # Key-padding mask: kmask_ref is (1, block_k) with 1 = attend.
-        s = jnp.where(kmask_ref[:] > 0, s, NEG_INF)
+        # Key-padding bias: kbias_ref is a (1, SUBLANES, block_k) tile of
+        # 0.0 (attend) / NEG_INF (masked), replicated across sublanes so
+        # the block meets Mosaic's (8, 128) tiling; reduce one row out.
+        s = s + jnp.max(kbias_ref[0], axis=0, keepdims=True)
 
         m_prev = m_scr[:, :1]                       # (block_q, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -101,6 +104,12 @@ def _forward_impl(q, k, v, kv_mask, scale, causal, block_q, block_k,
     nq, nk = tq // block_q, tk // block_k
     grid = (bh, nq, nk)
 
+    # Mosaic requires operand blocks whose last two dims tile to (8, 128),
+    # so the (BH, Tk) key mask travels as a (BH, SUBLANES, Tk) f32 additive
+    # bias (0 = attend, NEG_INF = masked), replicated across sublanes.
+    kbias = jnp.where(kv_mask > 0, 0.0, NEG_INF).astype(jnp.float32)
+    kbias = jnp.broadcast_to(kbias[:, None, :], (bh, SUBLANES, tk))
+
     kernel = functools.partial(
         _fa_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k)
@@ -115,7 +124,7 @@ def _forward_impl(q, k, v, kv_mask, scale, causal, block_q, block_k,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b, j),
+            pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (b, 0, j),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
@@ -127,7 +136,7 @@ def _forward_impl(q, k, v, kv_mask, scale, causal, block_q, block_k,
             pltpu.VMEM((block_q, d), jnp.float32),      # output accumulator
         ],
         interpret=interpret,
-    )(q, k, v, kv_mask)
+    )(q, k, v, kbias)
     return out
 
 

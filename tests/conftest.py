@@ -64,3 +64,17 @@ def reference_utils():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def ambient_accelerator_env(*extra_drop):
+    """Subprocess env for children that should see the AMBIENT backend
+    (real accelerator if present) rather than conftest's forced-CPU pin:
+    drops JAX_PLATFORMS (and any extra keys) and prepends the repo root
+    to PYTHONPATH. Shared by every test that shells out to hardware."""
+    drop = {"JAX_PLATFORMS", *extra_drop}
+    env = {k: v for k, v in os.environ.items() if k not in drop}
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env

@@ -90,3 +90,25 @@ class TestTransformerFlashPath:
         out_flash = flash.apply({"params": params}, src, tgt)
         err = float(jnp.max(jnp.abs(out_base - out_flash)))
         assert err < 1e-4, err
+
+
+@pytest.mark.tpu
+class TestFlashTPU:
+    def test_hardware_parity(self):
+        """Run the fwd+bwd flash-vs-einsum parity script on the REAL TPU
+        backend, in a subprocess outside conftest's forced-CPU env."""
+        import os
+        import subprocess
+        import sys
+
+        from conftest import REPO_ROOT, ambient_accelerator_env
+
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "tests/tpu_flash_parity.py")],
+            capture_output=True, text=True, timeout=600,
+            env=ambient_accelerator_env())
+        if out.returncode == 75:
+            pytest.skip("no TPU backend available")
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert "ALL OK" in out.stdout

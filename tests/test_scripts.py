@@ -129,11 +129,12 @@ class TestGraftEntry:
         """The driver leaves JAX_PLATFORMS unset and an accelerator plugin
         may auto-register via PYTHONPATH; the dryrun must still build its
         8-device virtual CPU mesh (round-1/2 gate failure regression)."""
-        env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+        from conftest import ambient_accelerator_env
         out = subprocess.run(
             [sys.executable, "-c",
              "from __graft_entry__ import dryrun_multichip; "
              "dryrun_multichip(8)"],
-            capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
+            capture_output=True, text=True, timeout=600, cwd=REPO,
+            env=ambient_accelerator_env())
         assert out.returncode == 0, out.stderr[-2000:]
         assert "dryrun_multichip(8)" in out.stdout
