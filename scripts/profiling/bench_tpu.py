@@ -1,0 +1,171 @@
+#!/usr/bin/env python3
+"""Single-chip TPU benchmark phase for bench.py.
+
+Measures, on the real TPU backend:
+  1. The flagship Seq2SeqTransformer jitted train step — steps/s and
+     achieved MFU (model FLOPs from XLA cost analysis when available,
+     else an analytic 6*N*tokens estimate, against the chip's peak
+     bf16 FLOPs).
+  2. Fused Pallas flash attention vs the einsum attention path at long
+     sequence length — per-call latency and speedup.
+
+Prints ONE JSON line; exits 75 when no TPU backend is available so the
+caller can degrade gracefully (bench.py merges these fields into its
+headline JSON only when present).
+
+Reference counterpart: scheduler/scripts/profiling/measure_throughput.py
+grounds the reference in measured GPU numbers; this grounds the TPU
+build in measured v5e numbers.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+# Peak dense bf16 FLOPs/s per chip. v5e (TPU v5 lite): 197 TFLOP/s.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for name, peak in PEAK_FLOPS.items():
+        if kind.startswith(name):
+            return peak
+    return 197e12  # default to v5e if the kind string is unrecognized
+
+
+def timed(fn, *args, warmup=3, iters=20):
+    """Median-free simple timing: warmup, then wall-time `iters` calls
+    with a final block_until_ready so async dispatch can't lie."""
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - start) / iters
+
+
+def transformer_train_bench(batch=64, steps=30, warmup=5):
+    """Flagship model: full-size Seq2SeqTransformer train step."""
+    from shockwave_tpu.models.transformer import Seq2SeqTransformer
+
+    model = Seq2SeqTransformer(use_flash=True)
+    seq = model.max_len
+    rng = jax.random.PRNGKey(0)
+    src = jnp.ones((batch, seq), jnp.int32)
+    tgt = jnp.ones((batch, seq), jnp.int32)
+    params = model.init(rng, src[:1], tgt[:1])["params"]
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    def train_step(params, opt_state, src, tgt):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, src, tgt)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tgt).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    # FLOPs per step from XLA's own cost model where exposed. Lower and
+    # compile through `step` itself so the timed calls below hit this
+    # same executable in the jit cache instead of compiling twice.
+    flops = None
+    try:
+        compiled = step.lower(params, opt_state, src, tgt).compile()
+        analyses = compiled.cost_analysis()
+        analysis = analyses[0] if isinstance(analyses, (list, tuple)) \
+            else analyses
+        flops = float(analysis.get("flops", 0.0)) or None
+    except Exception:  # noqa: BLE001 - cost analysis is best-effort
+        flops = None
+    if flops is None:
+        n_params = sum(x.size for x in jax.tree.leaves(params))
+        flops = 6.0 * n_params * batch * seq  # fwd+bwd analytic estimate
+
+    loss = None
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, src, tgt)
+    jax.block_until_ready(loss)
+    start = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, src, tgt)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - start) / steps
+
+    mfu = flops / dt / peak_flops(jax.devices()[0])
+    return {
+        "transformer_steps_per_s": round(1.0 / dt, 2),
+        "transformer_batch": batch,
+        "transformer_seq_len": seq,
+        "transformer_flops_per_step": flops,
+        "transformer_mfu": round(mfu, 4),
+    }
+
+
+def attention_bench(b=4, t=2048, h=8, d=64):
+    """Flash kernel vs einsum attention at long sequence length."""
+    from shockwave_tpu.ops import flash_attention
+
+    rng = jax.random.PRNGKey(0)
+    ks = jax.random.split(rng, 3)
+    q = jax.random.normal(ks[0], (b, t, h, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, t, h, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, t, h, d), jnp.bfloat16)
+
+    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+
+    def einsum_attn(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(1.0 * d)
+        mask = jnp.tril(jnp.ones((t, t), bool))[None, None]
+        s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
+        w = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+    ein = jax.jit(einsum_attn)
+    t_flash = timed(flash, q, k, v)
+    t_ein = timed(ein, q, k, v)
+    return {
+        "flash_attn_ms": round(t_flash * 1e3, 3),
+        "einsum_attn_ms": round(t_ein * 1e3, 3),
+        "flash_speedup": round(t_ein / t_flash, 3),
+        "attn_shape": [b, t, h, d],
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--steps", type=int, default=30)
+    args = p.parse_args()
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skip": f"backend={jax.default_backend()}"}))
+        sys.exit(75)
+
+    result = {"device": jax.devices()[0].device_kind,
+              "peak_bf16_flops": peak_flops(jax.devices()[0])}
+    result.update(transformer_train_bench(batch=args.batch, steps=args.steps))
+    result.update(attention_bench())
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,12 @@ class Dispatcher:
             "SWTPU_ROUND_ID": str(round_id),
             "SWTPU_SCHED_ADDR": self._sched_addr,
             "SWTPU_SCHED_PORT": str(self._sched_port),
+            # Adaptation mode (static / accordion / gns): Trainer selects
+            # its batch-size monitor from this. The reference selects mode
+            # by dispatching from a different script tree per mode
+            # (runtime/rpc/dispatcher.py:385-390); here one tree serves
+            # all modes and the env var switches behavior.
+            "SWTPU_MODE": job.get("mode", "static") or "static",
             # Restrict the training process to its chip.
             "JAX_VISIBLE_DEVICES": str(chip_id),
             "TPU_VISIBLE_CHIPS": str(chip_id),

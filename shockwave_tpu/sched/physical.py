@@ -254,7 +254,8 @@ class PhysicalScheduler(Scheduler):
                 timer.cancel()
 
             super().done_callback(job_id, worker_id, all_num_steps,
-                                  all_execution_times)
+                                  all_execution_times,
+                                  iterator_logs=iterator_logs)
 
             for m in job_id.singletons():
                 self._lease_update_requests[m] = []
@@ -294,6 +295,7 @@ class PhysicalScheduler(Scheduler):
                           next_round: bool = False):
         if not next_round or job_id not in self.rounds.current_assignments:
             self._in_progress_updates[job_id] = []
+            self._iterator_log_buffers.pop(job_id, None)
             for m in job_id.singletons():
                 self._lease_update_requests[m] = []
                 self._max_steps_consensus[m] = None
@@ -505,8 +507,14 @@ class PhysicalScheduler(Scheduler):
             # reference's "no renewal this round => dead" rule
             # (scheduler.py:4313-4339) produces spurious kills here.
             now = self.get_current_timestamp()
-            oldest = min(self._last_heartbeat.get(m, 0.0)
-                         for m in job_id.singletons())
+            # Only live members count, and a missing stamp defaults to
+            # `now`, not 0: when one job of a packed pair has already
+            # completed (its heartbeat entry removed), a 0.0 default
+            # would read as an ~epoch-old heartbeat and instantly kill
+            # the surviving job.
+            oldest = min((self._last_heartbeat.get(m, now)
+                          for m in job_id.singletons()
+                          if m in self.acct.jobs), default=now)
             if now - oldest > (self._time_per_iteration
                                + JOB_COMPLETION_BUFFER_TIME):
                 # No signal for over a round: job is unresponsive.

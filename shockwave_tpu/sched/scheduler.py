@@ -116,6 +116,10 @@ class Scheduler:
         self._job_cost_so_far: Dict[JobIdPair, float] = {}
         self._slo_deadlines: Dict[JobIdPair, float] = {}
         self._job_timelines: Dict[int, List[str]] = {}
+        # Per-round iterator logs shipped back in Done RPCs, buffered per
+        # job until the round's micro-task aggregates (reference folds
+        # these into job timelines, scheduler.py:4341-4715).
+        self._iterator_log_buffers: Dict[JobIdPair, list] = {}
 
         self._completed_jobs: Set[JobIdPair] = set()
         self._running_jobs: Set[JobIdPair] = set()
@@ -238,6 +242,7 @@ class Scheduler:
                 del self._throughputs[merged]
                 a.job_time.pop(merged, None)
         self._in_progress_updates.pop(job_id, None)
+        self._iterator_log_buffers.pop(job_id, None)
         self._steps_run_in_current_lease.pop(job_id, None)
         self.rounds.extended_leases.discard(job_id)
         if self._shockwave_planner is not None:
@@ -297,11 +302,13 @@ class Scheduler:
         if (oracle is not None and key in oracle
                 and oracle[key]["null"] > 0.0):
             self._throughputs[job_id][worker_type] = oracle[key]["null"]
-        elif not self._simulate and oracle is not None and key in oracle:
+        elif oracle is not None and key in oracle:
             # A zeroed oracle entry (the reference ships 0.0 for A3C /
             # CycleGAN) would starve the job in every throughput-driven
-            # policy; seed from the trace's expected rate and let the EMA
-            # learn the real value.
+            # policy — and in simulation it previously raised a misleading
+            # "no oracle throughput" KeyError even though the key exists.
+            # Seed from the trace's expected rate; in physical mode the
+            # EMA then learns the real value.
             nominal = job.total_steps / max(float(job.duration), 1.0)
             self.log.warning("zero oracle throughput for %s on %s; seeding "
                            "%.4f steps/s from expected duration", key,
@@ -795,7 +802,8 @@ class Scheduler:
 
     def done_callback(self, job_id: JobIdPair, worker_id: int,
                       all_num_steps: Sequence[int],
-                      all_execution_times: Sequence[float]):
+                      all_execution_times: Sequence[float],
+                      iterator_logs: Optional[Sequence[str]] = None):
         """Handle completion of one worker's micro-task for a job round."""
         a = self.acct
         to_remove: List[JobIdPair] = []
@@ -824,12 +832,32 @@ class Scheduler:
         scale_factor = len(self.rounds.current_assignments.get(job_id, (worker_id,)))
         self._in_progress_updates.setdefault(job_id, []).append(
             (worker_id, list(all_num_steps), list(all_execution_times)))
+        if iterator_logs:
+            self._iterator_log_buffers.setdefault(job_id, []).append(
+                (worker_id, list(iterator_logs)))
         if len(self._in_progress_updates[job_id]) < scale_factor:
             return
 
         updates = sorted(self._in_progress_updates[job_id], key=lambda u: u[0])
         self._in_progress_updates[job_id] = []
         self.rounds.completed_in_round.add(job_id)
+
+        # Fold the round's iterator logs into each live member's timeline.
+        # Each worker's logs are index-aligned with the members (like
+        # all_num_steps), and each element is a whole multi-line blob;
+        # split so every line carries the greppable ITERATOR prefix.
+        log_buffers = sorted(self._iterator_log_buffers.pop(job_id, []),
+                             key=lambda u: u[0])
+        for j, m in enumerate(members):
+            if not is_active[m]:
+                continue
+            tl = self._job_timelines.setdefault(m.integer_job_id(), [])
+            for w_id, blobs in log_buffers:
+                if j >= len(blobs):
+                    continue
+                tl.extend(f"t={self.get_current_timestamp():.1f} "
+                          f"ITERATOR worker={w_id} {line}"
+                          for line in blobs[j].splitlines())
 
         micro_task_succeeded = True
         agg_steps = [0] * len(members)

@@ -361,3 +361,120 @@ class TestPhysicalRounds:
             sched._done_event.set()
             worker.stop()
             sched._server.stop(grace=0)
+
+
+class TestDispatcherEnv:
+    def test_job_env_injects_mode(self, tmp_path):
+        from shockwave_tpu.runtime.dispatcher import Dispatcher
+        d = Dispatcher(round_duration=1.0, chip_ids=[0],
+                       worker_rpc_client=None, sched_addr="127.0.0.1",
+                       sched_port=1234, run_dirs={}, data_dir=None,
+                       checkpoint_dir=str(tmp_path))
+        env = d._job_env({"job_id": 7, "mode": "accordion"}, worker_id=0,
+                         round_id=0, chip_id=0)
+        assert env["SWTPU_MODE"] == "accordion"
+        env = d._job_env({"job_id": 8, "mode": ""}, worker_id=0,
+                         round_id=0, chip_id=0)
+        assert env["SWTPU_MODE"] == "static"
+
+
+class TestExtendedLeaseLiveness:
+    def _make_sched(self):
+        port = free_port()
+        return PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=100.0),
+            expected_num_workers=1, port=port)
+
+    def test_missing_heartbeat_entry_does_not_kill(self):
+        """A member with no heartbeat stamp (e.g. the already-completed
+        half of a packed pair) must default to `now`, not 0.0 — a 0.0
+        default reads as an epoch-old heartbeat and kills the survivor."""
+        sched = self._make_sched()
+        try:
+            job = Job(None, "ResNet-18 (batch size 32)",
+                      "python3 main.py --batch_size 32",
+                      "image_classification/cifar10", "--num_steps",
+                      total_steps=100, duration=1000)
+            job_id = sched.add_job(job)
+            kills = []
+            sched._kill_job = lambda j: kills.append(j)
+            assert job_id not in sched._last_heartbeat
+            sched._done_callback_extended_lease(job_id)
+            assert kills == []
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+    def test_stale_heartbeat_kills(self):
+        sched = self._make_sched()
+        try:
+            job = Job(None, "ResNet-18 (batch size 32)",
+                      "python3 main.py --batch_size 32",
+                      "image_classification/cifar10", "--num_steps",
+                      total_steps=100, duration=1000)
+            job_id = sched.add_job(job)
+            kills = []
+            sched._kill_job = lambda j: kills.append(j)
+            sched._last_heartbeat[job_id] = (
+                sched.get_current_timestamp() - 10_000.0)
+            sched._done_callback_extended_lease(job_id)
+            assert kills == [job_id]
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+
+class TestIteratorLogTimelines:
+    def test_done_logs_reach_job_timeline(self):
+        """Iterator logs shipped in Done RPCs must land in the job's
+        event timeline (reference: scheduler.py:4341-4715)."""
+        sched_port = free_port()
+        worker_port = free_port()
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=2.0, max_rounds=3),
+            expected_num_workers=1, port=sched_port)
+
+        class LoggingStub(StubWorkerDaemon):
+            def _run_job(self, jobs, worker_id, round_id):
+                def execute():
+                    for j in jobs:
+                        it = IteratorToSchedulerClient(
+                            j["job_id"], worker_id, "localhost",
+                            self.sched_port)
+                        it.init()
+                    time.sleep(self.execution_time)
+                    steps = [min(int(self.throughput * self.round_duration),
+                                 j["num_steps"]) for j in jobs]
+                    self._client.notify_done(
+                        [j["job_id"] for j in jobs], worker_id, steps,
+                        [self.execution_time] * len(jobs),
+                        iterator_logs=["[PROGRESS] [STEPS] 5 synthetic"])
+                threading.Thread(target=execute, daemon=True).start()
+
+        worker = LoggingStub(sched_port, worker_port, num_chips=1,
+                             throughput=100.0)
+        try:
+            sched.add_job(Job(
+                None, "ResNet-18 (batch size 32)",
+                "python3 main.py --batch_size 32",
+                "image_classification/cifar10", "--num_steps",
+                total_steps=150, duration=10000))
+            runner = threading.Thread(target=sched.run, daemon=True)
+            runner.start()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if len(sched._completed_jobs) == 1:
+                    break
+                time.sleep(0.2)
+            assert len(sched._completed_jobs) == 1
+            timeline = sched._job_timelines.get(0, [])
+            assert any("ITERATOR" in line and "[STEPS] 5" in line
+                       for line in timeline), timeline
+        finally:
+            sched._done_event.set()
+            worker.stop()
+            sched._server.stop(grace=0)

@@ -49,6 +49,22 @@ class TestSingleJob:
         assert sched.rounds.num_completed_rounds >= 9
 
 
+class TestZeroOracleFamilies:
+    def test_a3c_simulates_with_zeroed_oracle_entry(self):
+        """The reference oracle ships 0.0 steps/s for A3C/CycleGAN; the
+        simulator must seed from the trace's nominal rate instead of
+        raising a misleading "no oracle throughput" KeyError."""
+        sched, makespan = run_sim(
+            [make_job(job_type="A3C", total_steps=100, duration=100)], [0.0])
+        assert len(sched._completed_jobs) == 1
+        assert makespan > 0
+
+    def test_missing_oracle_key_still_raises(self):
+        with pytest.raises(KeyError):
+            run_sim([make_job(job_type="NoSuchModel (batch size 1)",
+                              total_steps=10, duration=10)], [0.0])
+
+
 class TestContention:
     def test_two_jobs_one_worker_share(self):
         jobs = [make_job(total_steps=20000), make_job(total_steps=20000)]
