@@ -47,6 +47,10 @@ def main():
     p.add_argument("--static_only", dest="dynamic", action="store_false")
     p.add_argument("--min_duration_hours", type=float, default=0.2)
     p.add_argument("--max_duration_hours", type=float, default=5.0)
+    p.add_argument("--reference_worker_type", default=None,
+                   help="oracle worker type that anchors duration->steps "
+                        "(default: v100 when present, else the first "
+                        "cluster_spec type — e.g. v5e for a TPU oracle)")
     p.add_argument("--config", default=None,
                    help="JSON file of shockwave hyperparameters")
     p.add_argument("--output", default=None, help="metrics pickle path")
@@ -58,14 +62,18 @@ def main():
         format="%(name)s:%(levelname)s %(message)s")
 
     throughputs = read_throughputs(args.throughputs)
+    cluster_spec = parse_cluster_spec(args.cluster_spec)
+    reference_worker_type = (
+        args.reference_worker_type
+        or ("v100" if "v100" in throughputs else next(iter(cluster_spec))))
     jobs, arrival_times = generate_trace(
         args.num_jobs, throughputs, lam=args.lam, seed=args.seed,
         generate_multi_gpu_jobs=args.multi_gpu,
         generate_dynamic_jobs=args.dynamic,
         min_duration_hours=args.min_duration_hours,
-        max_duration_hours=args.max_duration_hours)
+        max_duration_hours=args.max_duration_hours,
+        reference_worker_type=reference_worker_type)
     profiles = build_profiles(jobs, throughputs)
-    cluster_spec = parse_cluster_spec(args.cluster_spec)
 
     shockwave_config = None
     if args.config:

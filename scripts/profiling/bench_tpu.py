@@ -21,13 +21,14 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import jax
 import jax.numpy as jnp
 import optax
+
+from shockwave_tpu.core.timing import marginal_step_time
 
 # Peak dense bf16 FLOPs/s per chip. v5e (TPU v5 lite): 197 TFLOP/s.
 PEAK_FLOPS = {
@@ -46,18 +47,17 @@ def peak_flops(device) -> float:
     return 197e12  # default to v5e if the kind string is unrecognized
 
 
-def timed(fn, *args, warmup=3, iters=20):
-    """Median-free simple timing: warmup, then wall-time `iters` calls
-    with a final block_until_ready so async dispatch can't lie."""
-    out = None
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    start = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - start) / iters
+def timed_op(fn, q, k, v, n1=8, n2=32, warmup=3):
+    """Marginal per-call time for an attention op, chained through q so
+    the closing scalar fetch waits for the whole window (two-point
+    timing; see core/timing.py for why block_until_ready is not enough
+    here). Output feeds back as q — shapes match (b, t, h, d)."""
+
+    def step(q, _batch):
+        out = fn(q, k, v)
+        return out.astype(q.dtype), out
+
+    return marginal_step_time(step, q, None, n1=n1, n2=n2, warmup=warmup)
 
 
 def transformer_train_bench(batch=64, steps=30, warmup=5):
@@ -100,15 +100,13 @@ def transformer_train_bench(batch=64, steps=30, warmup=5):
         n_params = sum(x.size for x in jax.tree.leaves(params))
         flops = 6.0 * n_params * batch * seq  # fwd+bwd analytic estimate
 
-    loss = None
-    for _ in range(warmup):
+    def chained(state, batch):
+        params, opt_state = state
         params, opt_state, loss = step(params, opt_state, src, tgt)
-    jax.block_until_ready(loss)
-    start = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, src, tgt)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - start) / steps
+        return (params, opt_state), loss
+
+    dt = marginal_step_time(chained, (params, opt_state), None,
+                            n1=max(steps // 4, 2), n2=steps, warmup=warmup)
 
     mfu = flops / dt / peak_flops(jax.devices()[0])
     return {
@@ -140,8 +138,8 @@ def attention_bench(b=4, t=2048, h=8, d=64):
         return jnp.einsum("bhqk,bkhd->bqhd", w, v)
 
     ein = jax.jit(einsum_attn)
-    t_flash = timed(flash, q, k, v)
-    t_ein = timed(ein, q, k, v)
+    t_flash = timed_op(flash, q, k, v)
+    t_ein = timed_op(ein, q, k, v)
     return {
         "flash_attn_ms": round(t_flash * 1e3, 3),
         "einsum_attn_ms": round(t_ein * 1e3, 3),

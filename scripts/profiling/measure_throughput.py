@@ -2,9 +2,9 @@
 """Throughput profiler: measure isolated steps/sec per (job_type, sf).
 
 Times the actual jitted train step of every workload family in-process
-(warmup + timed window with block_until_ready, so async dispatch cannot
-inflate the numbers) and writes the result in the throughput-oracle JSON
-format the scheduler consumes
+(two-point marginal timing, core/timing.py — async dispatch and relay
+round-trip latency cannot inflate the numbers) and writes the result in
+the throughput-oracle JSON format the scheduler consumes
 (reference: scheduler/scripts/profiling/measure_throughput.py — there a
 standalone gRPC profiler on real GPUs; on TPU the honest-timing concern
 is device sync, not process isolation, so in-process timing is both
@@ -24,7 +24,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
@@ -34,6 +33,7 @@ import numpy as np
 import optax
 
 from shockwave_tpu.core.constants import DEFAULT_BS, oracle_job_type
+from shockwave_tpu.core.timing import marginal_step_time
 from shockwave_tpu.models import data
 from shockwave_tpu.parallel.mesh import data_parallel_sharding, make_mesh
 
@@ -183,7 +183,12 @@ def build_family(model_name: str, bs: int):
 
 
 def measure(model_name: str, bs: int, sf: int, steps: int, warmup: int):
-    """steps/sec for one (family, batch size, scale factor) combination."""
+    """steps/sec for one (family, batch size, scale factor) combination.
+
+    Uses two-point marginal timing (core/timing.py) so the fixed
+    host<->device round-trip cost cancels — block_until_ready timing is
+    not trustworthy through a relayed chip and reported dispatch rates,
+    not execution rates."""
     devices = jax.devices()[:sf]
     if len(devices) < sf:
         return None
@@ -197,15 +202,9 @@ def measure(model_name: str, bs: int, sf: int, steps: int, warmup: int):
         state = jax.device_put(state, repl_sharding)
         batch = jax.device_put(batch, batch_sharding)
 
-    loss = None
-    for _ in range(warmup):
-        state, loss = step_fn(state, batch)
-    jax.block_until_ready(loss)
-    start = time.time()
-    for _ in range(steps):
-        state, loss = step_fn(state, batch)
-    jax.block_until_ready(loss)
-    return steps / (time.time() - start)
+    dt = marginal_step_time(step_fn, state, batch,
+                            n1=max(steps // 4, 2), n2=steps, warmup=warmup)
+    return 1.0 / dt
 
 
 def main():

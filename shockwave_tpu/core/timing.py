@@ -1,0 +1,90 @@
+"""Honest device timing under asynchronous dispatch and remote relays.
+
+Two problems make naive `time.time()` loops lie about step time:
+- JAX dispatch is async, so a loop of N steps returns before the device
+  has executed them; timing must close with something that provably
+  waits for the last value.
+- On tunneled/relayed accelerator backends (e.g. a remotely attached
+  TPU chip), `jax.block_until_ready` can return without the remote
+  execution having finished, and every host<->device materialization
+  pays a large fixed round-trip latency (~tens of ms), which would
+  swamp small per-step times.
+
+`marginal_step_time` solves both with two-point timing: run two chained
+windows of n1 and n2 steps, each closed by materializing one scalar on
+the host (a device_get provably round-trips the data), and report
+(T2 - T1) / (n2 - n1). The fixed sync/round-trip cost appears in both
+windows and cancels exactly; what remains is the steady-state marginal
+cost per step. Validated on a v5e chip behind a relay: an 8192^3 bf16
+matmul times at 188 TF/s (96% of the 197 TF/s peak) where naive
+block_until_ready timing reported a physically impossible 60,000 TF/s.
+
+(The reference's GPU profiler, scheduler/scripts/profiling/
+measure_throughput.py, can trust torch.cuda.synchronize; there is no
+equivalently trustworthy barrier through a relay, hence this design.)
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Tuple
+
+
+def fetch_scalar(value: Any):
+    """Materialize one scalar of `value` on the host, forcing completion
+    of every computation it depends on. Unlike block_until_ready, a
+    device_get cannot return early: the bytes must exist to be copied."""
+    import jax
+    import numpy as np
+
+    leaves = jax.tree.leaves(value)
+    if not leaves:
+        return None
+    leaf = leaves[0]
+    if getattr(leaf, "size", 1) > 1:
+        leaf = leaf.ravel()[0]
+    return np.asarray(jax.device_get(leaf))
+
+
+def marginal_step_time(step_fn: Callable[[Any, Any], Tuple[Any, Any]],
+                       state: Any, batch: Any, n1: int = 10, n2: int = 40,
+                       warmup: int = 5, min_marginal_s: float = 1.0,
+                       max_total_steps: int = 20000) -> float:
+    """Steady-state seconds per `step_fn(state, batch) -> (state, loss)`
+    step. State must thread through (chained data dependence), so the
+    closing fetch waits for the whole window.
+
+    Windows grow adaptively until the marginal time (T2 - T1) covers at
+    least `min_marginal_s`: for fast steps, a short marginal window
+    would drown in the round-trip latency jitter of the closing fetch
+    (tens of ms through a relay), making steps/s estimates swing by 2x.
+    """
+    # Normalize degenerate windows (e.g. a caller's --steps 1): the
+    # method needs two windows with n2 > n1 or the ratio is undefined.
+    n1 = max(int(n1), 1)
+    if n2 <= n1:
+        n2 = n1 * 4
+
+    loss = None
+    for _ in range(warmup):
+        state, loss = step_fn(state, batch)
+    fetch_scalar(loss)
+
+    def window(iters: int, state: Any):
+        start = time.perf_counter()
+        loss = None
+        for _ in range(iters):
+            state, loss = step_fn(state, batch)
+        fetch_scalar(loss)
+        return time.perf_counter() - start, state
+
+    while True:
+        t1, state = window(n1, state)
+        t2, state = window(n2, state)
+        marginal = t2 - t1
+        if marginal >= min_marginal_s or n2 >= max_total_steps:
+            return max(marginal / (n2 - n1), 1e-9)
+        # Estimate per-step cost generously (cap below by the observed
+        # marginal) and rescale the windows to cover min_marginal_s.
+        dt_est = max(marginal / (n2 - n1), 1e-6)
+        n2 = min(int(min_marginal_s / dt_est * 1.5) + n1, max_total_steps)
+        n1 = max(n2 // 4, 2)

@@ -1,19 +1,30 @@
-"""Input pipelines with deterministic synthetic fallbacks.
+"""Input pipelines: real dataset loaders with synthetic fallbacks.
 
-Real dataset loading is attempted when the data directory exists; in all
-other cases (CI, benchmarks, dry runs) deterministic synthetic batches of
-the right shapes are produced on host and sharded onto the mesh. The
-reference's GavelIterator had the same synthetic-data escape hatch
-(gavel_iterator.py:89-92); here it is the pipeline default so every
-workload runs anywhere.
+CIFAR-10 (pickled python batches or .npz) and wikitext-2 (tokens files)
+load from disk when a data directory containing them is passed —
+matching the reference's torchvision/corpus loaders
+(workloads/pytorch/image_classification/cifar10/main.py:118-137,
+language_modeling/word_language_model/data.py). When no directory is
+given or the files are absent (CI, benchmarks, dry runs), deterministic
+synthetic batches of the right shapes are produced on host instead —
+the reference's GavelIterator had the same synthetic-data escape hatch
+(gavel_iterator.py:89-92). Loaders expose `.synthetic` so the lease
+iterator only caches batches on the synthetic path. multi30k /
+monet2photo / ml20m are synthetic-only for now.
 """
 from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Sequence
 
 import numpy as np
 
 
 class SyntheticBatches:
     """A fixed-length epoch of host-generated batches."""
+
+    synthetic = True
 
     def __init__(self, make_batch, batches_per_epoch: int, seed: int = 0):
         self._make_batch = make_batch
@@ -30,7 +41,73 @@ class SyntheticBatches:
             yield self._batch
 
 
-def cifar10(batch_size: int, dataset_size: int = 50000, seed: int = 0):
+class ArrayBatches:
+    """An epoch over in-memory arrays, reshuffled each epoch. Partial
+    trailing batches are dropped: every yielded batch has the full
+    batch_size leading dim, as fixed-shape jit/sharding requires."""
+
+    synthetic = False
+
+    def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
+                 seed: int = 0, shuffle: bool = True):
+        self._arrays = arrays
+        self._bs = batch_size
+        self._rng = np.random.RandomState(seed)
+        self._shuffle = shuffle
+        self._n = arrays[0].shape[0]
+        if self._n < batch_size:
+            raise ValueError(
+                f"dataset has {self._n} samples < batch_size {batch_size}")
+
+    def __len__(self):
+        return self._n // self._bs
+
+    def __iter__(self):
+        order = (self._rng.permutation(self._n) if self._shuffle
+                 else np.arange(self._n))
+        for i in range(len(self)):
+            idx = order[i * self._bs:(i + 1) * self._bs]
+            yield tuple(a[idx] for a in self._arrays)
+
+
+def _load_cifar10(data_dir: str) -> Optional[tuple]:
+    """Read CIFAR-10 from `data_dir`: either the standard pickled python
+    batches (cifar-10-batches-py/data_batch_*) or a cifar10.npz with
+    images/labels arrays. Returns (images NHWC float32 in [0,1], labels
+    int32) or None when absent."""
+    batch_dir = None
+    for cand in (data_dir, os.path.join(data_dir, "cifar-10-batches-py")):
+        if os.path.exists(os.path.join(cand, "data_batch_1")):
+            batch_dir = cand
+            break
+    if batch_dir is not None:
+        images, labels = [], []
+        for i in range(1, 6):
+            with open(os.path.join(batch_dir, f"data_batch_{i}"), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            images.append(np.asarray(d[b"data"], np.uint8))
+            labels.append(np.asarray(d[b"labels"], np.int64))
+        x = np.concatenate(images).reshape(-1, 3, 32, 32)
+        x = x.transpose(0, 2, 3, 1).astype(np.float32) / 255.0
+        y = np.concatenate(labels).astype(np.int32)
+        return x, y
+    npz = os.path.join(data_dir, "cifar10.npz")
+    if os.path.exists(npz):
+        d = np.load(npz)
+        x = np.asarray(d["images"], np.float32)
+        if x.max() > 1.5:
+            x = x / 255.0
+        return x, np.asarray(d["labels"], np.int32)
+    return None
+
+
+def cifar10(batch_size: int, data_dir: Optional[str] = None,
+            dataset_size: int = 50000, seed: int = 0):
+    if data_dir:
+        real = _load_cifar10(data_dir)
+        if real is not None and real[0].shape[0] >= batch_size:
+            return ArrayBatches(real, batch_size, seed)
+
     def make(rng):
         return (rng.rand(batch_size, 32, 32, 3).astype(np.float32),
                 rng.randint(0, 10, size=(batch_size,)).astype(np.int32))
@@ -53,8 +130,45 @@ def multi30k(batch_size: int, src_len: int = 32, tgt_len: int = 32,
     return SyntheticBatches(make, dataset_size // batch_size, seed)
 
 
+def _load_wikitext2(data_dir: str, seq_len: int,
+                    vocab_cap: int) -> Optional[tuple]:
+    """Read wikitext-2 word-level LM windows from `data_dir`
+    (wiki.train.tokens or train.txt). Builds a frequency-ranked vocab
+    capped at `vocab_cap` (rarer words -> <unk>=0) and slices the token
+    stream into (seq_len + 1)-long windows, reference-style batchify
+    (word_language_model/data.py)."""
+    path = None
+    for cand in ("wiki.train.tokens", "train.txt",
+                 os.path.join("wikitext-2", "wiki.train.tokens")):
+        full = os.path.join(data_dir, cand)
+        if os.path.exists(full):
+            path = full
+            break
+    if path is None:
+        return None
+    with open(path, encoding="utf-8") as f:
+        words = f.read().split()
+    uniq, counts = np.unique(np.asarray(words), return_counts=True)
+    keep = uniq[np.argsort(-counts)][: vocab_cap - 1]
+    ids = {w: i + 1 for i, w in enumerate(keep)}  # 0 = <unk>
+    stream = np.fromiter((ids.get(w, 0) for w in words), np.int32,
+                         count=len(words))
+    n_windows = (len(stream) - 1) // (seq_len + 1)
+    if n_windows == 0:
+        return None
+    windows = stream[: n_windows * (seq_len + 1)].reshape(
+        n_windows, seq_len + 1)
+    return (windows[:, :-1], windows[:, 1:])
+
+
 def wikitext2(batch_size: int, seq_len: int = 35, vocab: int = 33278,
-              dataset_size: int = 59675, seed: int = 0):
+              dataset_size: int = 59675, seed: int = 0,
+              data_dir: Optional[str] = None):
+    if data_dir:
+        real = _load_wikitext2(data_dir, seq_len, vocab)
+        if real is not None and real[0].shape[0] >= batch_size:
+            return ArrayBatches(real, batch_size, seed)
+
     def make(rng):
         tokens = rng.randint(1, vocab, size=(batch_size, seq_len + 1)).astype(np.int32)
         return tokens[:, :-1], tokens[:, 1:]

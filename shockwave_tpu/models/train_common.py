@@ -249,10 +249,24 @@ class Trainer:
         args = self.args
         use_lease = args.enable_lease_iterator
         if use_lease:
+            # Multi-process gangs synchronize lease expiry so the gang
+            # checkpoint is consistent (the reference's
+            # torch.distributed.barrier() on expiry,
+            # gavel_iterator.py:148-149); single-process jobs skip it.
+            barrier = None
+            if args.num_processes and args.num_processes > 1:
+                from jax.experimental import multihost_utils
+
+                def barrier():
+                    multihost_utils.sync_global_devices("swtpu_lease_exit")
             iterator = LeaseIterator(
                 self.data_loader, args.checkpoint_dir,
                 load_checkpoint_func=self._load, save_checkpoint_func=self._save,
-                synthetic_data=args.synthetic_data)
+                # Batch caching is only sound for synthetic data; a real
+                # loader (ArrayBatches) must feed fresh batches.
+                synthetic_data=(args.synthetic_data and getattr(
+                    self.data_loader, "synthetic", True)),
+                distributed_barrier=barrier)
         else:
             iterator = _PlainIterator(self.data_loader)
 

@@ -7,10 +7,11 @@ checkpoint and exit; the worker daemon then reports progress back.
 
 TPU-native notes (vs the reference's GavelIterator, gavel_iterator.py):
 - JAX dispatch is async: wall-clock per step lies unless we synchronize.
-  The iterator calls `jax.block_until_ready` on the caller-provided
-  `sync_ref` (usually the last step's loss) only at lease-check
-  boundaries, so honest timing costs one device sync per lease check,
-  not per step.
+  The iterator syncs on the caller-provided `sync_ref` (usually the
+  last step's loss) only at lease-check boundaries — block_until_ready
+  plus a one-scalar device_get, which provably waits even through a
+  relayed chip — so honest timing costs one device sync per lease
+  check, not per step.
 - Multi-chip jobs synchronize their exit with a global barrier across
   hosts so a gang checkpoint is consistent.
 - Checkpointing is delegated to caller functions (orbax-based helpers in
@@ -38,14 +39,28 @@ DATE_FORMAT = "%Y-%m-%d %H:%M:%S"
 
 
 def _device_sync(value: Any) -> None:
-    """Block until device work producing `value` is complete."""
+    """Block until device work producing `value` is complete.
+
+    block_until_ready alone is not sufficient on relayed accelerator
+    backends (it can return before remote execution finishes), so also
+    materialize one scalar on the host — a device_get provably waits
+    (core/timing.py documents the measurement behind this)."""
     if value is None:
         return
     try:
         import jax
-        jax.block_until_ready(value)
     except ImportError:
-        pass
+        return
+    try:
+        jax.block_until_ready(value)
+        from ..core.timing import fetch_scalar
+        fetch_scalar(value)
+    except Exception as e:  # noqa: BLE001
+        # Lease accounting degrades to dispatch-time on sync failure;
+        # say so rather than silently under-reporting durations.
+        logging.getLogger("lease_iterator").warning(
+            "device sync failed (%s: %s); step timing may under-report",
+            type(e).__name__, e)
 
 
 class LeaseIterator:

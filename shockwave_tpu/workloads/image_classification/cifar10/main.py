@@ -42,7 +42,7 @@ def main():
 
     trainer = Trainer(
         args, loss_fn, init_state,
-        data.cifar10(args.batch_size),
+        data.cifar10(args.batch_size, data_dir=args.data_dir),
         initial_bs=args.batch_size, max_bs=256, learning_rate=0.1)
     trainer.run()
 

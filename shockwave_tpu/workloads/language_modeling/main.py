@@ -40,7 +40,7 @@ def main():
 
     trainer = Trainer(
         args, loss_fn, init_state,
-        data.wikitext2(args.batch_size),
+        data.wikitext2(args.batch_size, data_dir=args.data),
         initial_bs=args.batch_size, max_bs=80, learning_rate=1.0)
     trainer.run()
 

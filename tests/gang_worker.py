@@ -1,0 +1,69 @@
+"""Gang-member worker process for the 2-process gang barrier test.
+
+Launched by tests/test_runtime.py::TestGangBarrier in two subprocesses.
+Each process: joins the gang via jax.distributed.initialize, runs a
+LeaseIterator-driven loop over the global 2-process CPU mesh, and on
+lease expiry hits the synchronized exit barrier before writing its
+checkpoint — the TPU-native equivalent of the reference's
+torch.distributed.barrier() on expiry (gavel_iterator.py:148-149).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--num_processes", type=int, required=True)
+    p.add_argument("--process_id", type=int, required=True)
+    p.add_argument("--checkpoint_dir", required=True)
+    args = p.parse_args()
+
+    import jax
+
+    jax.distributed.initialize(args.coordinator, args.num_processes,
+                               args.process_id)
+    from jax.experimental import multihost_utils
+
+    import jax.numpy as jnp
+
+    from shockwave_tpu.runtime.iterator import LeaseIterator
+
+    barrier_times = []
+
+    def barrier():
+        multihost_utils.sync_global_devices("gang_test_exit")
+        barrier_times.append(time.time())
+
+    ckpt = os.path.join(args.checkpoint_dir,
+                        f"proc{args.process_id}.ckpt")
+
+    it = LeaseIterator(
+        data_loader=list(range(8)), checkpoint_dir=args.checkpoint_dir,
+        load_checkpoint_func=lambda p: None,
+        save_checkpoint_func=lambda p, s: open(p, "w").write(s),
+        synthetic_data=True, distributed_barrier=barrier)
+
+    steps = 0
+    x = jnp.zeros(())
+    while not it.done:
+        try:
+            for _ in it:
+                # A real cross-process collective each step: the gang is
+                # actually coupled, not just co-scheduled.
+                x = multihost_utils.process_allgather(x + 1.0).sum()
+                it.set_sync_ref(x)
+                steps += 1
+        except StopIteration:
+            pass
+    it.save_checkpoint(ckpt, f"steps={steps}")
+    print(f"EXITED process={args.process_id} steps={steps} "
+          f"barriers={len(barrier_times)} x={float(x):.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -230,3 +230,65 @@ class TestCycleGAN:
         assert float(jnp.max(jnp.abs(y))) <= 1.0
         logits = d.apply({"params": dp}, x)
         assert logits.shape[0] == 2 and logits.shape[-1] == 1
+
+
+class TestRealDataLoaders:
+    def _write_cifar(self, root, n=64):
+        import pickle as pkl
+
+        import numpy as np
+        d = root / "cifar-10-batches-py"
+        d.mkdir()
+        per = max(1, n // 5)
+        for i in range(1, 6):
+            batch = {b"data": (np.arange(per * 3072) % 255).astype(
+                         np.uint8).reshape(per, 3072),
+                     b"labels": [i % 10] * per}
+            with open(d / f"data_batch_{i}", "wb") as f:
+                pkl.dump(batch, f)
+        return root
+
+    def test_cifar10_real(self, tmp_path):
+        from shockwave_tpu.models import data
+        root = self._write_cifar(tmp_path)
+        loader = data.cifar10(4, data_dir=str(root))
+        assert not loader.synthetic
+        images, labels = next(iter(loader))
+        assert images.shape == (4, 32, 32, 3)
+        assert images.dtype.name == "float32"
+        assert 0.0 <= images.min() and images.max() <= 1.0
+        assert labels.shape == (4,)
+        # Two epochs reshuffle: union over one epoch covers the data.
+        assert len(loader) == 60 // 4
+
+    def test_cifar10_fallback_when_missing(self, tmp_path):
+        from shockwave_tpu.models import data
+        loader = data.cifar10(4, data_dir=str(tmp_path / "nope"))
+        assert loader.synthetic
+
+    def test_wikitext2_real(self, tmp_path):
+        from shockwave_tpu.models import data
+        text = " ".join(f"word{i % 50}" for i in range(5000))
+        (tmp_path / "wiki.train.tokens").write_text(text)
+        loader = data.wikitext2(2, seq_len=10, data_dir=str(tmp_path))
+        assert not loader.synthetic
+        tokens, targets = next(iter(loader))
+        assert tokens.shape == (2, 10) and targets.shape == (2, 10)
+        # LM shift: target is the next token of the same stream.
+        assert (tokens[:, 1:] == targets[:, :-1]).all()
+
+    def test_cifar10_workload_trains_on_real_data(self, tmp_path):
+        """End-to-end: the dispatched CLI trains on a real data_dir."""
+        import subprocess
+        import sys
+        root = self._write_cifar(tmp_path)
+        out = subprocess.run(
+            [sys.executable,
+             "shockwave_tpu/workloads/image_classification/cifar10/main.py",
+             "--data_dir", str(root), "--batch_size", "8",
+             "--num_steps", "3",
+             "--checkpoint_dir", str(tmp_path / "ckpt")],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.join(os.path.dirname(__file__), ".."))
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "TRAINED 3 steps" in out.stdout
