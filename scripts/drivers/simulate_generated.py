@@ -73,7 +73,8 @@ def main():
         min_duration_hours=args.min_duration_hours,
         max_duration_hours=args.max_duration_hours,
         reference_worker_type=reference_worker_type)
-    profiles = build_profiles(jobs, throughputs)
+    profiles = build_profiles(jobs, throughputs,
+                              worker_type=reference_worker_type)
 
     shockwave_config = None
     if args.config:

@@ -88,3 +88,5 @@ def marginal_step_time(step_fn: Callable[[Any, Any], Tuple[Any, Any]],
         dt_est = max(marginal / (n2 - n1), 1e-6)
         n2 = min(int(min_marginal_s / dt_est * 1.5) + n1, max_total_steps)
         n1 = max(n2 // 4, 2)
+        if n2 <= n1:  # keep the two windows distinct after rescaling
+            n2 = n1 + 1

@@ -254,11 +254,21 @@ class Trainer:
             # torch.distributed.barrier() on expiry,
             # gavel_iterator.py:148-149); single-process jobs skip it.
             barrier = None
+            gang_allreduce = None
             if args.num_processes and args.num_processes > 1:
                 from jax.experimental import multihost_utils
 
                 def barrier():
                     multihost_utils.sync_global_devices("swtpu_lease_exit")
+
+                # Agrees every time-based lease decision across the gang
+                # so all members exit at the same step (LeaseIterator
+                # docs); allgather returns identical arrays everywhere,
+                # so the reduction is deterministic.
+                def gang_allreduce(value, op):
+                    arr = np.asarray(multihost_utils.process_allgather(
+                        np.float32(value)))
+                    return float(arr.max() if op == "max" else arr.min())
             iterator = LeaseIterator(
                 self.data_loader, args.checkpoint_dir,
                 load_checkpoint_func=self._load, save_checkpoint_func=self._save,
@@ -266,7 +276,8 @@ class Trainer:
                 # loader (ArrayBatches) must feed fresh batches.
                 synthetic_data=(args.synthetic_data and getattr(
                     self.data_loader, "synthetic", True)),
-                distributed_barrier=barrier)
+                distributed_barrier=barrier,
+                gang_allreduce=gang_allreduce)
         else:
             iterator = _PlainIterator(self.data_loader)
 

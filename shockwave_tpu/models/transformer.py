@@ -45,14 +45,14 @@ class MultiHeadAttention(nn.Module):
         k = dense("key")(kv_in)
         v = dense("value")(kv_in)
         tq, tk = q.shape[1], k.shape[1]
-        # flash_attention blocks at min(128, T), so T must divide into
-        # 128-blocks when long; short lengths additionally need the
-        # second-minor dim on the sublane tile (16 for bf16, 8 for f32).
-        # Anything unaligned falls back to einsum.
+        # flash_attention blocks at min(1024, T): T > 1024 must divide
+        # into 1024-blocks; shorter lengths are their own block and only
+        # need the second-minor dim on the sublane tile (16 for bf16,
+        # 8 for f32). Anything unaligned falls back to einsum.
         align = 16 if self.dtype == jnp.bfloat16 else 8
 
         def blockable(t):
-            return t % 128 == 0 if t > 128 else t % align == 0
+            return t % 1024 == 0 if t > 1024 else t % align == 0
 
         flash_ok = (self.use_flash and not (causal and tq != tk)
                     and blockable(tq) and blockable(tk))

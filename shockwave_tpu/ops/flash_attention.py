@@ -245,16 +245,21 @@ _flash_bhtd.defvjp(_flash_bhtd_fwd, _flash_bhtd_bwd)
 def flash_attention(q, k, v, causal: bool = False,
                     key_padding_mask: Optional[jnp.ndarray] = None,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = 1024, block_k: int = 1024):
     """Fused attention for (batch, seq, heads, head_dim) inputs.
 
-    head_dim is zero-padded to the 128-lane tile (zero columns change
-    neither scores nor the sliced-away output dims). Sequence lengths must
-    be divisible by the block size (shrunk to T for short sequences); mask
-    ragged sequences upstream. key_padding_mask is (B, Tk) with True =
-    attend. Cross-attention (Tq != Tk) is supported for causal=False.
-    Runs the Pallas TPU kernel on TPU and the Pallas interpreter elsewhere
-    (tests/CI on CPU).
+    head_dim is zero-padded to a multiple of 8 sublanes when ragged; it
+    is NOT padded to the 128-lane tile — a full-coverage lane dim is
+    legal in Mosaic and skipping the pad saves bandwidth (measured ~5%
+    at d=64). Default blocks are large (1024) because per-grid-step
+    overhead dominates on real v5e hardware: at (4, 2048, 8, 64) causal
+    bf16, blocks of 1024 run 5.7x faster than blocks of 128 and 3.6x
+    faster than the einsum path (0.47 ms vs 1.68 ms). Sequence lengths
+    must be divisible by the block size (shrunk to T for short
+    sequences); mask ragged sequences upstream. key_padding_mask is
+    (B, Tk) with True = attend. Cross-attention (Tq != Tk) is supported
+    for causal=False. Runs the Pallas TPU kernel on TPU and the Pallas
+    interpreter elsewhere (tests/CI on CPU).
     """
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -272,7 +277,7 @@ def flash_attention(q, k, v, causal: bool = False,
     def to_bhtd(x):
         t = x.shape[1]
         x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, -1)
-        return _pad_axis(x, 2, LANES)
+        return _pad_axis(x, 2, SUBLANES)
 
     qf, kf, vf = to_bhtd(q), to_bhtd(k), to_bhtd(v)
     if key_padding_mask is None:

@@ -60,7 +60,11 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     o = jnp.zeros(q.shape, jnp.float32)
     # Mark the accumulators as device-varying along the ring axis so the
     # scan carry types line up with the shard-resident outputs.
-    m, l, o = jax.tree.map(lambda x: lax.pvary(x, axis_name), (m, l, o))
+    if hasattr(lax, "pcast"):
+        to_varying = lambda x: lax.pcast(x, axis_name, to="varying")  # noqa: E731
+    else:  # older jax spells it pvary
+        to_varying = lambda x: lax.pvary(x, axis_name)  # noqa: E731
+    m, l, o = jax.tree.map(to_varying, (m, l, o))
 
     def make_mask(step):
         if not causal:

@@ -67,12 +67,30 @@ class LeaseIterator:
     def __init__(self, data_loader: Iterable, checkpoint_dir: str,
                  load_checkpoint_func: Callable, save_checkpoint_func: Callable,
                  synthetic_data: bool = False, write_on_close: bool = True,
-                 distributed_barrier: Optional[Callable] = None):
+                 distributed_barrier: Optional[Callable] = None,
+                 gang_allreduce: Optional[Callable] = None,
+                 gang_sync_every: int = 16):
+        """gang_allreduce(value, op) -> float ("max"/"min" across the
+        gang) makes every time-based decision step-deterministic for
+        multi-process gangs: lease grants are agreed by min at grant
+        time, the running duration is agreed by max at `gang_sync_every`
+        step boundaries, and time-based expiry/renewal checks only fire
+        at those boundaries — so all members take identical control
+        paths at identical steps and a member can never enter the exit
+        barrier while a peer is still issuing training collectives.
+        Steps-based checks are deterministic already (server-side
+        first-requester-computes consensus)."""
         self._data_loader = data_loader
         self._load_checkpoint_func = load_checkpoint_func
         self._save_checkpoint_func = save_checkpoint_func
         self._synthetic_data = synthetic_data
         self._distributed_barrier = distributed_barrier
+        self._gang_allreduce = gang_allreduce
+        self._gang_sync_every = max(int(gang_sync_every), 1)
+        # Absolute agreed-duration threshold for the next time-triggered
+        # renewal (gang mode replaces the per-step countdown, which
+        # drifts epsilon-differently on every member's local clock).
+        self._renewal_duration_threshold = INFINITY
 
         self._job_id = int(os.environ["SWTPU_JOB_ID"])
         self._worker_id = int(os.environ["SWTPU_WORKER_ID"])
@@ -128,8 +146,24 @@ class LeaseIterator:
         self._duration += elapsed
         self._prev_time = now
 
-        if (self._steps_until_lease_update <= 0
-                or self._time_until_lease_update <= 0):
+        gang = self._gang_allreduce is not None
+        # Gang members only evaluate time-based conditions at shared
+        # K-step boundaries, on an agreed (max-allreduced) duration, so
+        # the whole gang reaches the same verdict at the same step.
+        boundary = (not gang) or (self._steps % self._gang_sync_every == 0)
+        if gang and boundary:
+            _device_sync(self._sync_ref)
+            sync_now = time.time()
+            self._duration += sync_now - self._prev_time
+            self._prev_time = sync_now
+            self._duration = max(
+                self._duration,
+                float(self._gang_allreduce(self._duration, "max")))
+
+        time_renewal_due = boundary and (
+            self._duration >= self._renewal_duration_threshold if gang
+            else self._time_until_lease_update <= 0)
+        if self._steps_until_lease_update <= 0 or time_renewal_due:
             # Sync outstanding device work so self._duration is honest at the
             # renewal boundary.
             _device_sync(self._sync_ref)
@@ -138,7 +172,7 @@ class LeaseIterator:
             self._prev_time = sync_now
             self._update_lease()
 
-        if (self._duration >= self._lease.max_duration
+        if ((boundary and self._duration >= self._lease.max_duration)
                 or self._steps >= self._lease.max_steps):
             self._done = True
             self._logger.info(
@@ -212,13 +246,27 @@ class LeaseIterator:
             extra_time = 0.0
             if self._duration + run_time_so_far > deadline:
                 # Deadline enforcement: scheduler says we have overrun 1.5x
-                # our expected duration; finish now.
+                # our expected duration; finish now. Gang members reach
+                # this with agreed durations at the same step, so all
+                # exit together; the barrier keeps the gang checkpoint
+                # consistent either way.
                 self._logger.info(
                     "over deadline (%.1f + %.1f > %.1f)", self._duration,
                     run_time_so_far, deadline,
                     extra={"event": "LEASE", "status": "DEADLINE"})
+                if self._distributed_barrier is not None:
+                    self._distributed_barrier()
                 self.complete(timeout=True)
                 raise StopIteration
+
+        if self._gang_allreduce is not None:
+            # Agree the grant across the gang (min is the safe direction:
+            # nobody outruns a peer's lease). Steps are already identical
+            # via the scheduler's first-requester-computes consensus;
+            # durations can differ by RPC-arrival epsilons.
+            max_steps = int(self._gang_allreduce(max_steps, "min"))
+            max_duration = float(self._gang_allreduce(max_duration, "min"))
+            extra_time = float(self._gang_allreduce(extra_time, "min"))
 
         # Plan the next renewal at LEASE_UPDATE_FRACTION of the new grant; an
         # unchanged grant means this lease is final.
@@ -231,11 +279,14 @@ class LeaseIterator:
                 left + additional * LEASE_UPDATE_FRACTION)
         if max_duration <= self._lease.max_duration:
             self._time_until_lease_update = INFINITY
+            self._renewal_duration_threshold = INFINITY
         else:
             additional = max_duration - self._lease.max_duration
             left = self._lease.max_duration - self._duration
             self._time_until_lease_update = (
                 left + additional * LEASE_UPDATE_FRACTION + extra_time)
+            self._renewal_duration_threshold = (
+                self._duration + self._time_until_lease_update)
 
         self._lease.max_steps = max_steps
         self._lease.max_duration = max_duration + extra_time

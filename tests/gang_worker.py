@@ -21,6 +21,10 @@ def main():
     p.add_argument("--num_processes", type=int, required=True)
     p.add_argument("--process_id", type=int, required=True)
     p.add_argument("--checkpoint_dir", required=True)
+    p.add_argument("--gang_sync_every", type=int, default=16)
+    p.add_argument("--skew_ms", type=float, default=0.0,
+                   help="artificial per-step slowdown for this member, to "
+                        "prove time-based exits still land on the same step")
     args = p.parse_args()
 
     import jax
@@ -33,11 +37,18 @@ def main():
 
     from shockwave_tpu.runtime.iterator import LeaseIterator
 
+    import numpy as np
+
     barrier_times = []
 
     def barrier():
         multihost_utils.sync_global_devices("gang_test_exit")
         barrier_times.append(time.time())
+
+    def gang_allreduce(value, op):
+        arr = np.asarray(multihost_utils.process_allgather(
+            np.float32(value)))
+        return float(arr.max() if op == "max" else arr.min())
 
     ckpt = os.path.join(args.checkpoint_dir,
                         f"proc{args.process_id}.ckpt")
@@ -46,7 +57,8 @@ def main():
         data_loader=list(range(8)), checkpoint_dir=args.checkpoint_dir,
         load_checkpoint_func=lambda p: None,
         save_checkpoint_func=lambda p, s: open(p, "w").write(s),
-        synthetic_data=True, distributed_barrier=barrier)
+        synthetic_data=True, distributed_barrier=barrier,
+        gang_allreduce=gang_allreduce, gang_sync_every=args.gang_sync_every)
 
     steps = 0
     x = jnp.zeros(())
@@ -54,10 +66,13 @@ def main():
         try:
             for _ in it:
                 # A real cross-process collective each step: the gang is
-                # actually coupled, not just co-scheduled.
+                # actually coupled, not just co-scheduled. An unmatched
+                # exit would therefore hang, not just skew counters.
                 x = multihost_utils.process_allgather(x + 1.0).sum()
                 it.set_sync_ref(x)
                 steps += 1
+                if args.skew_ms:
+                    time.sleep(args.skew_ms / 1e3)
         except StopIteration:
             pass
     it.save_checkpoint(ckpt, f"steps={steps}")

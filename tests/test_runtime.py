@@ -549,3 +549,63 @@ class TestGangBarrier:
                 if proc.poll() is None:
                     proc.kill()
             server.stop(grace=0)
+
+    def test_duration_expiry_lands_on_same_step_despite_skew(self, tmp_path):
+        """Time-based lease expiry must be step-deterministic across the
+        gang even when members' local clocks/step rates differ: decisions
+        fire only at shared K-step boundaries on an allreduce-agreed
+        duration. A divergent exit would deadlock the per-step collective
+        (and the reference's barrier-only design cannot prevent it)."""
+        import re
+        import subprocess
+        import sys
+
+        sched_port = free_port()
+        coord_port = free_port()
+
+        server = serve_scheduler(sched_port, {
+            "RegisterWorker": lambda **kw: ([0], 60.0),
+            "Done": lambda *a: None,
+            "InitJob": lambda job_id: (10**6, 1.0, 0.0),
+            "UpdateLease": lambda job_id, worker_id, steps, duration,
+                max_steps, max_duration: (int(max_steps),
+                                          float(max_duration), 0.0, 1e9),
+            "UpdateResourceRequirement": lambda *a: None,
+        })
+        procs = []
+        try:
+            for pid, skew in ((0, 0.0), (1, 6.0)):
+                env = dict(os.environ)
+                env.update({
+                    "SWTPU_JOB_ID": "0", "SWTPU_WORKER_ID": str(pid),
+                    "SWTPU_ROUND_ID": "0",
+                    "SWTPU_SCHED_ADDR": "localhost",
+                    "SWTPU_SCHED_PORT": str(sched_port),
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                })
+                procs.append(subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(os.path.dirname(__file__),
+                                  "gang_worker.py"),
+                     "--coordinator", f"localhost:{coord_port}",
+                     "--num_processes", "2", "--process_id", str(pid),
+                     "--checkpoint_dir", str(tmp_path),
+                     "--gang_sync_every", "4", "--skew_ms", str(skew)],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, env=env))
+            steps_seen = []
+            for proc in procs:
+                out, _ = proc.communicate(timeout=180)
+                assert proc.returncode == 0, out[-3000:]
+                m = re.search(r"EXITED process=\d steps=(\d+) barriers=1",
+                              out)
+                assert m, out[-2000:]
+                steps_seen.append(int(m.group(1)))
+            assert steps_seen[0] == steps_seen[1], steps_seen
+            assert steps_seen[0] > 0
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+            server.stop(grace=0)
