@@ -1,0 +1,45 @@
+#!/bin/bash
+# Physical-vs-simulation fidelity experiment on one real TPU chip
+# (counterpart of the reference's reproduce/tacc_32gpus_comparison flow,
+# analyze_fidelity.py:31-56, scaled to a single-chip loopback).
+#
+# Runs the 3-job trace through the REAL scheduler + worker daemon + job
+# subprocesses on the attached chip, then the same trace in simulation
+# against the measured v5e oracle, and checks the metrics agree.
+#
+# Tips: pre-warm the XLA compile cache by running each workload once for
+# a few steps (first-dispatch compiles otherwise eat into round 0), and
+# keep round_duration >= 120 s.
+set -eu
+cd "$(dirname "$0")/../.."
+OUT=${1:-reproduce/fidelity}
+PORT=${2:-50381}
+ROUND=120
+TRACE=reproduce/fidelity/fidelity_3job.trace
+CKPT=$(mktemp -d /tmp/swtpu_fidelity.XXXX)
+
+python scripts/drivers/run_physical.py \
+    --trace "$TRACE" --policy max_min_fairness \
+    --throughputs data/v5e_throughputs.json \
+    --expected_num_workers 1 --round_duration "$ROUND" --port "$PORT" \
+    --timeout 3600 --timeline_dir "$OUT/timelines" \
+    --output "$OUT/physical_v5e.pkl" --verbose &
+SCHED_PID=$!
+sleep 5
+python -m shockwave_tpu.runtime.worker --worker_type v5e \
+    --sched_addr 127.0.0.1 --sched_port "$PORT" --worker_port "$((PORT+1))" \
+    --num_chips 1 --data_dir /tmp/swtpu_data --checkpoint_dir "$CKPT" &
+WORKER_PID=$!
+
+wait "$SCHED_PID"
+kill "$WORKER_PID" 2>/dev/null || true
+
+python scripts/drivers/simulate.py \
+    --trace "$TRACE" --policy max_min_fairness \
+    --throughputs data/v5e_throughputs.json \
+    --cluster_spec v5e:1 --round_duration "$ROUND" \
+    --output "$OUT/simulated_v5e.pkl"
+
+python reproduce/analyze_fidelity.py \
+    "$OUT/physical_v5e.pkl" "$OUT/simulated_v5e.pkl" --tolerance 0.15 \
+    | tee "$OUT/fidelity_report.txt"

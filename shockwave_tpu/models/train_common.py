@@ -300,12 +300,21 @@ class Trainer:
         window_start = time.time()
         window_steps = 0
         loss = None
+        # Synthetic pipelines yield the same host batch object every step;
+        # re-uploading it would cost a full host->device round trip per
+        # step (~70 ms through a relayed chip — measured 30x slowdown).
+        # Cache the device-resident copy for the identical host object
+        # (kept strongly referenced, so its identity cannot be recycled).
+        host_batch_ref, dev_batch = None, None
         try:
             while not iterator.done and (budget is None
                                          or start_step + steps_done < budget):
                 epoch_resized = False
                 for batch in iterator:
-                    batch = jax.device_put(batch, self.batch_sharding)
+                    if batch is not host_batch_ref:
+                        host_batch_ref = batch
+                        dev_batch = jax.device_put(batch, self.batch_sharding)
+                    batch = dev_batch
                     self.state, metrics = self.train_step(self.state, *batch)
                     loss = metrics["loss"]
                     if use_lease:

@@ -78,3 +78,15 @@ def ambient_accelerator_env(*extra_drop):
     env = {k: v for k, v in os.environ.items() if k not in drop}
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     return env
+
+
+def cpu_subprocess_env():
+    """Subprocess env for children that must stay entirely OFF the
+    accelerator relay: CPU backend pinned and the relay address dropped,
+    so a wedged tunnel can never hang a CPU-only test (the site hook
+    dials the relay at import when the address is present)."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env

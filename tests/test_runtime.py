@@ -511,13 +511,13 @@ class TestGangBarrier:
         procs = []
         try:
             for pid in (0, 1):
-                env = dict(os.environ)
+                from conftest import cpu_subprocess_env
+                env = cpu_subprocess_env()
                 env.update({
                     "SWTPU_JOB_ID": "0", "SWTPU_WORKER_ID": str(pid),
                     "SWTPU_ROUND_ID": "0",
                     "SWTPU_SCHED_ADDR": "localhost",
                     "SWTPU_SCHED_PORT": str(sched_port),
-                    "JAX_PLATFORMS": "cpu",
                     # One virtual device per process: the gang's global
                     # mesh is the 2 processes, not threads in one.
                     "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
@@ -575,13 +575,13 @@ class TestGangBarrier:
         procs = []
         try:
             for pid, skew in ((0, 0.0), (1, 6.0)):
-                env = dict(os.environ)
+                from conftest import cpu_subprocess_env
+                env = cpu_subprocess_env()
                 env.update({
                     "SWTPU_JOB_ID": "0", "SWTPU_WORKER_ID": str(pid),
                     "SWTPU_ROUND_ID": "0",
                     "SWTPU_SCHED_ADDR": "localhost",
                     "SWTPU_SCHED_PORT": str(sched_port),
-                    "JAX_PLATFORMS": "cpu",
                     "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
                 })
                 procs.append(subprocess.Popen(

@@ -281,6 +281,8 @@ class TestRealDataLoaders:
         """End-to-end: the dispatched CLI trains on a real data_dir."""
         import subprocess
         import sys
+
+        from conftest import cpu_subprocess_env
         root = self._write_cifar(tmp_path)
         out = subprocess.run(
             [sys.executable,
@@ -289,6 +291,7 @@ class TestRealDataLoaders:
              "--num_steps", "3",
              "--checkpoint_dir", str(tmp_path / "ckpt")],
             capture_output=True, text=True, timeout=300,
-            cwd=os.path.join(os.path.dirname(__file__), ".."))
+            cwd=os.path.join(os.path.dirname(__file__), ".."),
+            env=cpu_subprocess_env())
         assert out.returncode == 0, out.stderr[-2000:]
         assert "TRAINED 3 steps" in out.stdout
