@@ -12,11 +12,14 @@
 # keep round_duration >= 120 s.
 set -eu
 cd "$(dirname "$0")/../.."
-OUT=${1:-reproduce/fidelity}
+OUT=${1:-reproduce/fidelity/out}   # untracked by default; pass
+                                   # reproduce/fidelity to refresh the
+                                   # committed artifacts deliberately
 PORT=${2:-50381}
 ROUND=120
 TRACE=reproduce/fidelity/fidelity_3job.trace
 CKPT=$(mktemp -d /tmp/swtpu_fidelity.XXXX)
+mkdir -p "$OUT"
 
 python scripts/drivers/run_physical.py \
     --trace "$TRACE" --policy max_min_fairness \
@@ -25,6 +28,9 @@ python scripts/drivers/run_physical.py \
     --timeout 3600 --timeline_dir "$OUT/timelines" \
     --output "$OUT/physical_v5e.pkl" --verbose &
 SCHED_PID=$!
+# The worker must die with the script, even if the scheduler fails.
+WORKER_PID=""
+trap '[ -n "$WORKER_PID" ] && kill "$WORKER_PID" 2>/dev/null || true' EXIT
 sleep 5
 python -m shockwave_tpu.runtime.worker --worker_type v5e \
     --sched_addr 127.0.0.1 --sched_port "$PORT" --worker_port "$((PORT+1))" \

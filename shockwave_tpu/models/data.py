@@ -22,13 +22,18 @@ import numpy as np
 
 
 class SyntheticBatches:
-    """A fixed-length epoch of host-generated batches."""
+    """A fixed-length epoch of host-generated batches.
+
+    SWTPU_SYNTH_EPOCH_BATCHES overrides the epoch length — epoch-driven
+    mechanisms (the Accordion monitor decides once per epoch) are
+    untestable end-to-end on CPU against dataset-sized epochs."""
 
     synthetic = True
 
     def __init__(self, make_batch, batches_per_epoch: int, seed: int = 0):
         self._make_batch = make_batch
-        self._len = max(1, batches_per_epoch)
+        override = int(os.environ.get("SWTPU_SYNTH_EPOCH_BATCHES", "0"))
+        self._len = override if override > 0 else max(1, batches_per_epoch)
         rng = np.random.RandomState(seed)
         # One real batch, reused; keeps host CPU out of the hot loop.
         self._batch = make_batch(rng)

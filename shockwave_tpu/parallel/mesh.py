@@ -6,8 +6,10 @@ DDP/NCCL stack (reference: workloads/pytorch/*/main.py dist.init calls).
 
 Axis conventions used across the workloads:
   dp — data parallel (batch sharded, params replicated; psum on grads)
+  pp — pipeline parallel (layer stages; ppermute activation hops)
   tp — tensor parallel (feature-sharded matmuls)
   sp — sequence parallel (ring attention over sequence shards)
+  ep — expert parallel (MoE experts sharded; all-to-all dispatch)
 """
 from __future__ import annotations
 
@@ -19,16 +21,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def make_mesh(dp: Optional[int] = None, tp: int = 1, sp: int = 1,
+              pp: int = 1, ep: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
-    """Build a (dp, tp, sp) mesh; dp defaults to all remaining devices."""
+    """Build a (dp, pp, tp, sp, ep) mesh; dp defaults to the remaining
+    devices. Size-1 axes cost nothing and keep PartitionSpecs valid
+    everywhere, so every mesh carries all five names."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    rest = pp * tp * sp * ep
     if dp is None:
-        assert n % (tp * sp) == 0, (n, tp, sp)
-        dp = n // (tp * sp)
-    assert dp * tp * sp == n, f"mesh {dp}x{tp}x{sp} != {n} devices"
-    arr = np.array(devices).reshape((dp, tp, sp))
-    return Mesh(arr, axis_names=("dp", "tp", "sp"))
+        assert n % rest == 0, (n, pp, tp, sp, ep)
+        dp = n // rest
+    assert dp * rest == n, f"mesh {dp}x{pp}x{tp}x{sp}x{ep} != {n} devices"
+    arr = np.array(devices).reshape((dp, pp, tp, sp, ep))
+    return Mesh(arr, axis_names=("dp", "pp", "tp", "sp", "ep"))
 
 
 def data_parallel_sharding(mesh: Mesh) -> Tuple[NamedSharding, NamedSharding]:

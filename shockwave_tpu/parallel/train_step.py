@@ -1,13 +1,19 @@
-"""A fully-parallel transformer training step: dp x tp x sp on one mesh.
+"""A fully-parallel transformer training step: dp x pp x tp x sp x ep.
 
 Demonstrates (and dry-runs) the framework's multi-chip execution model in
 one jitted step:
 - batch sharded over `dp` (XLA all-reduces grads on ICI),
+- a stack of residual MLP blocks pipelined over `pp` (GPipe microbatch
+  schedule, ppermute activation hops — parallel/pipeline.py),
 - MLP hidden dimension sharded over `tp` (XLA inserts the reduce-scatter/
   all-gather pair around the two matmuls),
-- sequence sharded over `sp` with ring attention (explicit ppermute ring).
+- sequence sharded over `sp` with ring attention (explicit ppermute ring),
+- an MoE layer with experts sharded over `ep` (all-to-all dispatch —
+  parallel/moe.py).
 
-Used by `__graft_entry__.dryrun_multichip` and as the template for scaling
+Size-1 axes degrade gracefully, so the same builder serves everything
+from single-chip to a full 5-axis mesh. Used by
+`__graft_entry__.dryrun_multichip` and as the template for scaling
 workloads past data parallelism.
 """
 from __future__ import annotations
@@ -19,16 +25,25 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .moe import moe_mlp
+from .pipeline import pipeline_apply
 from .ring_attention import ring_attention
 
 
 def build_multi_parallel_train_step(mesh: Mesh, vocab: int = 1024,
                                     dim: int = 128, heads: int = 8,
                                     mlp_dim: int = 512, seq_len: int = 64,
-                                    batch: int = 8):
+                                    batch: int = 8, n_experts: int = None,
+                                    num_microbatches: int = None):
     """Returns (step_fn, state, example_batch), all mesh-sharded."""
     assert dim % heads == 0
     head_dim = dim // heads
+    pp = mesh.shape.get("pp", 1)
+    ep = mesh.shape.get("ep", 1)
+    if n_experts is None:
+        n_experts = max(2 * ep, 2)
+    if num_microbatches is None:
+        num_microbatches = max(2 * pp, 2)
     rng = np.random.RandomState(0)
 
     def init(shape, scale=0.02):
@@ -42,11 +57,22 @@ def build_multi_parallel_train_step(mesh: Mesh, vocab: int = 1024,
         "wo": init((heads, head_dim, dim)),
         "w1": init((dim, mlp_dim)),   # hidden dim sharded over tp
         "w2": init((mlp_dim, dim)),
+        # Pipelined residual MLP stack: one (w_in, w_out) pair per stage.
+        "pp_w1": init((pp, dim, mlp_dim)),
+        "pp_w2": init((pp, mlp_dim, dim)),
+        # MoE layer: experts sharded over ep.
+        "router": init((dim, n_experts)),
+        "moe_w1": init((n_experts, dim, mlp_dim)),
+        "moe_w2": init((n_experts, mlp_dim, dim)),
         "out": init((dim, vocab)),
     }
     param_specs = {
         "embed": P(), "wq": P(), "wk": P(), "wv": P(), "wo": P(),
-        "w1": P(None, "tp"), "w2": P("tp", None), "out": P(),
+        "w1": P(None, "tp"), "w2": P("tp", None),
+        "pp_w1": P("pp"), "pp_w2": P("pp"),
+        "router": P(),
+        "moe_w1": P("ep"), "moe_w2": P("ep"),
+        "out": P(),
     }
     param_shardings = {k: NamedSharding(mesh, s) for k, s in param_specs.items()}
     params = {k: jax.device_put(v, param_shardings[k]) for k, v in params.items()}
@@ -56,6 +82,10 @@ def build_multi_parallel_train_step(mesh: Mesh, vocab: int = 1024,
     targets = jnp.asarray(rng.randint(1, vocab, (batch, seq_len)), jnp.int32)
     example = (jax.device_put(tokens, batch_sharding),
                jax.device_put(targets, batch_sharding))
+
+    def pp_block(stage, x):
+        h = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, stage["w1"]))
+        return x + jnp.einsum("bsf,fd->bsd", h, stage["w2"])
 
     def forward(params, tokens):
         x = params["embed"][tokens]  # (b, s, d)
@@ -67,13 +97,22 @@ def build_multi_parallel_train_step(mesh: Mesh, vocab: int = 1024,
         # Tensor-parallel MLP: w1 column-sharded, w2 row-sharded over tp.
         h = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, params["w1"]))
         x = x + jnp.einsum("bsf,fd->bsd", h, params["w2"])
-        return jnp.einsum("bsd,dv->bsv", x, params["out"])
+        # Pipeline-parallel residual stack over pp.
+        x = pipeline_apply(
+            {"w1": params["pp_w1"], "w2": params["pp_w2"]}, x, mesh,
+            num_microbatches=num_microbatches, stage_fn=pp_block)
+        # Expert-parallel MoE layer over ep.
+        moe_out, aux = moe_mlp(x, params["router"], params["moe_w1"],
+                               params["moe_w2"], mesh)
+        x = x + moe_out
+        return jnp.einsum("bsd,dv->bsv", x, params["out"]), aux
 
     def loss_fn(params, tokens, targets):
-        logits = forward(params, tokens)
+        logits, aux = forward(params, tokens)
         logp = jax.nn.log_softmax(logits)
-        return -jnp.mean(jnp.take_along_axis(logp, targets[..., None],
-                                             axis=-1))
+        nll = -jnp.mean(jnp.take_along_axis(logp, targets[..., None],
+                                            axis=-1))
+        return nll + 1e-2 * aux
 
     lr = 1e-2
 

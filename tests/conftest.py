@@ -85,8 +85,6 @@ def cpu_subprocess_env():
     accelerator relay: CPU backend pinned and the relay address dropped,
     so a wedged tunnel can never hang a CPU-only test (the site hook
     dials the relay at import when the address is present)."""
-    env = {k: v for k, v in os.environ.items()
-           if k != "PALLAS_AXON_POOL_IPS"}
+    env = ambient_accelerator_env("PALLAS_AXON_POOL_IPS")
     env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     return env

@@ -13,7 +13,22 @@ The reference implementation has no attention kernel at all (vanilla
 torch softmax attention, workloads/pytorch/translation/transformer/
 SubLayers.py) — the parity target is the einsum path itself.
 """
+import subprocess
 import sys
+
+# Probe backend init in a disposable child first: a wedged relay makes
+# jax.devices() hang indefinitely, and a hang must read as a skip (75),
+# not a test failure.
+try:
+    probe = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        capture_output=True, timeout=90)
+except subprocess.TimeoutExpired:
+    print("SKIP: backend init timed out (wedged tunnel?)")
+    sys.exit(75)
+if probe.returncode != 0:
+    print("SKIP: backend init failed")
+    sys.exit(75)
 
 import jax
 import jax.numpy as jnp
