@@ -9,8 +9,18 @@ given or the files are absent (CI, benchmarks, dry runs), deterministic
 synthetic batches of the right shapes are produced on host instead —
 the reference's GavelIterator had the same synthetic-data escape hatch
 (gavel_iterator.py:89-92). Loaders expose `.synthetic` so the lease
-iterator only caches batches on the synthetic path. multi30k /
-monet2photo / ml20m are synthetic-only for now.
+iterator only caches batches on the synthetic path.
+
+Real formats supported per family:
+  cifar10     pickled python batches (cifar-10-batches-py/) or cifar10.npz
+  wikitext2   wiki.train.tokens / train.txt word stream
+  multi30k    train.de/train.en parallel sentence files (reference
+              preprocesses these into multi30k.atok.low.pt with torchtext;
+              we tokenize the raw pair files directly)
+  ml20m       pro_sg/train.csv (uid,sid) interaction list, the VAE-CF
+              preprocessing the reference's recoder consumes
+              (workloads/pytorch/recommendation/recoder/)
+  monet2photo trainA/ + trainB/ image folders (PIL) or monet2photo.npz
 """
 from __future__ import annotations
 
@@ -75,6 +85,61 @@ class ArrayBatches:
             yield tuple(a[idx] for a in self._arrays)
 
 
+class SparseRowBatches:
+    """Epochs of dense multi-hot rows densified per batch from per-row
+    item-index lists. ML-20M's full user×item matrix is ~9 GB dense, so
+    rows stay sparse on host and only each (batch, num_items) slab is
+    materialized. Reshuffles each epoch; drops the partial tail batch."""
+
+    synthetic = False
+
+    def __init__(self, rows: Sequence[np.ndarray], num_items: int,
+                 batch_size: int, seed: int = 0):
+        if len(rows) < batch_size:
+            raise ValueError(
+                f"dataset has {len(rows)} rows < batch_size {batch_size}")
+        self._rows = rows
+        self._num_items = num_items
+        self._bs = batch_size
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self):
+        return len(self._rows) // self._bs
+
+    def __iter__(self):
+        order = self._rng.permutation(len(self._rows))
+        for i in range(len(self)):
+            batch = np.zeros((self._bs, self._num_items), np.float32)
+            for j, r in enumerate(order[i * self._bs:(i + 1) * self._bs]):
+                batch[j, self._rows[r]] = 1.0
+            yield (batch,)
+
+
+class UnpairedBatches:
+    """Two independently shuffled domains (CycleGAN A/B); each epoch
+    yields min(len(A), len(B)) // batch_size unpaired (a, b) batches."""
+
+    synthetic = False
+
+    def __init__(self, a: np.ndarray, b: np.ndarray, batch_size: int,
+                 seed: int = 0):
+        if min(a.shape[0], b.shape[0]) < batch_size:
+            raise ValueError("domain smaller than batch_size")
+        self._a, self._b = a, b
+        self._bs = batch_size
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self):
+        return min(self._a.shape[0], self._b.shape[0]) // self._bs
+
+    def __iter__(self):
+        oa = self._rng.permutation(self._a.shape[0])
+        ob = self._rng.permutation(self._b.shape[0])
+        for i in range(len(self)):
+            sl = slice(i * self._bs, (i + 1) * self._bs)
+            yield self._a[oa[sl]], self._b[ob[sl]]
+
+
 def _load_cifar10(data_dir: str) -> Optional[tuple]:
     """Read CIFAR-10 from `data_dir`: either the standard pickled python
     batches (cifar-10-batches-py/data_batch_*) or a cifar10.npz with
@@ -126,8 +191,66 @@ def imagenet(batch_size: int, dataset_size: int = 100000, seed: int = 0):
     return SyntheticBatches(make, dataset_size // batch_size, seed)
 
 
+PAD, BOS, EOS, UNK = 0, 1, 2, 3
+
+
+def _load_multi30k(data_dir: str, src_len: int, tgt_len: int,
+                   vocab_cap: int) -> Optional[tuple]:
+    """Read the raw Multi30k parallel files (train.de source -> train.en
+    target, the reference task's direction). `data_dir` may be the
+    directory itself, a file inside it (the trace passes the reference's
+    preprocessed .pt path — we use its directory), or a parent holding a
+    multi30k/ subdir. Joint frequency-ranked vocab capped at `vocab_cap`
+    with PAD/BOS/EOS/UNK reserved; src truncated+padded to src_len, tgt
+    wrapped in BOS..EOS and padded to tgt_len."""
+    if not os.path.isdir(data_dir):
+        # The trace hands us the reference's .pt file path (which we never
+        # create); the raw pair files live in its directory.
+        data_dir = os.path.dirname(data_dir)
+    pair = None
+    for cand in (data_dir, os.path.join(data_dir, "multi30k")):
+        de, en = (os.path.join(cand, "train.de"), os.path.join(cand, "train.en"))
+        if os.path.exists(de) and os.path.exists(en):
+            pair = (de, en)
+            break
+    if pair is None:
+        return None
+    with open(pair[0], encoding="utf-8") as f:
+        src_lines = [ln.lower().split() for ln in f if ln.strip()]
+    with open(pair[1], encoding="utf-8") as f:
+        tgt_lines = [ln.lower().split() for ln in f if ln.strip()]
+    n = min(len(src_lines), len(tgt_lines))
+    if n == 0:
+        return None
+    src_lines, tgt_lines = src_lines[:n], tgt_lines[:n]
+    words = [w for ln in src_lines for w in ln]
+    words += [w for ln in tgt_lines for w in ln]
+    uniq, counts = np.unique(np.asarray(words), return_counts=True)
+    keep = uniq[np.argsort(-counts)][: vocab_cap - 4]
+    ids = {w: i + 4 for i, w in enumerate(keep)}
+
+    def encode(lines, length, wrap):
+        out = np.full((len(lines), length), PAD, np.int32)
+        for r, ln in enumerate(lines):
+            toks = [ids.get(w, UNK) for w in ln]
+            if wrap:
+                toks = [BOS] + toks[: length - 2] + [EOS]
+            else:
+                toks = toks[:length]
+            out[r, : len(toks)] = toks
+        return out
+
+    return encode(src_lines, src_len, False), encode(tgt_lines, tgt_len, True)
+
+
 def multi30k(batch_size: int, src_len: int = 32, tgt_len: int = 32,
-             vocab: int = 9521, dataset_size: int = 10000, seed: int = 0):
+             vocab: int = 9521, dataset_size: int = 10000, seed: int = 0,
+             data_dir: Optional[str] = None):
+    if data_dir:
+        real = _load_multi30k(data_dir, src_len, tgt_len, vocab)
+        if real is not None and real[0].shape[0] >= batch_size:
+            return ArrayBatches(real, batch_size, seed)
+
     def make(rng):
         src = rng.randint(1, vocab, size=(batch_size, src_len)).astype(np.int32)
         tgt = rng.randint(1, vocab, size=(batch_size, tgt_len)).astype(np.int32)
@@ -180,9 +303,67 @@ def wikitext2(batch_size: int, seq_len: int = 35, vocab: int = 33278,
     return SyntheticBatches(make, dataset_size // batch_size, seed)
 
 
+def _load_image_domain(folder: str, image_size: int) -> Optional[np.ndarray]:
+    """Decode every image in `folder` to (N, image_size, image_size, 3)
+    float32 in [-1, 1] (CycleGAN's tanh range)."""
+    if not os.path.isdir(folder):
+        return None
+    try:
+        from PIL import Image
+    except ImportError:
+        return None
+    exts = (".jpg", ".jpeg", ".png")
+    names = sorted(n for n in os.listdir(folder)
+                   if n.lower().endswith(exts))
+    if not names:
+        return None
+    out = np.empty((len(names), image_size, image_size, 3), np.float32)
+    for i, name in enumerate(names):
+        with Image.open(os.path.join(folder, name)) as im:
+            im = im.convert("RGB").resize((image_size, image_size))
+            out[i] = np.asarray(im, np.float32) / 127.5 - 1.0
+    return out
+
+
+def _load_monet2photo(data_dir: str, image_size: int) -> Optional[tuple]:
+    """trainA/ (paintings) + trainB/ (photos) folders, or monet2photo.npz
+    with A/B arrays."""
+    for cand in (data_dir, os.path.join(data_dir, "monet2photo")):
+        a = _load_image_domain(os.path.join(cand, "trainA"), image_size)
+        b = _load_image_domain(os.path.join(cand, "trainB"), image_size)
+        if a is not None and b is not None:
+            return a, b
+        npz = os.path.join(cand, "monet2photo.npz")
+        if os.path.exists(npz):
+            d = np.load(npz)
+            a, b = np.asarray(d["A"], np.float32), np.asarray(d["B"], np.float32)
+            if a.max() > 1.5:  # stored as uint8 range
+                a, b = a / 127.5 - 1.0, b / 127.5 - 1.0
+            a, b = (_resize_domain(x, image_size) for x in (a, b))
+            return a, b
+    return None
+
+
+def _resize_domain(x: np.ndarray, image_size: int) -> np.ndarray:
+    """Match stored images to the generators' (image_size, image_size)
+    input; nearest-neighbor index resampling keeps numpy-only."""
+    if x.shape[1] == image_size and x.shape[2] == image_size:
+        return x
+    ih = (np.arange(image_size) * x.shape[1] // image_size)
+    iw = (np.arange(image_size) * x.shape[2] // image_size)
+    return np.ascontiguousarray(x[:, ih][:, :, iw])
+
+
 def monet2photo(batch_size: int, image_size: int = 128,
-                dataset_size: int = 1193, seed: int = 0):
+                dataset_size: int = 1193, seed: int = 0,
+                data_dir: Optional[str] = None):
     """Unpaired image batches for CycleGAN (domains A=paintings, B=photos)."""
+    if data_dir:
+        real = _load_monet2photo(data_dir, image_size)
+        if real is not None and min(real[0].shape[0],
+                                    real[1].shape[0]) >= batch_size:
+            return UnpairedBatches(real[0], real[1], batch_size, seed)
+
     def make(rng):
         a = (rng.rand(batch_size, image_size, image_size, 3) * 2 - 1)
         b = (rng.rand(batch_size, image_size, image_size, 3) * 2 - 1)
@@ -190,8 +371,51 @@ def monet2photo(batch_size: int, image_size: int = 128,
     return SyntheticBatches(make, dataset_size // batch_size, seed)
 
 
+def _load_ml20m(data_dir: str, num_items: int) -> Optional[list]:
+    """Read the VAE-CF pro_sg interaction list: train.csv with a header
+    and (uid, sid) integer rows. Items are frequency-ranked and capped at
+    `num_items` (the model's output width); returns one sorted item-id
+    array per user."""
+    path = None
+    for cand in (data_dir, os.path.join(data_dir, "pro_sg"),
+                 os.path.join(data_dir, "ml-20m", "pro_sg")):
+        full = os.path.join(cand, "train.csv")
+        if os.path.exists(full):
+            path = full
+            break
+    if path is None:
+        return None
+    try:
+        pairs = np.genfromtxt(path, delimiter=",", skip_header=1,
+                              dtype=np.int64)
+    except Exception:  # noqa: BLE001 - malformed file -> synthetic fallback
+        return None
+    if pairs.ndim != 2 or pairs.shape[1] < 2 or pairs.shape[0] == 0:
+        return None
+    uids, sids = pairs[:, 0], pairs[:, 1]
+    # Frequency-rank items so the cap keeps the most-interacted ones.
+    uniq, inverse, counts = np.unique(sids, return_inverse=True,
+                                      return_counts=True)
+    rank = np.empty(len(uniq), np.int64)
+    rank[np.argsort(-counts)] = np.arange(len(uniq))
+    new_sid = rank[inverse]
+    keep = new_sid < num_items
+    uids, new_sid = uids[keep], new_sid[keep]
+    order = np.argsort(uids, kind="stable")
+    uids, new_sid = uids[order], new_sid[order]
+    bounds = np.searchsorted(uids, np.unique(uids))
+    rows = [np.sort(chunk.astype(np.int32))
+            for chunk in np.split(new_sid, bounds[1:])]
+    return [r for r in rows if r.size]
+
+
 def ml20m(batch_size: int, num_items: int = 20108, dataset_size: int = 117907,
-          seed: int = 0):
+          seed: int = 0, data_dir: Optional[str] = None):
+    if data_dir:
+        rows = _load_ml20m(data_dir, num_items)
+        if rows is not None and len(rows) >= batch_size:
+            return SparseRowBatches(rows, num_items, batch_size, seed)
+
     def make(rng):
         # ~1% interaction density multi-hot rows.
         rows = (rng.rand(batch_size, num_items) < 0.01).astype(np.float32)

@@ -44,7 +44,9 @@ def _pipeline_local(stage_params, microbatches, *, stage_fn: Callable,
 
     def tick(carry, t):
         buf, outs = carry
-        # Stage 0 consumes microbatch t (zeros once the trace drains).
+        # Stage 0 consumes microbatch t; once the trace drains it keeps
+        # re-injecting the last microbatch, whose outputs never reach the
+        # out_idx window and are discarded.
         inject = lax.dynamic_index_in_dim(
             microbatches, jnp.minimum(t, n_micro - 1), keepdims=False)
         x = jnp.where(stage == 0, inject, buf)

@@ -113,7 +113,8 @@ def main():
     state = jax.device_put(state, repl_sharding)
     step_fn = build_step((g_ab, g_ba, d_a, d_b), g_tx, d_tx)
 
-    loader = data.monet2photo(args.batch_size, args.img_size)
+    loader = data.monet2photo(args.batch_size, args.img_size,
+                              data_dir=args.dataset_path)
     ckpt = checkpoint_path(args.checkpoint_dir)
 
     def load(path):

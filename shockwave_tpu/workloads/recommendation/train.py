@@ -37,7 +37,8 @@ def main():
 
     trainer = Trainer(
         args, loss_fn, init_state,
-        data.ml20m(args.batch_size),
+        data.ml20m(args.batch_size, num_items=model.num_items,
+                   data_dir=args.data_dir),
         initial_bs=args.batch_size, max_bs=8192, learning_rate=1e-3)
     trainer.run()
 

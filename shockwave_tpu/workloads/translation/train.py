@@ -50,7 +50,7 @@ def main():
 
     trainer = Trainer(
         args, loss_fn, init_state,
-        data.multi30k(args.batch_size, tgt_len=33),
+        data.multi30k(args.batch_size, tgt_len=33, data_dir=args.data),
         initial_bs=args.batch_size, max_bs=128, learning_rate=1e-3)
     trainer.run()
 

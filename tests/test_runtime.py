@@ -645,6 +645,11 @@ class TestAccordionEndToEnd:
         # 10-batch epochs: the accordion monitor decides once per epoch,
         # and dataset-sized epochs would take many rounds.
         env["SWTPU_SYNTH_EPOCH_BATCHES"] = "10"
+        # Log to a file, not a PIPE: the worker (and the job grandchild
+        # that inherits the fd) can emit more than the OS pipe buffer
+        # over a 400 s run, and an undrained pipe would deadlock them.
+        log_path = tmp_path / "worker.log"
+        log_f = open(log_path, "w")
         worker = subprocess.Popen(
             [sys.executable, "-m", "shockwave_tpu.runtime.worker",
              "--worker_type", "v100", "--sched_addr", "127.0.0.1",
@@ -652,7 +657,7 @@ class TestAccordionEndToEnd:
              "--worker_port", str(worker_port), "--num_chips", "1",
              "--data_dir", str(tmp_path / "nodata"),
              "--checkpoint_dir", str(tmp_path / "ckpt")],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            stdout=log_f, stderr=subprocess.STDOUT, text=True,
             cwd=REPO_ROOT, env=env)
         try:
             job = Job(None, "ResNet-18 (batch size 128)",
@@ -674,13 +679,12 @@ class TestAccordionEndToEnd:
             sched._done_event.set()
             worker.terminate()
             try:
-                out, _ = worker.communicate(timeout=30)
+                worker.wait(timeout=30)
             except subprocess.TimeoutExpired:
-                # A job grandchild can inherit the stdout pipe and keep
-                # it open past the daemon's death; don't mask the real
-                # assertion with a pipe timeout.
                 worker.kill()
-                out, _ = worker.communicate(timeout=30)
+                worker.wait(timeout=30)
+            log_f.close()
+            out = log_path.read_text()
             sched._server.stop(grace=0)
         # The redispatch after the resize must carry the doubled batch.
         assert "--batch_size 256" in out, out[-3000:]
