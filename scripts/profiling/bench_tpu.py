@@ -150,7 +150,9 @@ def attention_bench(b=4, t=2048, h=8, d=64):
 
 def main():
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--batch", type=int, default=128,
+                   help="the Transformer family's largest trace batch "
+                        "size (core/job_table.py)")
     p.add_argument("--steps", type=int, default=30)
     args = p.parse_args()
 

@@ -13,6 +13,7 @@ iterator only caches batches on the synthetic path.
 
 Real formats supported per family:
   cifar10     pickled python batches (cifar-10-batches-py/) or cifar10.npz
+  imagenet    train/<class>/ image folders, decoded lazily per batch
   wikitext2   wiki.train.tokens / train.txt word stream
   multi30k    train.de/train.en parallel sentence files (reference
               preprocesses these into multi30k.atok.low.pt with torchtext;
@@ -117,27 +118,43 @@ class SparseRowBatches:
 
 class UnpairedBatches:
     """Two independently shuffled domains (CycleGAN A/B); each epoch
-    yields min(len(A), len(B)) // batch_size unpaired (a, b) batches."""
+    yields min(len(A), len(B)) // batch_size unpaired (a, b) batches.
+    Each domain is either an in-memory array or a list of image paths
+    decoded lazily per batch (an epoch touches only min(len(A), len(B))
+    images, so eagerly decoding a large domain would waste minutes and
+    GBs at every lease re-dispatch)."""
 
     synthetic = False
 
-    def __init__(self, a: np.ndarray, b: np.ndarray, batch_size: int,
+    def __init__(self, a, b, batch_size: int, image_size: int = 128,
                  seed: int = 0):
-        if min(a.shape[0], b.shape[0]) < batch_size:
+        if min(len(a), len(b)) < batch_size:
             raise ValueError("domain smaller than batch_size")
         self._a, self._b = a, b
         self._bs = batch_size
+        self._size = image_size
         self._rng = np.random.RandomState(seed)
 
     def __len__(self):
-        return min(self._a.shape[0], self._b.shape[0]) // self._bs
+        return min(len(self._a), len(self._b)) // self._bs
+
+    def _take(self, domain, idx):
+        if isinstance(domain, np.ndarray):
+            return domain[idx]
+        from PIL import Image
+        out = np.empty((len(idx), self._size, self._size, 3), np.float32)
+        for j, r in enumerate(idx):
+            with Image.open(domain[r]) as im:
+                im = im.convert("RGB").resize((self._size, self._size))
+                out[j] = np.asarray(im, np.float32) / 127.5 - 1.0
+        return out
 
     def __iter__(self):
-        oa = self._rng.permutation(self._a.shape[0])
-        ob = self._rng.permutation(self._b.shape[0])
+        oa = self._rng.permutation(len(self._a))
+        ob = self._rng.permutation(len(self._b))
         for i in range(len(self)):
             sl = slice(i * self._bs, (i + 1) * self._bs)
-            yield self._a[oa[sl]], self._b[ob[sl]]
+            yield self._take(self._a, oa[sl]), self._take(self._b, ob[sl])
 
 
 def _load_cifar10(data_dir: str) -> Optional[tuple]:
@@ -184,7 +201,76 @@ def cifar10(batch_size: int, data_dir: Optional[str] = None,
     return SyntheticBatches(make, dataset_size // batch_size, seed)
 
 
-def imagenet(batch_size: int, dataset_size: int = 100000, seed: int = 0):
+class LazyImageFolderBatches:
+    """ImageFolder-style epochs decoded lazily per batch: train/<class>/
+    image files, label = class-dir index. The full dataset never sits in
+    RAM (ImageNet is ~150 GB decoded) — only each (batch, size, size, 3)
+    slab, matching the torchvision ImageFolder+DataLoader behavior the
+    reference relies on. Shuffles each epoch; drops the partial tail."""
+
+    synthetic = False
+
+    def __init__(self, files: Sequence[str], labels: np.ndarray,
+                 batch_size: int, image_size: int = 224, seed: int = 0):
+        if len(files) < batch_size:
+            raise ValueError(
+                f"dataset has {len(files)} images < batch_size {batch_size}")
+        self._files = files
+        self._labels = labels
+        self._bs = batch_size
+        self._size = image_size
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self):
+        return len(self._files) // self._bs
+
+    def __iter__(self):
+        from PIL import Image
+        order = self._rng.permutation(len(self._files))
+        for i in range(len(self)):
+            idx = order[i * self._bs:(i + 1) * self._bs]
+            batch = np.empty((self._bs, self._size, self._size, 3),
+                             np.float32)
+            for j, r in enumerate(idx):
+                with Image.open(self._files[r]) as im:
+                    im = im.convert("RGB").resize((self._size, self._size))
+                    batch[j] = np.asarray(im, np.float32) / 255.0
+            yield batch, self._labels[idx].astype(np.int32)
+
+
+def _scan_image_folder(data_dir: str) -> Optional[tuple]:
+    """(files, labels) from a train/<class>/* tree (or <class>/* directly
+    under data_dir). Returns None when no class dirs with images exist."""
+    try:
+        from PIL import Image  # noqa: F401 - decoding needs PIL later
+    except ImportError:
+        return None
+    exts = (".jpg", ".jpeg", ".png", ".bmp")
+    for root in (os.path.join(data_dir, "train"), data_dir):
+        if not os.path.isdir(root):
+            continue
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        files, labels = [], []
+        for ci, cls in enumerate(classes):
+            cdir = os.path.join(root, cls)
+            for name in sorted(os.listdir(cdir)):
+                if name.lower().endswith(exts):
+                    files.append(os.path.join(cdir, name))
+                    labels.append(ci)
+        if files:
+            return files, np.asarray(labels, np.int64)
+    return None
+
+
+def imagenet(batch_size: int, dataset_size: int = 100000, seed: int = 0,
+             data_dir: Optional[str] = None):
+    if data_dir:
+        scanned = _scan_image_folder(data_dir)
+        if scanned is not None and len(scanned[0]) >= batch_size:
+            return LazyImageFolderBatches(scanned[0], scanned[1], batch_size,
+                                          seed=seed)
+
     def make(rng):
         return (rng.rand(batch_size, 224, 224, 3).astype(np.float32),
                 rng.randint(0, 1000, size=(batch_size,)).astype(np.int32))
@@ -215,14 +301,19 @@ def _load_multi30k(data_dir: str, src_len: int, tgt_len: int,
             break
     if pair is None:
         return None
+    # Pair lines positionally FIRST, then drop pairs with a blank side:
+    # filtering each file independently would shift every pair after a
+    # blank line present in only one file.
     with open(pair[0], encoding="utf-8") as f:
-        src_lines = [ln.lower().split() for ln in f if ln.strip()]
+        src_raw = f.read().splitlines()
     with open(pair[1], encoding="utf-8") as f:
-        tgt_lines = [ln.lower().split() for ln in f if ln.strip()]
-    n = min(len(src_lines), len(tgt_lines))
-    if n == 0:
+        tgt_raw = f.read().splitlines()
+    pairs = [(s.lower().split(), t.lower().split())
+             for s, t in zip(src_raw, tgt_raw) if s.strip() and t.strip()]
+    if not pairs:
         return None
-    src_lines, tgt_lines = src_lines[:n], tgt_lines[:n]
+    src_lines = [s for s, _ in pairs]
+    tgt_lines = [t for _, t in pairs]
     words = [w for ln in src_lines for w in ln]
     words += [w for ln in tgt_lines for w in ln]
     uniq, counts = np.unique(np.asarray(words), return_counts=True)
@@ -303,13 +394,13 @@ def wikitext2(batch_size: int, seq_len: int = 35, vocab: int = 33278,
     return SyntheticBatches(make, dataset_size // batch_size, seed)
 
 
-def _load_image_domain(folder: str, image_size: int) -> Optional[np.ndarray]:
-    """Decode every image in `folder` to (N, image_size, image_size, 3)
-    float32 in [-1, 1] (CycleGAN's tanh range)."""
+def _list_image_domain(folder: str) -> Optional[list]:
+    """Sorted image paths in `folder`; decoding happens per batch in
+    UnpairedBatches (float32 in [-1, 1], CycleGAN's tanh range)."""
     if not os.path.isdir(folder):
         return None
     try:
-        from PIL import Image
+        from PIL import Image  # noqa: F401 - decoding needs PIL later
     except ImportError:
         return None
     exts = (".jpg", ".jpeg", ".png")
@@ -317,20 +408,15 @@ def _load_image_domain(folder: str, image_size: int) -> Optional[np.ndarray]:
                    if n.lower().endswith(exts))
     if not names:
         return None
-    out = np.empty((len(names), image_size, image_size, 3), np.float32)
-    for i, name in enumerate(names):
-        with Image.open(os.path.join(folder, name)) as im:
-            im = im.convert("RGB").resize((image_size, image_size))
-            out[i] = np.asarray(im, np.float32) / 127.5 - 1.0
-    return out
+    return [os.path.join(folder, n) for n in names]
 
 
 def _load_monet2photo(data_dir: str, image_size: int) -> Optional[tuple]:
-    """trainA/ (paintings) + trainB/ (photos) folders, or monet2photo.npz
-    with A/B arrays."""
+    """trainA/ (paintings) + trainB/ (photos) folders (lazy path lists),
+    or monet2photo.npz with A/B arrays."""
     for cand in (data_dir, os.path.join(data_dir, "monet2photo")):
-        a = _load_image_domain(os.path.join(cand, "trainA"), image_size)
-        b = _load_image_domain(os.path.join(cand, "trainB"), image_size)
+        a = _list_image_domain(os.path.join(cand, "trainA"))
+        b = _list_image_domain(os.path.join(cand, "trainB"))
         if a is not None and b is not None:
             return a, b
         npz = os.path.join(cand, "monet2photo.npz")
@@ -360,9 +446,10 @@ def monet2photo(batch_size: int, image_size: int = 128,
     """Unpaired image batches for CycleGAN (domains A=paintings, B=photos)."""
     if data_dir:
         real = _load_monet2photo(data_dir, image_size)
-        if real is not None and min(real[0].shape[0],
-                                    real[1].shape[0]) >= batch_size:
-            return UnpairedBatches(real[0], real[1], batch_size, seed)
+        if real is not None and min(len(real[0]),
+                                    len(real[1])) >= batch_size:
+            return UnpairedBatches(real[0], real[1], batch_size,
+                                   image_size=image_size, seed=seed)
 
     def make(rng):
         a = (rng.rand(batch_size, image_size, image_size, 3) * 2 - 1)
@@ -386,11 +473,14 @@ def _load_ml20m(data_dir: str, num_items: int) -> Optional[list]:
     if path is None:
         return None
     try:
-        pairs = np.genfromtxt(path, delimiter=",", skip_header=1,
-                              dtype=np.int64)
+        # The real file is ~10M rows; np.loadtxt's C tokenizer parses it
+        # in seconds, where genfromtxt's python loop takes minutes — and
+        # jobs re-pay loader startup on every lease re-dispatch.
+        pairs = np.loadtxt(path, delimiter=",", skiprows=1, dtype=np.int64,
+                           usecols=(0, 1), ndmin=2)
     except Exception:  # noqa: BLE001 - malformed file -> synthetic fallback
         return None
-    if pairs.ndim != 2 or pairs.shape[1] < 2 or pairs.shape[0] == 0:
+    if pairs.shape[0] == 0 or pairs.shape[1] < 2:
         return None
     uids, sids = pairs[:, 0], pairs[:, 1]
     # Frequency-rank items so the cap keeps the most-interacted ones.

@@ -44,7 +44,7 @@ def main():
 
     trainer = Trainer(
         args, loss_fn, init_state,
-        data.imagenet(args.batch_size),
+        data.imagenet(args.batch_size, data_dir=args.data),
         initial_bs=args.batch_size, max_bs=128, learning_rate=0.1)
     trainer.run()
 

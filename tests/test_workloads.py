@@ -353,6 +353,32 @@ class TestRealDataLoaders:
         xa8, _ = next(iter(loader8))
         assert xa8.shape == (3, 8, 8, 3)
 
+    def test_imagenet_real_folder(self, tmp_path):
+        pytest.importorskip("PIL")
+        from PIL import Image
+
+        import numpy as np
+        from shockwave_tpu.models import data
+        root = tmp_path / "imagenet" / "train"
+        for ci, cls in enumerate(("n01440764", "n01443537")):
+            d = root / cls
+            d.mkdir(parents=True)
+            for i in range(4):
+                arr = np.full((30, 40, 3), 40 * ci + i, dtype="uint8")
+                Image.fromarray(arr).save(d / f"im{i}.jpg")
+        loader = data.imagenet(4, data_dir=str(tmp_path / "imagenet"))
+        assert not loader.synthetic
+        assert len(loader) == 8 // 4
+        images, labels = next(iter(loader))
+        assert images.shape == (4, 224, 224, 3)
+        assert images.dtype.name == "float32"
+        assert 0.0 <= images.min() and images.max() <= 1.0
+        assert set(labels.tolist()) <= {0, 1}
+
+    def test_imagenet_fallback_when_missing(self, tmp_path):
+        from shockwave_tpu.models import data
+        assert data.imagenet(4, data_dir=str(tmp_path / "nope")).synthetic
+
     def test_monet2photo_real_folders(self, tmp_path):
         PIL = pytest.importorskip("PIL")
         from PIL import Image
