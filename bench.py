@@ -19,6 +19,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_MAKESPAN_S = 24197.42350629904  # reference shockwave pickle
@@ -44,6 +45,7 @@ def tpu_phase():
 
 
 def main():
+    sim_start = time.monotonic()
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts/drivers/simulate.py"),
          "--trace", os.path.join(REPO, "data/canonical_120job.trace"),
@@ -66,6 +68,9 @@ def main():
         "vs_baseline": round(makespan / BASELINE_MAKESPAN_S, 4),
         "avg_jct": result["avg_jct"],
         "unfair_fraction": result["unfair_fraction"],
+        # Scheduler-core speed: wall time to replay the whole canonical
+        # trace, MILP solves included (reference: ~600 s, README.md:48).
+        "sim_wall_s": round(time.monotonic() - sim_start, 1),
     }
     line.update(tpu_phase())
     print(json.dumps(line))

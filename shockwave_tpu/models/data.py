@@ -86,6 +86,17 @@ class ArrayBatches:
             yield tuple(a[idx] for a in self._arrays)
 
 
+def _decode_image(path: str, size: int, scale: float,
+                  offset: float) -> np.ndarray:
+    """Decode one image file to (size, size, 3) float32 as
+    pixel/scale + offset (classification: /255 in [0,1]; GAN tanh
+    range: /127.5 - 1)."""
+    from PIL import Image
+    with Image.open(path) as im:
+        im = im.convert("RGB").resize((size, size))
+        return np.asarray(im, np.float32) / scale + offset
+
+
 class SparseRowBatches:
     """Epochs of dense multi-hot rows densified per batch from per-row
     item-index lists. ML-20M's full user×item matrix is ~9 GB dense, so
@@ -141,12 +152,9 @@ class UnpairedBatches:
     def _take(self, domain, idx):
         if isinstance(domain, np.ndarray):
             return domain[idx]
-        from PIL import Image
         out = np.empty((len(idx), self._size, self._size, 3), np.float32)
         for j, r in enumerate(idx):
-            with Image.open(domain[r]) as im:
-                im = im.convert("RGB").resize((self._size, self._size))
-                out[j] = np.asarray(im, np.float32) / 127.5 - 1.0
+            out[j] = _decode_image(domain[r], self._size, 127.5, -1.0)
         return out
 
     def __iter__(self):
@@ -225,16 +233,14 @@ class LazyImageFolderBatches:
         return len(self._files) // self._bs
 
     def __iter__(self):
-        from PIL import Image
         order = self._rng.permutation(len(self._files))
         for i in range(len(self)):
             idx = order[i * self._bs:(i + 1) * self._bs]
             batch = np.empty((self._bs, self._size, self._size, 3),
                              np.float32)
             for j, r in enumerate(idx):
-                with Image.open(self._files[r]) as im:
-                    im = im.convert("RGB").resize((self._size, self._size))
-                    batch[j] = np.asarray(im, np.float32) / 255.0
+                batch[j] = _decode_image(self._files[r], self._size,
+                                         255.0, 0.0)
             yield batch, self._labels[idx].astype(np.int32)
 
 
@@ -480,7 +486,7 @@ def _load_ml20m(data_dir: str, num_items: int) -> Optional[list]:
                            usecols=(0, 1), ndmin=2)
     except Exception:  # noqa: BLE001 - malformed file -> synthetic fallback
         return None
-    if pairs.shape[0] == 0 or pairs.shape[1] < 2:
+    if pairs.shape[0] == 0:
         return None
     uids, sids = pairs[:, 0], pairs[:, 1]
     # Frequency-rank items so the cap keeps the most-interacted ones.

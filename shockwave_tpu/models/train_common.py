@@ -272,10 +272,7 @@ class Trainer:
             iterator = LeaseIterator(
                 self.data_loader, args.checkpoint_dir,
                 load_checkpoint_func=self._load, save_checkpoint_func=self._save,
-                # Batch caching is only sound for synthetic data; a real
-                # loader (ArrayBatches) must feed fresh batches.
-                synthetic_data=(args.synthetic_data and getattr(
-                    self.data_loader, "synthetic", True)),
+                synthetic_data=args.synthetic_data,
                 distributed_barrier=barrier,
                 gang_allreduce=gang_allreduce)
         else:

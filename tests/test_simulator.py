@@ -241,3 +241,61 @@ class TestCostSLOTimelines:
         assert "SUBMITTED" in log
         assert "MICROTASK" in log
         assert "COMPLETED" in log
+
+
+class TestJobMetadataCaches:
+    """The calibration + duration-map caches added for MILP-loop speed
+    must be invisible: same results, recomputed only when the shared
+    measurement timeline actually changes."""
+
+    def _meta(self):
+        from collections import OrderedDict
+
+        from shockwave_tpu.shockwave.metadata import JobMetadata
+        profile = {
+            "model": "ResNet-18", "dataset": "cifar10", "num_epochs": 4,
+            "num_samples_per_epoch": 1000,
+            "bs_every_epoch": [32, 32, 64, 64],
+            "mem_every_epoch": [1024] * 4,
+            "util_every_epoch": [50] * 4,
+            "duration_every_epoch": [100.0] * 4,
+            "scale_factor": 1, "duration": 400.0,
+        }
+        meta = JobMetadata(7, profile)
+        timeline = OrderedDict()
+        meta.attach_throughput_measurements(timeline, round_duration=10.0)
+        return meta, timeline
+
+    def test_dmap_cached_until_recalibration(self):
+        meta, timeline = self._meta()
+        m1 = meta.bs_epoch_duration_map()
+        assert m1 == {32: 100.0, 64: 100.0}
+        assert meta.bs_epoch_duration_map() is m1  # cache hit
+        # Measured sample rate ~4x the profile (>40% deviation): the
+        # calibration rescales epoch durations and must drop the cache.
+        timeline[1] = (40.0, 32)  # 40 steps/s * bs32 * 10 s = 12800 samples
+        m2 = meta.bs_epoch_duration_map()
+        assert m2 is not m1
+        assert m2[32] < m1[32]
+        # Unchanged timeline -> cached again.
+        assert meta.bs_epoch_duration_map() is m2
+
+    def test_same_round_overwrite_invalidates(self):
+        meta, timeline = self._meta()
+        timeline[1] = (40.0, 32)
+        m1 = meta.bs_epoch_duration_map()
+        # A second worker's done callback overwrites round 1 with a
+        # different measurement: fingerprint must notice (same len/key).
+        timeline[1] = (0.1, 32)  # now ~3x SLOWER than profile
+        m2 = meta.bs_epoch_duration_map()
+        assert m2 is not m1
+        assert m2[32] > m1[32]
+
+    def test_dirichlet_matches_uncached_formula(self):
+        meta, timeline = self._meta()
+        est = meta.dirichlet_posterior_remaining_runtime()
+        # Fresh instance, no cache warm-up: identical estimate.
+        meta2, _ = self._meta()
+        meta2.bs_epoch_duration_map()
+        assert meta2.dirichlet_posterior_remaining_runtime() == est
+        assert est > 0
