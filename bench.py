@@ -27,11 +27,23 @@ BASELINE_MAKESPAN_S = 24197.42350629904  # reference shockwave pickle
 
 def tpu_phase():
     """Run the single-chip TPU bench in a subprocess; {} when unavailable."""
+    # Cheap liveness probe first: with a dead/wedged accelerator tunnel
+    # even backend init blocks forever, and the full 600 s bench timeout
+    # would be wasted on a chip that can't answer.
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"tpu_error": "backend liveness probe timed out "
+                             "(wedged accelerator tunnel?)"}
+    if probe.returncode != 0:
+        return {"tpu_error": "backend init failed: " + probe.stderr[-300:]}
     try:
         out = subprocess.run(
             [sys.executable,
              os.path.join(REPO, "scripts/profiling/bench_tpu.py")],
-            capture_output=True, text=True, timeout=1200, cwd=REPO)
+            capture_output=True, text=True, timeout=600, cwd=REPO)
     except subprocess.TimeoutExpired:
         return {"tpu_error": "bench_tpu timeout"}
     if out.returncode == 75:

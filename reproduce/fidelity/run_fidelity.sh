@@ -16,23 +16,31 @@ OUT=${1:-reproduce/fidelity/out}   # untracked by default; pass
                                    # reproduce/fidelity to refresh the
                                    # committed artifacts deliberately
 PORT=${2:-50381}
-ROUND=120
-TRACE=reproduce/fidelity/fidelity_3job.trace
+ROUND=${ROUND:-120}
+TRACE=${TRACE:-reproduce/fidelity/fidelity_3job.trace}
+# No TPU attached? The same experiment runs on CPU (this produced the
+# committed reproduce/fidelity/cpu_loopback artifacts):
+#   JAX_PLATFORMS=cpu WORKER_TYPE=cpu ROUND=120 \
+#   TRACE=reproduce/fidelity/fidelity_cpu_3job.trace \
+#   ORACLE=reproduce/fidelity/cpu_throughputs.json \
+#   reproduce/fidelity/run_fidelity.sh reproduce/fidelity/cpu_loopback
+WORKER_TYPE=${WORKER_TYPE:-v5e}
+ORACLE=${ORACLE:-data/v5e_throughputs.json}
 CKPT=$(mktemp -d /tmp/swtpu_fidelity.XXXX)
 mkdir -p "$OUT"
 
 python scripts/drivers/run_physical.py \
     --trace "$TRACE" --policy max_min_fairness \
-    --throughputs data/v5e_throughputs.json \
+    --throughputs "$ORACLE" \
     --expected_num_workers 1 --round_duration "$ROUND" --port "$PORT" \
     --timeout 3600 --timeline_dir "$OUT/timelines" \
-    --output "$OUT/physical_v5e.pkl" --verbose &
+    --output "$OUT/physical_${WORKER_TYPE}.pkl" --verbose &
 SCHED_PID=$!
 # The worker must die with the script, even if the scheduler fails.
 WORKER_PID=""
 trap '[ -n "$WORKER_PID" ] && kill "$WORKER_PID" 2>/dev/null || true' EXIT
 sleep 5
-python -m shockwave_tpu.runtime.worker --worker_type v5e \
+python -m shockwave_tpu.runtime.worker --worker_type "$WORKER_TYPE" \
     --sched_addr 127.0.0.1 --sched_port "$PORT" --worker_port "$((PORT+1))" \
     --num_chips 1 --data_dir /tmp/swtpu_data --checkpoint_dir "$CKPT" &
 WORKER_PID=$!
@@ -42,10 +50,10 @@ kill "$WORKER_PID" 2>/dev/null || true
 
 python scripts/drivers/simulate.py \
     --trace "$TRACE" --policy max_min_fairness \
-    --throughputs data/v5e_throughputs.json \
-    --cluster_spec v5e:1 --round_duration "$ROUND" \
-    --output "$OUT/simulated_v5e.pkl"
+    --throughputs "$ORACLE" \
+    --cluster_spec "$WORKER_TYPE:1" --round_duration "$ROUND" \
+    --output "$OUT/simulated_${WORKER_TYPE}.pkl"
 
 python reproduce/analyze_fidelity.py \
-    "$OUT/physical_v5e.pkl" "$OUT/simulated_v5e.pkl" --tolerance 0.15 \
+    "$OUT/physical_${WORKER_TYPE}.pkl" "$OUT/simulated_${WORKER_TYPE}.pkl" --tolerance 0.15 \
     | tee "$OUT/fidelity_report.txt"

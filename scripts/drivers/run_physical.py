@@ -110,7 +110,13 @@ def main():
         threading.Thread(target=_deadline, daemon=True).start()
 
     sched.run()
-    makespan = time.time() - start_time
+    # Last completion, not teardown: run() returning includes the final
+    # round's drain + shutdown, which the reference's makespan (stamped
+    # as soon as is_done polls true) does not contain. The physical
+    # clock is wall time, so rebase against the driver's start.
+    last_done = sched.get_last_completion_time()
+    makespan = (last_done - start_time) if last_done else (
+        time.time() - start_time)
 
     jct = sched.get_average_jct()
     ftf_static, ftf_themis = sched.get_finish_time_fairness()

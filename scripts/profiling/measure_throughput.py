@@ -212,6 +212,11 @@ def main():
     p.add_argument("--worker_type", default="v5e")
     p.add_argument("--output", required=True)
     p.add_argument("--families", nargs="*", default=list(FAMILY_BATCH_SIZES))
+    p.add_argument("--only", nargs="*", default=None, metavar="FAMILY:BS",
+                   help="profile exactly these family:batch_size rows "
+                        "(e.g. ResNet-18:32 LM:20), overriding --families; "
+                        "the reference profiler takes explicit job types "
+                        "the same way")
     p.add_argument("--scale_factors", nargs="*", type=int, default=[1, 2, 4, 8])
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=5)
@@ -225,24 +230,36 @@ def main():
             oracle = json.load(f)
     table = oracle.setdefault(args.worker_type, {})
 
+    if args.only:
+        rows = []
+        for spec in args.only:
+            family, sep, bs = spec.rpartition(":")
+            if not sep or family not in FAMILY_BATCH_SIZES \
+                    or not bs.isdigit():
+                p.error(f"--only expects FAMILY:BS with FAMILY one of "
+                        f"{sorted(FAMILY_BATCH_SIZES)}; got {spec!r}")
+            rows.append((family, int(bs)))
+    else:
+        rows = [(family, bs) for family in args.families
+                for bs in FAMILY_BATCH_SIZES[family]]
+
     n_devices = len(jax.devices())
-    for family in args.families:
-        for bs in FAMILY_BATCH_SIZES[family]:
-            for sf in args.scale_factors:
-                if sf > n_devices:
-                    print(f"skip {family} bs={bs} sf={sf}: "
-                          f"only {n_devices} devices", file=sys.stderr)
-                    continue
-                if family in DEFAULT_BS and sf > 1:
-                    continue  # A3C / CycleGAN are single-chip families
-                tput = measure(family, bs, sf, args.steps, args.warmup)
-                if tput is None:
-                    continue
-                job_type = oracle_job_type(family, bs)
-                key = str((job_type, sf))
-                table.setdefault(key, {})["null"] = round(tput, 4)
-                print(f"{args.worker_type} {key}: {tput:.3f} steps/s",
-                      flush=True)
+    for family, bs in rows:
+        for sf in args.scale_factors:
+            if sf > n_devices:
+                print(f"skip {family} bs={bs} sf={sf}: "
+                      f"only {n_devices} devices", file=sys.stderr)
+                continue
+            if family in DEFAULT_BS and sf > 1:
+                continue  # A3C / CycleGAN are single-chip families
+            tput = measure(family, bs, sf, args.steps, args.warmup)
+            if tput is None:
+                continue
+            job_type = oracle_job_type(family, bs)
+            key = str((job_type, sf))
+            table.setdefault(key, {})["null"] = round(tput, 4)
+            print(f"{args.worker_type} {key}: {tput:.3f} steps/s",
+                  flush=True)
 
     os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
     with open(args.output, "w") as f:

@@ -269,6 +269,36 @@ class PhysicalScheduler(Scheduler):
                     self.rounds.next_assignments[job_id])
             self._cv.notify_all()
 
+    def _inflight_elapsed_times(self, current_time: float):
+        """Unaccounted time of currently-running microtasks, charged into
+        the priority fractions (reference: scheduler.py:3640-3666). Done
+        callbacks only arrive when a process exits, so without this a
+        lease-extended job looks like it has received no time at all and
+        sticky placement would re-extend it until completion, starving
+        the queue (observed as sequential JCTs in the CPU loopback
+        fidelity run)."""
+        inflight_job: dict = {}
+        inflight_worker: dict = {}
+        for job_id, worker_ids in self.rounds.current_assignments.items():
+            member = job_id.singletons()[0]
+            # Only microtasks whose process is still alive: an exited
+            # job stays in current_assignments until the round boundary,
+            # but its real time was already charged by its done
+            # callback — counting idle tail time would double-charge.
+            if member not in self._running_jobs:
+                continue
+            dispatch = self.acct.latest_timestamps.get(member)
+            if dispatch is None or not worker_ids:
+                continue
+            elapsed = current_time - max(dispatch, self._last_reset_time)
+            if elapsed <= 0:
+                continue
+            wt = self.workers.id_to_type[worker_ids[0]]
+            per_wt = inflight_job.setdefault(job_id, {})
+            per_wt[wt] = per_wt.get(wt, 0.0) + elapsed
+            inflight_worker[wt] = inflight_worker.get(wt, 0.0) + elapsed
+        return inflight_job, inflight_worker
+
     # ------------------------------------------------------------------
     # Allocation thread
     # ------------------------------------------------------------------

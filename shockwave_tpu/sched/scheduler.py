@@ -122,6 +122,7 @@ class Scheduler:
         self._iterator_log_buffers: Dict[JobIdPair, list] = {}
 
         self._completed_jobs: Set[JobIdPair] = set()
+        self._last_completion_time = 0.0
         self._running_jobs: Set[JobIdPair] = set()
         self._in_progress_updates: Dict[JobIdPair, list] = {}
         self._steps_run_in_current_lease: Dict[JobIdPair, int] = {}
@@ -226,6 +227,8 @@ class Scheduler:
         self._completed_jobs.add(job_id)
         duration = a.latest_timestamps[job_id] - a.start_timestamps[job_id]
         a.completion_times[job_id] = duration
+        self._last_completion_time = max(self._last_completion_time,
+                                         a.latest_timestamps[job_id])
         a.priority_weights_archive[job_id] = a.jobs[job_id].priority_weight
         int_id = job_id.integer_job_id()
         self._job_timelines.setdefault(int_id, []).append(
@@ -413,6 +416,17 @@ class Scheduler:
                 self.acct.worker_type_time[wt] += self.acct.job_time[job_id][wt]
         self._last_reset_time = current_time
 
+    def _inflight_elapsed_times(self, current_time: float):
+        """(per-job, per-worker-type) time of microtasks still running.
+
+        Simulation charges time at done-callbacks only, so this is empty;
+        the physical scheduler overrides it. Without the in-flight term a
+        job holding an extended lease never reports a Done, its received
+        fraction never grows, and sticky placement re-extends it forever
+        while the other jobs starve (reference: scheduler.py:3640-3666
+        adds exactly this elapsed-time correction in physical mode)."""
+        return {}, {}
+
     def _update_priorities(self):
         current_time = self.get_current_timestamp()
         reset_elapsed = (current_time - self._last_reset_time
@@ -426,8 +440,11 @@ class Scheduler:
                 self._allocation = self._compute_allocation()
                 self._need_to_update_allocation = False
 
+        inflight_job, inflight_worker = self._inflight_elapsed_times(
+            current_time)
         for wt in self.workers.worker_types:
-            worker_time = self.acct.worker_type_time.get(wt, 0.0)
+            worker_time = (self.acct.worker_type_time.get(wt, 0.0)
+                           + inflight_worker.get(wt, 0.0))
             for job_id in self._priorities[wt]:
                 if job_id not in self._allocation:
                     self._priorities[wt][job_id] = 0.0
@@ -437,7 +454,9 @@ class Scheduler:
                     self._priorities[wt][job_id] = 0.0
                     continue
                 if worker_time > 0 and wt in self.acct.job_time.get(job_id, {}):
-                    fraction = self.acct.job_time[job_id][wt] / worker_time
+                    job_time = (self.acct.job_time[job_id][wt]
+                                + inflight_job.get(job_id, {}).get(wt, 0.0))
+                    fraction = job_time / worker_time
                 else:
                     fraction = 0.0
                 if fraction > 0.0:
@@ -1280,6 +1299,14 @@ class Scheduler:
 
     def get_makespan(self) -> float:
         return self._current_timestamp
+
+    def get_last_completion_time(self) -> float:
+        """Scheduler-clock timestamp of the last job completion. The
+        physical driver reports this as makespan — matching the
+        reference's measurement (poll is_done, then stamp elapsed;
+        run_scheduler_with_trace.py:120-155) — so round-drain and
+        shutdown time after the final completion don't inflate it."""
+        return self._last_completion_time
 
     def get_throughput_timeline(self):
         """Per-job {round: (throughput, batch_size)} measurement history."""
