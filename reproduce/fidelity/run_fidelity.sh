@@ -10,7 +10,7 @@
 # Tips: pre-warm the XLA compile cache by running each workload once for
 # a few steps (first-dispatch compiles otherwise eat into round 0), and
 # keep round_duration >= 120 s.
-set -eu
+set -eu -o pipefail
 cd "$(dirname "$0")/../.."
 OUT=${1:-reproduce/fidelity/out}   # untracked by default; pass
                                    # reproduce/fidelity to refresh the
@@ -21,11 +21,12 @@ TRACE=${TRACE:-reproduce/fidelity/fidelity_3job.trace}
 # No TPU attached? The same experiment runs on CPU (this produced the
 # committed reproduce/fidelity/cpu_loopback artifacts):
 #   JAX_PLATFORMS=cpu WORKER_TYPE=cpu ROUND=120 \
-#   TRACE=reproduce/fidelity/fidelity_cpu_3job.trace \
+#   TOL=0.20 TRACE=reproduce/fidelity/fidelity_cpu_3job.trace \
 #   ORACLE=reproduce/fidelity/cpu_throughputs.json \
 #   reproduce/fidelity/run_fidelity.sh reproduce/fidelity/cpu_loopback
 WORKER_TYPE=${WORKER_TYPE:-v5e}
 ORACLE=${ORACLE:-data/v5e_throughputs.json}
+TOL=${TOL:-0.15}
 CKPT=$(mktemp -d /tmp/swtpu_fidelity.XXXX)
 mkdir -p "$OUT"
 
@@ -55,5 +56,5 @@ python scripts/drivers/simulate.py \
     --output "$OUT/simulated_${WORKER_TYPE}.pkl"
 
 python reproduce/analyze_fidelity.py \
-    "$OUT/physical_${WORKER_TYPE}.pkl" "$OUT/simulated_${WORKER_TYPE}.pkl" --tolerance 0.15 \
+    "$OUT/physical_${WORKER_TYPE}.pkl" "$OUT/simulated_${WORKER_TYPE}.pkl" --tolerance "$TOL" \
     | tee "$OUT/fidelity_report.txt"

@@ -280,16 +280,20 @@ class PhysicalScheduler(Scheduler):
         inflight_job: dict = {}
         inflight_worker: dict = {}
         for job_id, worker_ids in self.rounds.current_assignments.items():
-            member = job_id.singletons()[0]
             # Only microtasks whose process is still alive: an exited
             # job stays in current_assignments until the round boundary,
             # but its real time was already charged by its done
             # callback — counting idle tail time would double-charge.
-            if member not in self._running_jobs:
+            # For colocated pairs, any still-running member keeps the
+            # combo in flight (its peer's exit does not free the chip),
+            # and the combo is charged once, from the latest dispatch
+            # stamp among the running members.
+            running = [m for m in job_id.singletons()
+                       if m in self._running_jobs
+                       and self.acct.latest_timestamps.get(m) is not None]
+            if not running or not worker_ids:
                 continue
-            dispatch = self.acct.latest_timestamps.get(member)
-            if dispatch is None or not worker_ids:
-                continue
+            dispatch = max(self.acct.latest_timestamps[m] for m in running)
             elapsed = current_time - max(dispatch, self._last_reset_time)
             if elapsed <= 0:
                 continue

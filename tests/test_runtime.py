@@ -688,3 +688,65 @@ class TestAccordionEndToEnd:
             sched._server.stop(grace=0)
         # The redispatch after the resize must carry the doubled batch.
         assert "--batch_size 256" in out, out[-3000:]
+
+
+class TestInflightTimeAccounting:
+    """Physical-mode priorities must charge currently-running microtasks
+    their elapsed time (reference: scheduler.py:3640-3666) — without it
+    a lease-extended job reads as starved and sticky placement
+    re-extends it until completion (the sequential-JCT failure the CPU
+    loopback fidelity run exposed) — but must NOT phantom-charge
+    microtasks whose process already exited this round."""
+
+    def _sched(self):
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=60.0),
+            expected_num_workers=1, port=free_port())
+        sched.workers.id_to_type[0] = "v100"
+        return sched
+
+    def test_running_member_charged_elapsed(self):
+        sched = self._sched()
+        try:
+            jid = JobIdPair(0, None)
+            now = sched.get_current_timestamp()
+            sched.rounds.current_assignments[jid] = (0,)
+            sched.acct.latest_timestamps[jid] = now - 30.0
+            sched._running_jobs.add(jid)
+            job_t, worker_t = sched._inflight_elapsed_times(now)
+            assert job_t[jid]["v100"] == pytest.approx(30.0, abs=1.0)
+            assert worker_t["v100"] == pytest.approx(30.0, abs=1.0)
+        finally:
+            sched._server.stop(grace=0)
+
+    def test_exited_member_not_charged(self):
+        sched = self._sched()
+        try:
+            jid = JobIdPair(0, None)
+            now = sched.get_current_timestamp()
+            sched.rounds.current_assignments[jid] = (0,)
+            sched.acct.latest_timestamps[jid] = now - 30.0
+            # Done callback already removed it from _running_jobs and
+            # charged its real time; the idle tail must not be added.
+            job_t, worker_t = sched._inflight_elapsed_times(now)
+            assert job_t == {} and worker_t == {}
+        finally:
+            sched._server.stop(grace=0)
+
+    def test_elapsed_clamped_to_last_reset(self):
+        sched = self._sched()
+        try:
+            jid = JobIdPair(0, None)
+            now = sched.get_current_timestamp()
+            sched.rounds.current_assignments[jid] = (0,)
+            sched.acct.latest_timestamps[jid] = now - 500.0
+            sched._running_jobs.add(jid)
+            sched._last_reset_time = now - 20.0
+            job_t, _ = sched._inflight_elapsed_times(now)
+            # Time before the allocation reset was already folded into
+            # the deficits; only post-reset time counts.
+            assert job_t[jid]["v100"] == pytest.approx(20.0, abs=1.0)
+        finally:
+            sched._server.stop(grace=0)

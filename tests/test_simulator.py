@@ -299,3 +299,18 @@ class TestJobMetadataCaches:
         meta2.bs_epoch_duration_map()
         assert meta2.dirichlet_posterior_remaining_runtime() == est
         assert est > 0
+
+
+class TestLastCompletionTime:
+    def test_tracks_final_job_completion(self):
+        jobs = [make_job(total_steps=20000, duration=2000),
+                make_job(total_steps=40000, duration=4000)]
+        sched, _ = run_sim(jobs, [0.0, 0.0])
+        last = sched.get_last_completion_time()
+        assert last > 0
+        # The last completion can't exceed the simulator's final clock,
+        # and every recorded JCT must end at or before it.
+        assert last <= sched.get_makespan()
+        ends = [sched.acct.start_timestamps[j] + d
+                for j, d in sched.acct.completion_times.items()]
+        assert last == pytest.approx(max(ends))
