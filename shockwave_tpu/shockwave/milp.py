@@ -191,28 +191,53 @@ def plan_schedule(jobs, round_index: int, future_nrounds: int,
                 ub[L.w(j, b)] = 1
         return c, A_ub, np.array(b_ub), A_eq, np.array(b_eq), integrality, ub
 
+    # The reference gives Gurobi a flat 15 s on 24 threads
+    # (configurations/*.json); single-threaded HiGHS needs the budget to
+    # grow with the boolean count or large instances (hundreds of jobs)
+    # time out with no incumbent at all. Canonical-scale problems
+    # (<= 120 jobs) keep the reference budget exactly. Budgets stay
+    # bounded by the round duration so a hard instance can never stall
+    # the physical round loop for multiple rounds: per-solve at most
+    # round/2, the one no-incumbent retry at most one full round.
+    timeout_scale = max(1.0, njobs / 120.0)
+    solve_budget = min(opts.timeout * timeout_scale, round_duration / 2.0)
+    retry_budget = min(4.0 * solve_budget, round_duration)
+    scale = solve_budget / opts.timeout
+
     # -- first attempt: with FTF constraints ------------------------------
     ones = [1.0] * njobs
     model = assemble(ones, with_ftf=True)
     res = None
     if model is not None:
-        res = _solve(*model, opts)
+        res = _solve(*model, opts, scale)
     if model is not None and res.x is not None and res.status in (0, 1):
         x = _extract(res.x, L, njobs, future_nrounds)
         return x
 
     # -- fallback: relax FTF, boost violating jobs' utilities -------------
-    logger.info("FTF constraints infeasible at round %d; relaxing", round_index)
+    if res is not None and res.x is None and res.status == 1:
+        logger.info("FTF solve timed out with no incumbent at round %d; "
+                    "relaxing", round_index)
+    else:
+        logger.info("FTF constraints infeasible at round %d; relaxing",
+                    round_index)
     priorities = _relaxation_priorities(
         jobs, dirichlet, runavg, round_index, round_duration, future_share,
         opts.rhomax, opts.lam)
     model = assemble(priorities, with_ftf=False)
-    res = _solve(*model, opts)
+    res = _solve(*model, opts, scale)
+    if res.x is None and res.status == 1:
+        # Timed out before finding any incumbent: one longer attempt is
+        # much better than degrading to the greedy schedule.
+        logger.info("relaxed MILP hit its time limit; retrying at %.0fs",
+                    retry_budget)
+        res = _solve(*model, opts, retry_budget / opts.timeout)
     if res.x is None:
         logger.warning("relaxed MILP failed (%s); greedy fallback", res.status)
         return _greedy_fallback(jobs, future_nrounds, ngpus, dirichlet)
     x = _extract(res.x, L, njobs, future_nrounds)
-    return _rank_in_schedule(x, priorities, nworkers, ngpus, opts)
+    return _rank_in_schedule(x, priorities, nworkers, ngpus, opts,
+                             time_limit=solve_budget)
 
 
 def _extract(xvec, L, njobs, nrounds) -> np.ndarray:
@@ -244,9 +269,12 @@ def _relaxation_priorities(jobs, dirichlet, runavg, round_index,
 
 
 def _rank_in_schedule(x: np.ndarray, priorities, nworkers, ngpus,
-                      opts: MilpOptions) -> np.ndarray:
+                      opts: MilpOptions,
+                      time_limit: Optional[float] = None) -> np.ndarray:
     """Second MILP: keep each job's number of scheduled rounds but permute
-    rounds so high-priority jobs run earlier (reference: shockwave.py:714-793)."""
+    rounds so high-priority jobs run earlier (reference: shockwave.py:714-793).
+    `time_limit` inherits the (scaled, round-bounded) budget of the main
+    solve — this model has the same njobs x nrounds boolean count."""
     njobs, nrounds = x.shape
     counts = x.sum(axis=1)
     if not np.any(counts > 0):
@@ -285,10 +313,12 @@ def _rank_in_schedule(x: np.ndarray, priorities, nworkers, ngpus,
         ],
         integrality=np.ones(n),
         bounds=Bounds(np.zeros(n), np.ones(n)),
-        options={"time_limit": opts.timeout, "mip_rel_gap": opts.rel_gap,
-                 "presolve": True},
+        options={"time_limit": time_limit or opts.timeout,
+                 "mip_rel_gap": opts.rel_gap, "presolve": True},
     )
     if res.x is None:
+        logger.warning("rank-in-schedule MILP failed (%s); "
+                       "keeping unranked schedule", res.status)
         return x
     return np.round(res.x.reshape((njobs, nrounds))).astype(bool)
 
