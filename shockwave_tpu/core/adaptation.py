@@ -16,7 +16,8 @@ scale_factor) and are captured in `_GNS_SEGMENTS`.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from functools import lru_cache
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .constants import MAX_BS
 
@@ -97,11 +98,24 @@ _GNS_SEGMENTS: Dict[Tuple[str, int, int], Tuple[int, List[_Seg]]] = {
 }
 
 
-def gns_bs_schedule(model: str, initial_bs: int, num_epochs: int, scale_factor: int) -> List[int]:
-    """Per-epoch batch sizes under GNS adaptation."""
+def gns_bs_schedule(model: str, initial_bs: int, num_epochs: int,
+                    scale_factor: int) -> Sequence[int]:
+    """Per-epoch batch sizes under GNS adaptation.
+
+    The simulator's GNS oracle rebuilds this schedule every round
+    (sched/scheduler.py:_simulate_gns), so the pure computation is
+    memoized. Returns a read-only tuple; all callers only index or
+    iterate it.
+    """
+    return _gns_bs_schedule(model, initial_bs, num_epochs, scale_factor)
+
+
+@lru_cache(maxsize=4096)
+def _gns_bs_schedule(model: str, initial_bs: int, num_epochs: int,
+                     scale_factor: int) -> tuple:
     schedule = [initial_bs] * num_epochs
     if model in _NON_ADAPTIVE:
-        return schedule
+        return tuple(schedule)
     entry = _GNS_SEGMENTS.get((model, initial_bs, scale_factor))
     if entry is not None:
         min_epochs, segments = entry
@@ -115,7 +129,7 @@ def gns_bs_schedule(model: str, initial_bs: int, num_epochs: int, scale_factor: 
                 for epoch in range(start, stop):
                     schedule[epoch] *= mult
     cap = MAX_BS[model]
-    return [min(bs, cap) for bs in schedule]
+    return tuple(min(bs, cap) for bs in schedule)
 
 
 def bs_schedule_for_mode(mode: str, model: str, initial_bs: int, num_epochs: int,
@@ -123,5 +137,7 @@ def bs_schedule_for_mode(mode: str, model: str, initial_bs: int, num_epochs: int
     if mode == "accordion":
         return accordion_bs_schedule(model, initial_bs, num_epochs)
     if mode == "gns":
-        return gns_bs_schedule(model, initial_bs, num_epochs, scale_factor)
+        # Profiles (and their JSON/pickle round trips) carry lists; only
+        # the simulator's per-round GNS oracle consumes the raw tuple.
+        return list(gns_bs_schedule(model, initial_bs, num_epochs, scale_factor))
     return [initial_bs] * num_epochs

@@ -54,6 +54,29 @@ def common_parser(description: str, steps_args=("--num_steps",)) -> argparse.Arg
     return p
 
 
+def _host_fingerprint() -> str:
+    """Short hash of the host's architecture + CPU feature flags.
+
+    XLA:CPU AOT artifacts embed the compile machine's feature set and
+    fail to load on a host with different features (cpu_aot_loader
+    rejects them, stalling the job until the scheduler's liveness
+    watchdog kills it) — so cached executables are segregated per host.
+    """
+    import hashlib
+    import platform
+
+    bits = [platform.system(), platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    bits.append(line.strip())
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:8]
+
+
 def enable_compile_cache(path: Optional[str] = None) -> None:
     """Point XLA's persistent compilation cache at a per-host directory.
 
@@ -61,12 +84,15 @@ def enable_compile_cache(path: Optional[str] = None) -> None:
     re-dispatch pays the full jit compile inside its lease (the dominant
     startup cost on TPU — the reference's PyTorch workloads have no
     analogue). Executables are keyed by (computation, shapes, mesh), so a
-    re-dispatched job at the same batch size restarts in seconds.
+    re-dispatched job at the same batch size restarts in seconds. The
+    base dir (or $SWTPU_COMPILE_CACHE) gains a host-fingerprint subdir
+    so a cache shared over NFS never serves another machine's AOT code.
     """
     path = path or os.environ.get(
         "SWTPU_COMPILE_CACHE",
         os.path.join(os.path.expanduser("~"), ".cache", "shockwave_tpu",
                      "xla_cache"))
+    path = os.path.join(path, _host_fingerprint())
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)

@@ -14,12 +14,18 @@ import os
 import signal
 import sys
 import threading
+import time
+
+import grpc
 
 from .clients import WorkerToSchedulerClient
 from .dispatcher import Dispatcher
 from .servers import get_host_ip, serve_worker
 
 logger = logging.getLogger("shockwave_tpu.runtime")
+
+REGISTER_RETRY_WINDOW_S = 300.0
+REGISTER_RETRY_INTERVAL_S = 5.0
 
 
 def detect_num_chips() -> int:
@@ -45,9 +51,24 @@ class WorkerDaemon:
         }
         self._server = serve_worker(worker_port, callbacks)
 
-        worker_ids, round_duration = self._rpc_client.register_worker(
-            worker_type=worker_type, ip_addr=get_host_ip(), port=worker_port,
-            num_chips=num_chips)
+        # Daemons race the scheduler at cluster bring-up (and the
+        # scheduler may spend a minute importing before its server
+        # listens), so registration retries with backoff instead of
+        # dying on the first connection refusal.
+        deadline = time.monotonic() + REGISTER_RETRY_WINDOW_S
+        while True:
+            try:
+                worker_ids, round_duration = self._rpc_client.register_worker(
+                    worker_type=worker_type, ip_addr=get_host_ip(),
+                    port=worker_port, num_chips=num_chips)
+                break
+            except grpc.RpcError as e:
+                if (e.code() != grpc.StatusCode.UNAVAILABLE
+                        or time.monotonic() >= deadline):
+                    raise
+                logger.info("scheduler at %s:%d unavailable; retrying",
+                            sched_addr, sched_port)
+                time.sleep(REGISTER_RETRY_INTERVAL_S)
         logger.info("registered %d chips as workers %s (round %.0fs)",
                     num_chips, worker_ids, round_duration)
         self._worker_ids = worker_ids

@@ -58,6 +58,12 @@ class JobMetadata:
         self._calib_fingerprint = None
         self._duration_version = 0
         self._dmap_cache: Optional[tuple] = None
+        # bs_schedule/prior/epochs are fixed after construction, so the
+        # posterior is a pure function of (progress, epoch_progress,
+        # duration calibration version). Memoized: within one planning
+        # pass it runs once per job plus the schedule-construction sort
+        # keys, and across rounds most jobs' keys are unchanged.
+        self._posterior_cache: Dict[tuple, float] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -177,6 +183,13 @@ class JobMetadata:
         if oracle:
             return sum(self.epoch_duration[self.epoch_progress:])
 
+        # Calibration may bump _duration_version; run it before keying.
+        self.calibrate_profiled_epoch_duration()
+        key = (progress, self.epoch_progress, self._duration_version)
+        cached = self._posterior_cache.get(key)
+        if cached is not None:
+            return cached
+
         observed = self.bs_schedule[:progress + 1]
         posterior = dict(self.bs_dirichlet_prior)  # flat {int: float}
         for bs in observed:
@@ -186,16 +199,17 @@ class JobMetadata:
         for bs in observed:
             if rebased[bs] >= 1:
                 rebased[bs] -= 1
-        if not rebased:
-            return 1.0
         inflated = int(sum(rebased.values()) + 1)
         remaining = self.epochs - self.epoch_progress
         inflated = max(inflated, remaining)
-        if inflated <= 0 or remaining <= 0:
-            return 1.0
-        durations = self.bs_epoch_duration_map()
-        runtime = sum(rebased[bs] * durations[bs] for bs in rebased)
-        return runtime * remaining / inflated
+        if not rebased or inflated <= 0 or remaining <= 0:
+            runtime = 1.0
+        else:
+            durations = self.bs_epoch_duration_map()
+            runtime = (sum(rebased[bs] * durations[bs] for bs in rebased)
+                       * remaining / inflated)
+        self._posterior_cache[key] = runtime
+        return runtime
 
     def interpolated_epoch_duration(self) -> float:
         """Mean profiled duration of the epochs seen so far (+1)."""

@@ -108,7 +108,7 @@ class TestAdaptationParity:
             job_type = f"{model} (batch size {bs})"
             expected = reference_utils.get_gns_bs_pattern(job_type, bs, n, sf)
             got = gns_bs_schedule(model, bs, n, sf)
-            assert got == expected, (model, bs, sf, n)
+            assert list(got) == list(expected), (model, bs, sf, n)
 
     def test_accordion_matches_reference(self, reference_utils):
         for model, bs, sf, n in self.CASES:
