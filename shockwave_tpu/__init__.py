@@ -20,4 +20,4 @@ Layer map (mirrors SURVEY.md §1):
   parallel/  mesh + sharding helpers, DP/TP/SP train steps, ring attention
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"

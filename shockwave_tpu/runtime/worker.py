@@ -65,6 +65,10 @@ class WorkerDaemon:
             except grpc.RpcError as e:
                 if (e.code() != grpc.StatusCode.UNAVAILABLE
                         or time.monotonic() >= deadline):
+                    # Don't leave the control server listening on a
+                    # half-constructed daemon (its handlers dereference
+                    # a dispatcher that was never built).
+                    self._server.stop(grace=0)
                     raise
                 logger.info("scheduler at %s:%d unavailable; retrying",
                             sched_addr, sched_port)

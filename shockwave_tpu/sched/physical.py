@@ -28,6 +28,13 @@ logger = logging.getLogger("shockwave_tpu.sched")
 SCHEDULE_RECOMPUTE_FRACTION = 0.5
 JOB_COMPLETION_BUFFER_TIME = 60.0
 EARLY_INIT_THRESHOLD = 3.0
+# Minimum initial lease grant. TPU jobs can spend most of a round in
+# imports + jit compilation before InitJob arrives; granting only the
+# round's sliver of remaining time would expire the lease before a
+# single step, and the job would livelock re-paying startup every round.
+# Must stay below JOB_COMPLETION_BUFFER_TIME so the round-end kill
+# watchdog still leaves room for the expiry checkpoint.
+INIT_LEASE_FLOOR_S = 45.0
 BASE_JOB_PORT = 60570
 MAX_PORT = 65535
 
@@ -154,7 +161,7 @@ class PhysicalScheduler(Scheduler):
                 # Early dispatch for the next round: full round + leftover.
                 return (remaining, self._time_per_iteration, time_left)
             if time_left > 0:
-                return (remaining, time_left, 0.0)
+                return (remaining, max(time_left, INIT_LEASE_FLOOR_S), 0.0)
             # Init in the gap between rounds.
             return (remaining, self._time_per_iteration - EARLY_INIT_THRESHOLD,
                     time_left)

@@ -378,6 +378,54 @@ class TestDispatcherEnv:
         assert env["SWTPU_MODE"] == "static"
 
 
+class TestWorkerRegisterRetry:
+    """Daemons race the scheduler at cluster bring-up; registration must
+    retry through connection refusals instead of dying."""
+
+    def test_retries_until_scheduler_appears(self, monkeypatch, tmp_path):
+        from shockwave_tpu.runtime import worker as worker_mod
+        monkeypatch.setattr(worker_mod, "REGISTER_RETRY_INTERVAL_S", 0.2)
+        sched_port = free_port()
+        box = {}
+
+        def start_sched_late():
+            time.sleep(1.0)
+            box["server"] = serve_scheduler(sched_port, {
+                "RegisterWorker":
+                    lambda worker_type, num_chips, ip_addr, port: ([0], 60.0),
+            })
+
+        t = threading.Thread(target=start_sched_late)
+        t.start()
+        daemon = None
+        try:
+            daemon = worker_mod.WorkerDaemon(
+                worker_type="cpu", sched_addr="127.0.0.1",
+                sched_port=sched_port, worker_port=free_port(), num_chips=1,
+                run_dirs={"static": ".", "accordion": ".", "gns": "."},
+                data_dir=str(tmp_path), checkpoint_dir=str(tmp_path / "ckpt"))
+            assert daemon._worker_ids == [0]
+        finally:
+            t.join()
+            if daemon is not None:
+                daemon._server.stop(grace=0)
+            if "server" in box:
+                box["server"].stop(grace=0)
+
+    def test_gives_up_after_retry_window(self, monkeypatch, tmp_path):
+        import grpc
+
+        from shockwave_tpu.runtime import worker as worker_mod
+        monkeypatch.setattr(worker_mod, "REGISTER_RETRY_INTERVAL_S", 0.1)
+        monkeypatch.setattr(worker_mod, "REGISTER_RETRY_WINDOW_S", 0.4)
+        with pytest.raises(grpc.RpcError):
+            worker_mod.WorkerDaemon(
+                worker_type="cpu", sched_addr="127.0.0.1",
+                sched_port=free_port(), worker_port=free_port(), num_chips=1,
+                run_dirs={"static": ".", "accordion": ".", "gns": "."},
+                data_dir=str(tmp_path), checkpoint_dir=str(tmp_path / "ckpt"))
+
+
 class TestExtendedLeaseLiveness:
     def _make_sched(self):
         port = free_port()
@@ -421,6 +469,50 @@ class TestExtendedLeaseLiveness:
                 sched.get_current_timestamp() - 10_000.0)
             sched._done_callback_extended_lease(job_id)
             assert kills == [job_id]
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+
+class TestInitLeaseFloor:
+    """A job whose startup (imports + jit) eats most of the round must not
+    be granted a sliver lease that expires before one step — that
+    livelocks the job re-paying startup every round."""
+
+    def _make_sched(self):
+        return PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=100.0),
+            expected_num_workers=1, port=free_port())
+
+    def _add_job(self, sched):
+        job = Job(None, "ResNet-18 (batch size 32)",
+                  "python3 main.py --batch_size 32",
+                  "image_classification/cifar10", "--num_steps",
+                  total_steps=100, duration=1000)
+        return sched.add_job(job)
+
+    def test_late_init_gets_floor_not_sliver(self):
+        from shockwave_tpu.sched.physical import INIT_LEASE_FLOOR_S
+        sched = self._make_sched()
+        try:
+            job_id = self._add_job(sched)
+            sched._current_round_start_time = (
+                sched.get_current_timestamp() - 99.5)
+            _, max_duration, _ = sched._init_job_callback(job_id)
+            assert max_duration >= INIT_LEASE_FLOOR_S
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+    def test_fresh_init_gets_remaining_round(self):
+        sched = self._make_sched()
+        try:
+            job_id = self._add_job(sched)
+            sched._current_round_start_time = sched.get_current_timestamp()
+            _, max_duration, _ = sched._init_job_callback(job_id)
+            assert 90.0 <= max_duration <= 100.0
         finally:
             sched._done_event.set()
             sched._server.stop(grace=0)

@@ -415,3 +415,25 @@ class TestRealDataLoaders:
             env=cpu_subprocess_env())
         assert out.returncode == 0, out.stderr[-2000:]
         assert "TRAINED 3 steps" in out.stdout
+
+
+class TestCompileCache:
+    def test_cache_dir_is_host_fingerprinted(self, tmp_path):
+        """XLA:CPU AOT artifacts embed the compile machine's feature set
+        and fail to load elsewhere; the persistent cache must segregate
+        executables per host fingerprint."""
+        from shockwave_tpu.models import train_common as tc
+
+        old = jax.config.jax_compilation_cache_dir
+        try:
+            tc.enable_compile_cache(str(tmp_path / "xc"))
+            got = jax.config.jax_compilation_cache_dir
+            assert os.path.dirname(got) == str(tmp_path / "xc")
+            fp = os.path.basename(got)
+            assert fp == tc._host_fingerprint()
+            assert len(fp) == 8
+            assert os.path.isdir(got)
+            # Fingerprint is stable across calls on the same host.
+            assert tc._host_fingerprint() == fp
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old)
