@@ -301,3 +301,14 @@ class TestJobGeneration:
                                  max_duration_hours=4.0)
         modes = {j.mode for j in jobs}
         assert "accordion" in modes and "gns" in modes
+
+
+class TestPackaging:
+    def test_version_matches_pyproject(self):
+        tomllib = pytest.importorskip("tomllib")  # stdlib from 3.11
+
+        import shockwave_tpu
+        root = os.path.join(os.path.dirname(__file__), "..")
+        with open(os.path.join(root, "pyproject.toml"), "rb") as f:
+            meta = tomllib.load(f)
+        assert meta["project"]["version"] == shockwave_tpu.__version__

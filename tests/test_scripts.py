@@ -12,8 +12,13 @@ THROUGHPUTS = os.path.join(REPO, "data", "tacc_throughputs.json")
 
 
 def run_script(args, timeout=600):
+    # Children stay off the accelerator relay (a wedged tunnel would
+    # hang their jax import); tests that need the ambient backend build
+    # their env explicitly with ambient_accelerator_env().
+    from conftest import cpu_subprocess_env
     out = subprocess.run([sys.executable, *args], capture_output=True,
-                         text=True, timeout=timeout, cwd=REPO)
+                         text=True, timeout=timeout, cwd=REPO,
+                         env=cpu_subprocess_env())
     assert out.returncode == 0, out.stderr[-2000:]
     return out.stdout
 
@@ -104,10 +109,12 @@ class TestReproduceTooling:
         out = run_script(["reproduce/analyze_fidelity.py", str(phys),
                           str(sim), "--tolerance", "0.10"])
         assert "within tolerance" in out
+        from conftest import cpu_subprocess_env
         bad = subprocess.run(
             [sys.executable, "reproduce/analyze_fidelity.py", str(phys),
              str(sim), "--tolerance", "0.01"],
-            capture_output=True, text=True, cwd=REPO)
+            capture_output=True, text=True, cwd=REPO,
+            env=cpu_subprocess_env())
         assert bad.returncode == 1
 
 

@@ -171,11 +171,15 @@ class TestWorkloadEntrypoints:
     @pytest.mark.parametrize("script,args", ENTRIES,
                              ids=[e[0].split("/")[-2] for e in ENTRIES])
     def test_entry_runs(self, script, args, tmp_path):
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # cpu_subprocess_env, not os.environ + JAX_PLATFORMS: the child
+        # must also drop the accelerator relay address, or a wedged
+        # relay tunnel hangs its jax import until the test times out.
+        from conftest import cpu_subprocess_env
         out = subprocess.run(
             [sys.executable, os.path.join(WORKLOADS, script), *args,
              "--checkpoint_dir", str(tmp_path)],
-            capture_output=True, text=True, timeout=900, env=env)
+            capture_output=True, text=True, timeout=900,
+            env=cpu_subprocess_env())
         assert out.returncode == 0, out.stderr[-2000:]
         assert "TRAINED" in out.stdout
 
