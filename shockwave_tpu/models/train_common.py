@@ -219,7 +219,7 @@ class Trainer:
                                      args.process_id)
         self.args = args
         self.mode = mode or os.environ.get("SWTPU_MODE", "static")
-        self.mesh = make_mesh()
+        self.mesh = make_mesh(batch_size=initial_bs)
         self.batch_sharding, self.repl_sharding = data_parallel_sharding(self.mesh)
 
         self.tx = optax.sgd(learning_rate, momentum=0.9)

@@ -22,18 +22,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def make_mesh(dp: Optional[int] = None, tp: int = 1, sp: int = 1,
               pp: int = 1, ep: int = 1,
-              devices: Optional[Sequence] = None) -> Mesh:
+              devices: Optional[Sequence] = None,
+              batch_size: Optional[int] = None) -> Mesh:
     """Build a (dp, pp, tp, sp, ep) mesh; dp defaults to the remaining
     devices. Size-1 axes cost nothing and keep PartitionSpecs valid
-    everywhere, so every mesh carries all five names."""
+    everywhere, so every mesh carries all five names.
+
+    With `batch_size`, dp is capped at the largest divisor of the global
+    batch (a dp-sharded batch's leading dim must divide evenly); any
+    leftover devices stay out of the mesh. Small-batch jobs on a
+    many-device host (e.g. CycleGAN at batch 1) would otherwise fail
+    at the first device_put.
+    """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     rest = pp * tp * sp * ep
     if dp is None:
         assert n % rest == 0, (n, pp, tp, sp, ep)
         dp = n // rest
-    assert dp * rest == n, f"mesh {dp}x{pp}x{tp}x{sp}x{ep} != {n} devices"
-    arr = np.array(devices).reshape((dp, pp, tp, sp, ep))
+        # Cap only in single-process mode: dropping devices from a
+        # multi-host gang's mesh could leave a host with no addressable
+        # devices, wedging the gang instead of failing loudly.
+        if batch_size is not None and jax.process_count() == 1:
+            while dp > 1 and batch_size % dp:
+                dp -= 1
+    else:
+        # An explicit shape must cover the devices exactly — a silently
+        # undersized mesh would skew profiling/throughput numbers.
+        assert dp * rest == n, f"mesh {dp}x{pp}x{tp}x{sp}x{ep} != {n} devices"
+    arr = np.array(devices[:dp * rest]).reshape((dp, pp, tp, sp, ep))
     return Mesh(arr, axis_names=("dp", "pp", "tp", "sp", "ep"))
 
 

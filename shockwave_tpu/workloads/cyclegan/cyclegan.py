@@ -94,7 +94,7 @@ def main():
 
     maybe_initialize_distributed(args.coordinator, args.num_processes,
                                  args.process_id)
-    mesh = make_mesh()
+    mesh = make_mesh(batch_size=args.batch_size)
     batch_sharding, repl_sharding = data_parallel_sharding(mesh)
 
     g_ab, g_ba = Generator(), Generator()

@@ -30,6 +30,15 @@ class TestMesh:
         with pytest.raises(AssertionError):
             make_mesh(dp=3, tp=3, sp=1)
 
+    def test_batch_size_caps_dp(self, devices):
+        """Small-batch jobs must get a dp that divides the batch (largest
+        such divisor), leaving leftover devices out of the mesh."""
+        assert dict(make_mesh(batch_size=1).shape)["dp"] == 1
+        assert dict(make_mesh(batch_size=20).shape)["dp"] == 5
+        assert dict(make_mesh(batch_size=32).shape)["dp"] == len(devices)
+        # Explicit dp wins; batch_size only applies to the default.
+        assert dict(make_mesh(dp=4, tp=2, batch_size=1).shape)["dp"] == 4
+
     def test_shard_and_replicate(self, devices):
         mesh = make_mesh()
         batch = jnp.arange(16.0).reshape(16, 1)
