@@ -115,7 +115,10 @@ def main():
     # as soon as is_done polls true) does not contain. The physical
     # clock is wall time, so rebase against the driver's start.
     last_done = sched.get_last_completion_time()
-    makespan = (last_done - start_time) if last_done else (
+    # A max_rounds/timeout exit can leave jobs unfinished; last-completion
+    # time would then understate makespan vs a run that drained the trace.
+    all_done = sched.get_num_completed_jobs() >= len(jobs)
+    makespan = (last_done - start_time) if (last_done and all_done) else (
         time.time() - start_time)
 
     jct = sched.get_average_jct()
@@ -127,6 +130,7 @@ def main():
         "trace_file": args.trace,
         "policy": args.policy,
         "makespan": makespan,
+        "all_jobs_completed": all_done,
         "avg_jct": jct[0] if jct else None,
         "geometric_mean_jct": jct[1] if jct else None,
         "harmonic_mean_jct": jct[2] if jct else None,

@@ -1,0 +1,138 @@
+#!/usr/bin/env python3
+"""Per-dispatch startup profiler: calibrate the simulator's cold-dispatch
+overhead against reality.
+
+Every physical (re)dispatch of a job pays a fixed cost the throughput
+oracle cannot see: interpreter + jax import, input-pipeline setup,
+checkpoint restore, first-step jit (against the persistent XLA compile
+cache), and the exit-path checkpoint save. This script measures that
+cost the way the dispatcher actually incurs it — by spawning the real
+workload entrypoints (core/job_table.py templates, the same commands a
+trace row carries) for a 1-step run and timing spawn -> exit — and
+writes the per-worker-type mean into the oracle file's
+``__meta__.dispatch_overhead_s`` (core/oracle.py), which activates the
+simulator's calibrated cold-dispatch model (sched/scheduler.py).
+
+For each family the first (cold-compile-cache) run is a discarded
+warmup — re-dispatches in a physical run hit the warm persistent cache,
+which is the regime the simulator charges — then ``--repeats`` runs are
+measured, each restoring the checkpoint the previous run saved, so the
+measurement includes restore + save exactly like a mid-trace redispatch.
+
+Counterpart of the reference's fidelity-calibration step: its simulator
+bakes a flat 20 s checkpoint/restore charge measured on its GPU cluster
+(reference: scheduler/scheduler.py:1936-1968); here the charge is
+measured per worker type on the actual deployment host.
+
+Example (CPU loopback calibration):
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \\
+      python scripts/profiling/measure_startup.py --worker_type cpu \\
+      --oracle reproduce/fidelity/cpu_throughputs.json \\
+      --families "ResNet-18 (batch size 32)" "LM (batch size 20)"
+"""
+import argparse
+import datetime
+import json
+import os
+import platform
+import shutil
+import shlex
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, REPO)
+
+from shockwave_tpu.core.job_table import JOB_TABLE, a3c, cyclegan  # noqa: E402
+
+WORKLOADS = os.path.join(REPO, "shockwave_tpu", "workloads")
+
+
+def run_once(template, data_dir, ckpt_dir, timeout):
+    """Spawn the workload exactly like the dispatcher does, for 1 step;
+    return wall seconds from spawn to exit."""
+    command = template.command
+    if template.needs_data_dir and "%s" in command:
+        command = command % (data_dir,)
+    command = (f"{command} --local_rank 0 {template.num_steps_arg} 1 "
+               f"--checkpoint_dir {ckpt_dir}")
+    cwd = os.path.join(WORKLOADS, template.working_directory)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        shlex.split(command), cwd=cwd, timeout=timeout,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    elapsed = time.monotonic() - t0
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{template.model}: exit {proc.returncode}:\n"
+            f"{proc.stdout.decode(errors='replace')[-2000:]}")
+    return elapsed
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--worker_type", required=True)
+    p.add_argument("--oracle", required=True,
+                   help="throughput-oracle JSON to write __meta__ into")
+    p.add_argument("--families", nargs="+",
+                   default=["ResNet-18 (batch size 32)", "LM (batch size 20)",
+                            "Recommendation (batch size 512)"],
+                   help="job_type strings (job_table models) to profile")
+    p.add_argument("--repeats", type=int, default=2,
+                   help="measured runs per family after the cache warmup")
+    p.add_argument("--data_dir", default="/tmp/swtpu_data",
+                   help="dataset root; absent datasets fall back synthetic")
+    p.add_argument("--timeout", type=float, default=900.0)
+    args = p.parse_args()
+
+    by_model = {t.model: t for t in JOB_TABLE + [a3c(), cyclegan()]}
+    per_family = {}
+    for family in args.families:
+        if family not in by_model:
+            raise SystemExit(f"unknown job type {family!r}; "
+                             f"known: {sorted(by_model)}")
+        template = by_model[family]
+        ckpt_dir = tempfile.mkdtemp(prefix="swtpu_startup_")
+        try:
+            warmup = run_once(template, args.data_dir, ckpt_dir, args.timeout)
+            samples = [run_once(template, args.data_dir, ckpt_dir,
+                                args.timeout)
+                       for _ in range(args.repeats)]
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+        per_family[family] = {
+            "cold_compile_s": round(warmup, 2),
+            "samples_s": [round(s, 2) for s in samples],
+            "mean_s": round(statistics.mean(samples), 2),
+        }
+        print(f"{family}: warmup {warmup:.1f}s, "
+              f"measured {per_family[family]['samples_s']}")
+
+    overhead = round(statistics.mean(
+        f["mean_s"] for f in per_family.values()), 2)
+
+    with open(args.oracle) as f:
+        oracle = json.load(f)
+    meta = oracle.setdefault("__meta__", {})
+    meta.setdefault("dispatch_overhead_s", {})[args.worker_type] = overhead
+    meta.setdefault("dispatch_overhead_detail", {})[args.worker_type] = {
+        "measured_at": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "host": platform.node(),
+        "python": platform.python_version(),
+        "method": "spawn->exit of 1-step runs, warm XLA cache, "
+                  "ckpt restore+save included; mean over families",
+        "per_family": per_family,
+    }
+    with open(args.oracle, "w") as f:
+        json.dump(oracle, f, indent=1)
+        f.write("\n")
+    print(f"dispatch_overhead_s[{args.worker_type}] = {overhead} "
+          f"-> {args.oracle}")
+
+
+if __name__ == "__main__":
+    main()

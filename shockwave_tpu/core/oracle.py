@@ -8,6 +8,21 @@ Format (reference: scheduler/utils.py:575-594 and *_throughputs.json):
 
 Keys are stringified (job_type, scale_factor) tuples; "null" holds the
 isolated throughput in steps/sec.
+
+A top-level "__meta__" entry (not in the reference format) carries
+measurement metadata alongside the numbers it calibrates, e.g.
+
+    {"__meta__": {"dispatch_overhead_s": {"cpu": 22.4},
+                  "measured_at": "...", ...}, "cpu": {...}}
+
+`dispatch_overhead_s` is the measured per-dispatch dead time per
+worker type: the full spawn -> exit wall time of a 1-step run
+(interpreter + jax import, data load, checkpoint restore, first-step
+compile, and the exit-path checkpoint save) as measured by
+scripts/profiling/measure_startup.py; the simulator's calibrated
+overhead model consumes it (sched/scheduler.py). `read_throughputs`
+skips the entry so every existing consumer sees the plain oracle
+mapping.
 """
 from __future__ import annotations
 
@@ -27,12 +42,17 @@ def parse_job_type_tuple(s: str) -> Optional[JobTypeKey]:
     return (m.group(1), int(m.group(2)))
 
 
-def read_throughputs(path: str) -> Dict[str, Dict[JobTypeKey, dict]]:
-    """Load an oracle file, parsing stringified keys into tuples."""
+def read_oracle(path: str) -> Tuple[Dict[str, Dict[JobTypeKey, dict]], dict]:
+    """Load an oracle file once: (throughputs, __meta__ or {})."""
     with open(path) as f:
         raw = json.load(f)
+    meta = raw.get("__meta__", {})
+    if not isinstance(meta, dict):
+        raise ValueError(f"__meta__ in {path} must be an object")
     out: Dict[str, Dict[JobTypeKey, dict]] = {}
     for worker_type, per_type in raw.items():
+        if worker_type == "__meta__":
+            continue
         parsed = {}
         for job_type_str, entry in per_type.items():
             key = parse_job_type_tuple(job_type_str)
@@ -43,7 +63,17 @@ def read_throughputs(path: str) -> Dict[str, Dict[JobTypeKey, dict]]:
                 parsed_entry["null" if other == "null" else parse_job_type_tuple(other)] = tput
             parsed[key] = parsed_entry
         out[worker_type] = parsed
-    return out
+    return out, meta
+
+
+def read_throughputs(path: str) -> Dict[str, Dict[JobTypeKey, dict]]:
+    """Load an oracle file, parsing stringified keys into tuples."""
+    return read_oracle(path)[0]
+
+
+def read_oracle_meta(path: str) -> dict:
+    """The oracle file's "__meta__" entry ({} when absent)."""
+    return read_oracle(path)[1]
 
 
 def write_throughputs(path: str, throughputs: Dict[str, Dict[JobTypeKey, dict]]) -> None:

@@ -323,7 +323,7 @@ def _load_multi30k(data_dir: str, src_len: int, tgt_len: int,
     words = [w for ln in src_lines for w in ln]
     words += [w for ln in tgt_lines for w in ln]
     uniq, counts = np.unique(np.asarray(words), return_counts=True)
-    keep = uniq[np.argsort(-counts)][: vocab_cap - 4]
+    keep = uniq[np.argsort(-counts, kind="stable")][: vocab_cap - 4]
     ids = {w: i + 4 for i, w in enumerate(keep)}
 
     def encode(lines, length, wrap):
@@ -374,7 +374,7 @@ def _load_wikitext2(data_dir: str, seq_len: int,
     with open(path, encoding="utf-8") as f:
         words = f.read().split()
     uniq, counts = np.unique(np.asarray(words), return_counts=True)
-    keep = uniq[np.argsort(-counts)][: vocab_cap - 1]
+    keep = uniq[np.argsort(-counts, kind="stable")][: vocab_cap - 1]
     ids = {w: i + 1 for i, w in enumerate(keep)}  # 0 = <unk>
     stream = np.fromiter((ids.get(w, 0) for w in words), np.int32,
                          count=len(words))
@@ -493,7 +493,7 @@ def _load_ml20m(data_dir: str, num_items: int) -> Optional[list]:
     uniq, inverse, counts = np.unique(sids, return_inverse=True,
                                       return_counts=True)
     rank = np.empty(len(uniq), np.int64)
-    rank[np.argsort(-counts)] = np.arange(len(uniq))
+    rank[np.argsort(-counts, kind="stable")] = np.arange(len(uniq))
     new_sid = rank[inverse]
     keep = new_sid < num_items
     uids, new_sid = uids[keep], new_sid[keep]

@@ -13,11 +13,14 @@ Axis conventions used across the workloads:
 """
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
 
 
 def make_mesh(dp: Optional[int] = None, tp: int = 1, sp: int = 1,
@@ -46,6 +49,11 @@ def make_mesh(dp: Optional[int] = None, tp: int = 1, sp: int = 1,
         if batch_size is not None and jax.process_count() == 1:
             while dp > 1 and batch_size % dp:
                 dp -= 1
+            if dp * rest < n:
+                logger.warning(
+                    "mesh: batch_size=%d caps dp at %d; %d of %d devices "
+                    "left out of the mesh and will idle", batch_size, dp,
+                    n - dp * rest, n)
     else:
         # An explicit shape must cover the devices exactly — a silently
         # undersized mesh would skew profiling/throughput numbers.

@@ -161,7 +161,11 @@ class PhysicalScheduler(Scheduler):
                 # Early dispatch for the next round: full round + leftover.
                 return (remaining, self._time_per_iteration, time_left)
             if time_left > 0:
-                return (remaining, max(time_left, INIT_LEASE_FLOOR_S), 0.0)
+                # Floor clamped to the round duration: with short rounds
+                # (< INIT_LEASE_FLOOR_S) an unclamped floor would overrun
+                # every round and delay the next dispatch on this chip.
+                floor = min(INIT_LEASE_FLOOR_S, self._time_per_iteration)
+                return (remaining, max(time_left, floor), 0.0)
             # Init in the gap between rounds.
             return (remaining, self._time_per_iteration - EARLY_INIT_THRESHOLD,
                     time_left)

@@ -27,7 +27,7 @@ import numpy as np
 
 from ..core import constants
 from ..core.job import Job, JobIdPair
-from ..core.oracle import read_throughputs
+from ..core.oracle import read_oracle
 from .state import JobAccounting, RoundState, WorkerState
 
 logger = logging.getLogger("shockwave_tpu.sched")
@@ -72,6 +72,15 @@ class SchedulerConfig:
     shockwave: Optional[dict] = None
     # Per-worker-type $/hour, for cost-normalized policies.
     per_worker_type_prices: Optional[Dict[str, float]] = None
+    # Measured per-dispatch process startup (spawn -> first completed
+    # step) per worker type, in seconds. When set — explicitly or via
+    # the oracle file's __meta__.dispatch_overhead_s — the simulator
+    # charges it on every COLD dispatch (first dispatch and redispatch
+    # after preemption) instead of the reference-parity flat
+    # PREEMPTION_OVERHEAD_S drain-time charge, closing the
+    # physical-vs-sim fidelity gap on platforms where startup dominates
+    # (reproduce/fidelity/). None preserves reference behavior exactly.
+    dispatch_overhead_s: Optional[Dict[str, float]] = None
     # Physical-mode deadlock watchdog: dump all thread tracebacks every
     # N seconds (reference: faulthandler at scheduler.py:451-455).
     watchdog_interval: Optional[float] = None
@@ -108,8 +117,15 @@ class Scheduler:
 
         # Throughputs: measured/estimated per job, plus the offline oracle.
         self._throughputs: Dict[JobIdPair, Dict[str, float]] = {}
-        self._oracle_throughputs = (
-            read_throughputs(throughputs_file) if throughputs_file else None)
+        self._oracle_throughputs, oracle_meta = (
+            read_oracle(throughputs_file) if throughputs_file
+            else (None, {}))
+        # Calibrated cold-dispatch overhead: explicit config wins, else
+        # the oracle file's measured metadata, else the reference-parity
+        # flat post-preemption charge (PREEMPTION_OVERHEAD_S).
+        self._dispatch_overhead = self._config.dispatch_overhead_s
+        if self._dispatch_overhead is None:
+            self._dispatch_overhead = oracle_meta.get("dispatch_overhead_s")
         self._throughput_timeline: Dict[int, "collections.OrderedDict"] = {}
 
         # Cost / SLO / timeline observability.
@@ -1084,7 +1100,12 @@ class Scheduler:
                 # (not the previous round's end) keeps idle cluster gaps and a
                 # nonzero first arrival from inflating the measurement.
                 execution_time = finish_time - dispatch_time
-                if current_round >= 2:
+                # Reference-parity flat post-preemption charge — skipped
+                # when the calibrated cold-dispatch model already charged
+                # measured startup at dispatch time.
+                calibrated = (self._dispatch_overhead or {}).get(
+                    self.workers.id_to_type[worker_ids[0]]) is not None
+                if current_round >= 2 and not calibrated:
                     prev_sched = self.rounds.per_round_schedule[current_round - 2]
                     for m in job_id.singletons():
                         if m.integer_job_id() not in prev_sched:
@@ -1148,17 +1169,25 @@ class Scheduler:
             for job_id in self.rounds.current_assignments:
                 if any(m in self.acct.jobs for m in job_id.singletons()):
                     self.rounds.num_lease_opportunities += 1
+            warm_jobs = set()
             for job_id in assignments:
                 if job_id in self.rounds.current_assignments:
                     if set(self.rounds.current_assignments[job_id]) == set(
                             assignments[job_id]):
                         self.rounds.num_lease_extensions += 1
+                        # Same workers as last round: the physical lease
+                        # would extend, so no new process is spawned.
+                        warm_jobs.add(job_id)
             self.rounds.current_assignments = assignments
 
             for job_id, worker_ids in assignments.items():
                 worker_type = self.workers.id_to_type[worker_ids[0]]
+                overhead = 0.0
+                if job_id not in warm_jobs:
+                    overhead = (self._dispatch_overhead or {}).get(
+                        worker_type, 0.0)
                 all_num_steps, finish_time = self._steps_and_finish_time(
-                    job_id, worker_type)
+                    job_id, worker_type, overhead)
                 heapq.heappush(
                     running, (-finish_time, job_id, worker_ids, all_num_steps,
                               self._current_timestamp))
@@ -1173,19 +1202,27 @@ class Scheduler:
                     self._current_timestamp, self._current_timestamp / 3600)
         return self._current_timestamp
 
-    def _steps_and_finish_time(self, job_id: JobIdPair, worker_type: str):
-        """Oracle-throughput step count and finish time for the next round."""
+    def _steps_and_finish_time(self, job_id: JobIdPair, worker_type: str,
+                               overhead: float = 0.0):
+        """Oracle-throughput step count and finish time for the next round.
+
+        With `overhead` > 0 (calibrated cold-dispatch model), the first
+        `overhead` seconds of the round are process startup: the step
+        budget shrinks and a final partial round's completion is pushed
+        back by the startup time — matching what the physical dispatcher
+        actually measures (spawn -> first step)."""
         now = self.get_current_timestamp()
+        budget = max(self._time_per_iteration - overhead, 1.0)
         max_finish = now
         all_num_steps = []
         for m in job_id.singletons():
             tput = self._oracle_step_throughput(job_id, worker_type, m)
-            num_steps = min(int(tput * self._time_per_iteration),
-                            self._get_remaining_steps(m))
-            all_num_steps.append(num_steps)
             if tput <= 0:
                 raise RuntimeError(f"zero throughput for {m} on {worker_type}")
-            max_finish = max(max_finish, now + num_steps / tput)
+            num_steps = min(max(int(tput * budget), 1),
+                            self._get_remaining_steps(m))
+            all_num_steps.append(num_steps)
+            max_finish = max(max_finish, now + overhead + num_steps / tput)
             self._running_jobs.add(m)
         return all_num_steps, max_finish
 
@@ -1307,6 +1344,9 @@ class Scheduler:
         run_scheduler_with_trace.py:120-155) — so round-drain and
         shutdown time after the final completion don't inflate it."""
         return self._last_completion_time
+
+    def get_num_completed_jobs(self) -> int:
+        return len(self._completed_jobs)
 
     def get_throughput_timeline(self):
         """Per-job {round: (throughput, batch_size)} measurement history."""

@@ -160,7 +160,9 @@ class JobMetadata:
         self.calibrate_profiled_epoch_duration()
         if (self._dmap_cache is not None
                 and self._dmap_cache[0] == self._duration_version):
-            return self._dmap_cache[1]
+            # Fresh copy: a caller mutating the result must not corrupt
+            # the cached durations for every later planner query.
+            return dict(self._dmap_cache[1])
         buckets: Dict[int, List[float]] = {}
         for bs, duration in zip(self.bs_schedule, self.epoch_duration):
             buckets.setdefault(bs, []).append(duration)
@@ -173,7 +175,7 @@ class JobMetadata:
             assert 0 < mean < INFINITY
             out[bs] = mean
         self._dmap_cache = (self._duration_version, out)
-        return out
+        return dict(out)
 
     def dirichlet_posterior_remaining_runtime(self, progress: Optional[int] = None,
                                               oracle: bool = False) -> float:

@@ -479,11 +479,11 @@ class TestInitLeaseFloor:
     be granted a sliver lease that expires before one step — that
     livelocks the job re-paying startup every round."""
 
-    def _make_sched(self):
+    def _make_sched(self, round_duration=100.0):
         return PhysicalScheduler(
             get_policy("max_min_fairness"),
             throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
-            config=SchedulerConfig(time_per_iteration=100.0),
+            config=SchedulerConfig(time_per_iteration=round_duration),
             expected_num_workers=1, port=free_port())
 
     def _add_job(self, sched):
@@ -502,6 +502,21 @@ class TestInitLeaseFloor:
                 sched.get_current_timestamp() - 99.5)
             _, max_duration, _ = sched._init_job_callback(job_id)
             assert max_duration >= INIT_LEASE_FLOOR_S
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+    def test_floor_clamped_to_short_rounds(self):
+        # With rounds shorter than the 45 s floor, an unclamped floor
+        # would make every late init overrun its round and delay the
+        # next round's dispatch on that chip.
+        sched = self._make_sched(round_duration=30.0)
+        try:
+            job_id = self._add_job(sched)
+            sched._current_round_start_time = (
+                sched.get_current_timestamp() - 29.5)
+            _, max_duration, _ = sched._init_job_callback(job_id)
+            assert max_duration <= 30.0
         finally:
             sched._done_event.set()
             sched._server.stop(grace=0)

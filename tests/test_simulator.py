@@ -65,6 +65,66 @@ class TestZeroOracleFamilies:
                               total_steps=10, duration=10)], [0.0])
 
 
+class TestCalibratedDispatchOverhead:
+    """Calibrated cold-dispatch model (reproduce/fidelity/): a measured
+    per-worker-type startup charge on every cold dispatch replaces the
+    reference-parity flat post-preemption charge."""
+
+    RATE = 42.97497938  # ResNet-18 bs32 on v100 in the reference oracle
+
+    def _run(self, total_steps, overhead, num_workers=1, n_jobs=1):
+        jobs = [make_job(total_steps=total_steps) for _ in range(n_jobs)]
+        return run_sim(jobs, [0.0] * n_jobs, num_workers=num_workers,
+                       dispatch_overhead_s={"v100": overhead})
+
+    def test_single_job_charged_once_then_warm(self):
+        # One job on one worker lease-extends every round: only the
+        # first dispatch is cold, so exactly one startup charge lands.
+        steps = int(self.RATE * 300)
+        _, base = self._run(steps, 0.0)
+        _, slow = self._run(steps, 25.0)
+        assert slow == pytest.approx(base + 25.0, abs=2.0)
+
+    def test_preempted_jobs_charged_every_cold_dispatch(self):
+        # Two jobs sharing one worker alternate rounds: every dispatch
+        # is cold, so the makespan grows by ~one charge per round.
+        steps = int(self.RATE * 115)  # just under one 120 s round each
+        sched, slow = self._run(steps, 25.0, n_jobs=2)
+        _, base = self._run(steps, 0.0, n_jobs=2)
+        rounds = sched.rounds.num_completed_rounds
+        assert rounds >= 3
+        assert slow > base + 25.0 * (rounds - 1) * 0.8
+
+    def test_oracle_meta_activates_model(self, tmp_path):
+        with open(os.path.join(DATA, "tacc_throughputs.json")) as f:
+            oracle = json.load(f)
+        oracle["__meta__"] = {"dispatch_overhead_s": {"v100": 30.0}}
+        path = tmp_path / "oracle_meta.json"
+        path.write_text(json.dumps(oracle))
+        steps = int(self.RATE * 300)
+        policy = get_policy("max_min_fairness", seed=0)
+        sched = Scheduler(
+            policy, simulate=True, throughputs_file=str(path),
+            config=SchedulerConfig(time_per_iteration=120.0))
+        makespan = sched.simulate(
+            {"v100": 1}, [0.0], [make_job(total_steps=steps)])
+        _, base = run_sim([make_job(total_steps=steps)], [0.0],
+                          num_workers=1)
+        assert makespan == pytest.approx(base + 30.0, abs=2.0)
+
+    def test_meta_key_invisible_to_throughput_readers(self, tmp_path):
+        from shockwave_tpu.core.oracle import (read_oracle_meta,
+                                               read_throughputs)
+        path = tmp_path / "o.json"
+        path.write_text(json.dumps({
+            "__meta__": {"dispatch_overhead_s": {"cpu": 9.5}},
+            "cpu": {"('A3C', 1)": {"null": 2.0}}}))
+        tputs = read_throughputs(str(path))
+        assert set(tputs) == {"cpu"}
+        assert read_oracle_meta(str(path)) == {
+            "dispatch_overhead_s": {"cpu": 9.5}}
+
+
 class TestContention:
     def test_two_jobs_one_worker_share(self):
         jobs = [make_job(total_steps=20000), make_job(total_steps=20000)]
@@ -270,15 +330,19 @@ class TestJobMetadataCaches:
         meta, timeline = self._meta()
         m1 = meta.bs_epoch_duration_map()
         assert m1 == {32: 100.0, 64: 100.0}
-        assert meta.bs_epoch_duration_map() is m1  # cache hit
+        # Cache hits hand out fresh copies: a caller mutating the result
+        # must not corrupt the planner's cached durations.
+        m1b = meta.bs_epoch_duration_map()
+        assert m1b == m1
+        m1b[32] = -1.0
+        assert meta.bs_epoch_duration_map() == m1
         # Measured sample rate ~4x the profile (>40% deviation): the
         # calibration rescales epoch durations and must drop the cache.
         timeline[1] = (40.0, 32)  # 40 steps/s * bs32 * 10 s = 12800 samples
         m2 = meta.bs_epoch_duration_map()
-        assert m2 is not m1
         assert m2[32] < m1[32]
-        # Unchanged timeline -> cached again.
-        assert meta.bs_epoch_duration_map() is m2
+        # Unchanged timeline -> cached again (same values).
+        assert meta.bs_epoch_duration_map() == m2
 
     def test_same_round_overwrite_invalidates(self):
         meta, timeline = self._meta()
