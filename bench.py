@@ -25,35 +25,73 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_MAKESPAN_S = 24197.42350629904  # reference shockwave pickle
 
 
+def committed_tpu_result():
+    """Newest committed raw TPU measurement (reproduce/tpu/bench_*.json,
+    written by bench_tpu.py), provenance-marked with its capture time —
+    so hardware numbers stay reportable when the chip is unreachable,
+    the way the reference's committed oracle JSONs carry its measured
+    GPU numbers."""
+    import glob
+    best = None
+    for path in glob.glob(os.path.join(REPO, "reproduce/tpu/bench_*.json")):
+        try:
+            with open(path) as f:
+                saved = json.load(f)
+        except Exception:  # noqa: BLE001 - a bad artifact must not sink bench
+            continue
+        # Newest by capture time, not filename (filenames lead with the
+        # device kind, which would sort v5 artifacts after newer v4 ones).
+        stamp = saved.get("measured_at", "")
+        if best is None or stamp > best[0]:
+            best = (stamp, path, saved)
+    if best is None:
+        return {}
+    _, path, saved = best
+    saved["tpu_as_of"] = saved.pop("measured_at", "unknown")
+    saved["tpu_source"] = os.path.relpath(path, REPO)
+    return saved
+
+
 def tpu_phase():
-    """Run the single-chip TPU bench in a subprocess; {} when unavailable."""
-    # Cheap liveness probe first: with a dead/wedged accelerator tunnel
-    # even backend init blocks forever, and the full 600 s bench timeout
-    # would be wasted on a chip that can't answer.
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True, text=True, timeout=120, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        return {"tpu_error": "backend liveness probe timed out "
-                             "(wedged accelerator tunnel?)"}
-    if probe.returncode != 0:
-        return {"tpu_error": "backend init failed: " + probe.stderr[-300:]}
+    """Run the single-chip TPU bench in a subprocess; on failure fall
+    back to the newest committed measurement (provenance-marked)."""
+    # Cheap liveness probe first, with one backoff retry: a wedged
+    # accelerator tunnel blocks backend init forever, and transient
+    # relay hiccups often clear within a minute.
+    err = None
+    for attempt in range(2):
+        if attempt:
+            time.sleep(45)
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True, text=True, timeout=120, cwd=REPO)
+        except subprocess.TimeoutExpired:
+            err = ("backend liveness probe timed out "
+                   "(wedged accelerator tunnel?)")
+            continue
+        if probe.returncode != 0:
+            err = "backend init failed: " + probe.stderr[-300:]
+            continue
+        err = None
+        break
+    if err is not None:
+        return {"tpu_error": err, **committed_tpu_result()}
     try:
         out = subprocess.run(
             [sys.executable,
              os.path.join(REPO, "scripts/profiling/bench_tpu.py")],
-            capture_output=True, text=True, timeout=600, cwd=REPO)
+            capture_output=True, text=True, timeout=1200, cwd=REPO)
     except subprocess.TimeoutExpired:
-        return {"tpu_error": "bench_tpu timeout"}
+        return {"tpu_error": "bench_tpu timeout", **committed_tpu_result()}
     if out.returncode == 75:
         return {}  # no TPU backend — sim-only result
     if out.returncode != 0:
-        return {"tpu_error": out.stderr[-300:]}
+        return {"tpu_error": out.stderr[-300:], **committed_tpu_result()}
     try:
         return json.loads(out.stdout.strip().splitlines()[-1])
     except Exception:  # noqa: BLE001
-        return {"tpu_error": out.stdout[-300:]}
+        return {"tpu_error": out.stdout[-300:], **committed_tpu_result()}
 
 
 def main():

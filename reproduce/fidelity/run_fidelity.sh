@@ -27,6 +27,7 @@ TRACE=${TRACE:-reproduce/fidelity/fidelity_3job.trace}
 WORKER_TYPE=${WORKER_TYPE:-v5e}
 ORACLE=${ORACLE:-data/v5e_throughputs.json}
 TOL=${TOL:-0.15}
+TIMEOUT=${TIMEOUT:-3600}
 CKPT=$(mktemp -d /tmp/swtpu_fidelity.XXXX)
 mkdir -p "$OUT"
 
@@ -34,7 +35,7 @@ python scripts/drivers/run_physical.py \
     --trace "$TRACE" --policy max_min_fairness \
     --throughputs "$ORACLE" \
     --expected_num_workers 1 --round_duration "$ROUND" --port "$PORT" \
-    --timeout 3600 --timeline_dir "$OUT/timelines" \
+    --timeout "$TIMEOUT" --timeline_dir "$OUT/timelines" \
     --output "$OUT/physical_${WORKER_TYPE}.pkl" --verbose &
 SCHED_PID=$!
 # The worker must die with the script, even if the scheduler fails.

@@ -1,0 +1,52 @@
+#!/bin/bash
+# Multi-seed spread for the headline claims (EXPERIMENTS.md): the
+# canonical tuned-config shockwave replay and the continuous-arrival
+# load sweep, re-run at 5 / 3 seeds. Seed 0 stays the pinned
+# bit-deterministic result; this records the spread around it.
+#
+# The seed feeds the scheduler RNG (worker shuffling, round-scheduler
+# tie-breaks) and — for the sweep — the generated Poisson trace, so the
+# sweep's spread covers workload draw as well as scheduler stochasticity.
+#
+# Writes one JSON line per run to $OUT/canonical_seeds.jsonl and the
+# sweep tool's aggregate to $OUT/load_sweep_seeds.json.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-reproduce/pickles/multiseed}
+SEEDS=${SEEDS:-0 1 2 3 4}
+SWEEP_SEEDS=${SWEEP_SEEDS:-0 1 2}
+mkdir -p "$OUT"
+
+: > "$OUT/canonical_seeds.jsonl"
+for SEED in $SEEDS; do
+    echo "=== canonical shockwave seed $SEED ==="
+    python3 scripts/drivers/simulate.py \
+        --trace data/canonical_120job.trace \
+        --policy shockwave \
+        --throughputs data/tacc_throughputs.json \
+        --cluster_spec v100:32 --round_duration 120 \
+        --seed "$SEED" \
+        --config configs/tacc_32gpus.json \
+        | tail -1 | sed "s/^{/{\"seed\": $SEED, /" \
+        >> "$OUT/canonical_seeds.jsonl"
+done
+
+echo "=== load sweep (seeds: $SWEEP_SEEDS) ==="
+python3 scripts/sweeps/run_sweep_continuous.py \
+    --policies shockwave max_min_fairness finish_time_fairness \
+    --num_jobs 120 --lams 3600 300 150 \
+    --seeds $SWEEP_SEEDS \
+    --output "$OUT/load_sweep_seeds.json"
+
+python3 - "$OUT" <<'EOF'
+import json, statistics, sys
+out = sys.argv[1]
+rows = [json.loads(l) for l in open(f"{out}/canonical_seeds.jsonl")]
+mk = [r["makespan"] for r in rows]
+jct = [r["avg_jct"] for r in rows]
+print(f"canonical makespan: mean {statistics.mean(mk):.1f} "
+      f"+- {statistics.stdev(mk) if len(mk) > 1 else 0:.1f} "
+      f"(min {min(mk):.1f}, max {max(mk):.1f}, n={len(mk)})")
+print(f"canonical avg JCT:  mean {statistics.mean(jct):.1f} "
+      f"+- {statistics.stdev(jct) if len(jct) > 1 else 0:.1f}")
+EOF

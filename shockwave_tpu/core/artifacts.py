@@ -1,0 +1,43 @@
+"""Timestamped raw-measurement artifacts.
+
+Hardware claims (bench numbers, kernel parity errors, calibration
+constants) are only as durable as their raw measurements: the committed
+artifact is the evidence, the way the reference's committed oracle
+JSONs carry its measured GPU numbers. Every profiling/bench tool
+persists through this helper so all artifacts share one format:
+device + jax version + UTC capture time + the tool's payload, in a
+``<prefix>_<device>_<UTCstamp>.json`` file.
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import os
+from typing import Optional
+
+
+def save_measurement(dir_path: str, prefix: str, payload: dict,
+                     device_kind: Optional[str] = None):
+    """Write ``payload`` (stamped with provenance) to a timestamped JSON
+    under ``dir_path``; returns (path, stamped_record). The
+    ``measured_at`` stamp is what consumers (e.g. bench.py's
+    committed-artifact fallback) sort on, so it is always set here."""
+    import jax
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    if device_kind is None:
+        device_kind = jax.devices()[0].device_kind
+    record = {
+        "device": device_kind,
+        "jax_version": jax.__version__,
+        "measured_at": now.isoformat(timespec="seconds"),
+        **payload,
+    }
+    os.makedirs(dir_path, exist_ok=True)
+    name = (f"{prefix}_{device_kind.replace(' ', '_')}_"
+            f"{now.strftime('%Y%m%dT%H%M%SZ')}.json")
+    path = os.path.join(dir_path, name)
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    return path, record

@@ -1219,8 +1219,13 @@ class Scheduler:
             tput = self._oracle_step_throughput(job_id, worker_type, m)
             if tput <= 0:
                 raise RuntimeError(f"zero throughput for {m} on {worker_type}")
-            num_steps = min(max(int(tput * budget), 1),
-                            self._get_remaining_steps(m))
+            num_steps = int(tput * budget)
+            if overhead > 0:
+                # Calibrated model only: at least one step per dispatch,
+                # else a near-round-sized overhead would zero the round
+                # and livelock. The default path stays reference-exact.
+                num_steps = max(num_steps, 1)
+            num_steps = min(num_steps, self._get_remaining_steps(m))
             all_num_steps.append(num_steps)
             max_finish = max(max_finish, now + overhead + num_steps / tput)
             self._running_jobs.add(m)

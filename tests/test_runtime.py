@@ -521,6 +521,35 @@ class TestInitLeaseFloor:
             sched._done_event.set()
             sched._server.stop(grace=0)
 
+    def test_gang_job_seeds_from_estimated_sf_row(self):
+        """Physical scheduling of a multi-chip v5e job must start from
+        the oracle's scale_factor>1 prior (measured sf=1 rate scaled by
+        the reference's measured DP efficiency — scripts/profiling/
+        extrapolate_sf.py), not the fabricated DEFAULT_THROUGHPUT."""
+        from shockwave_tpu.core.oracle import read_throughputs
+        from shockwave_tpu.sched.scheduler import DEFAULT_THROUGHPUT
+        oracle_path = os.path.join(DATA, "v5e_throughputs.json")
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=oracle_path,
+            config=SchedulerConfig(time_per_iteration=100.0),
+            expected_num_workers=1, port=free_port())
+        try:
+            sched.register_worker("v5e", num_chips=4)
+            job = Job(None, "Transformer (batch size 64)",
+                      "python3 main.py --batch_size 64", "translation",
+                      "--step", total_steps=1000, duration=1000,
+                      scale_factor=4)
+            job_id = sched.add_job(job)
+            got = sched._throughputs[job_id]["v5e"]
+            want = read_throughputs(oracle_path)["v5e"][
+                ("Transformer (batch size 64)", 4)]["null"]
+            assert got == want
+            assert got != DEFAULT_THROUGHPUT
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
     def test_fresh_init_gets_remaining_round(self):
         sched = self._make_sched()
         try:

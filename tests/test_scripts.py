@@ -131,6 +131,78 @@ class TestProfiler:
         assert oracle["test"][("LM (batch size 5)", 1)]["null"] > 0
 
 
+class TestExtrapolateSf:
+    def test_adds_estimated_rows_with_provenance(self, tmp_path):
+        """sf>1 rows derived from measured sf=1 rates x the reference
+        oracle's measured scaling efficiency, recorded as estimates."""
+        oracle = {"v5e": {"('Transformer (batch size 64)', 1)":
+                          {"null": 10.0}}}
+        path = tmp_path / "o.json"
+        path.write_text(json.dumps(oracle))
+        run_script([os.path.join(REPO, "scripts/profiling/extrapolate_sf.py"),
+                    "--oracle", str(path), "--worker_type", "v5e"])
+        got = json.loads(path.read_text())
+        rows = got["v5e"]
+        ref = json.load(open(THROUGHPUTS))["v100"]
+        base = ref["('Transformer (batch size 64)', 1)"]["null"]
+        for sf in (2, 4, 8):
+            key = f"('Transformer (batch size 64)', {sf})"
+            eff = ref[key]["null"] / (base * sf)
+            assert rows[key]["null"] == pytest.approx(10.0 * sf * eff,
+                                                      rel=1e-3)
+            assert key in got["__meta__"]["estimated_rows"]["v5e"]
+
+    def test_never_overwrites_measured_rows(self, tmp_path):
+        oracle = {"v5e": {"('Transformer (batch size 64)', 1)":
+                          {"null": 10.0},
+                          "('Transformer (batch size 64)', 4)":
+                          {"null": 123.0}}}
+        path = tmp_path / "o.json"
+        path.write_text(json.dumps(oracle))
+        run_script([os.path.join(REPO, "scripts/profiling/extrapolate_sf.py"),
+                    "--oracle", str(path), "--worker_type", "v5e"])
+        got = json.loads(path.read_text())
+        assert got["v5e"]["('Transformer (batch size 64)', 4)"][
+            "null"] == 123.0
+        assert ("('Transformer (batch size 64)', 4)"
+                not in got["__meta__"]["estimated_rows"]["v5e"])
+
+
+class TestBenchTpuFallback:
+    def test_merges_newest_committed_artifact(self, tmp_path, monkeypatch):
+        """With the chip unreachable, bench.py must report the newest
+        committed raw measurement, provenance-marked (tpu_as_of)."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(REPO, "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+
+        tpu_dir = tmp_path / "reproduce" / "tpu"
+        tpu_dir.mkdir(parents=True)
+        (tpu_dir / "bench_TPU_v5_lite_20260101T000000Z.json").write_text(
+            json.dumps({"measured_at": "2026-01-01T00:00:00+00:00",
+                        "transformer_steps_per_s": 10.0}))
+        (tpu_dir / "bench_TPU_v5_lite_20260301T000000Z.json").write_text(
+            json.dumps({"measured_at": "2026-03-01T00:00:00+00:00",
+                        "transformer_steps_per_s": 52.8,
+                        "transformer_mfu": 0.33}))
+        monkeypatch.setattr(bench, "REPO", str(tmp_path))
+        got = bench.committed_tpu_result()
+        assert got["transformer_steps_per_s"] == 52.8
+        assert got["tpu_as_of"] == "2026-03-01T00:00:00+00:00"
+        assert got["tpu_source"].endswith("20260301T000000Z.json")
+
+    def test_empty_dir_gives_nothing(self, tmp_path, monkeypatch):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(REPO, "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        monkeypatch.setattr(bench, "REPO", str(tmp_path))
+        assert bench.committed_tpu_result() == {}
+
+
 class TestGraftEntry:
     def test_dryrun_multichip_with_unset_jax_platforms(self):
         """The driver leaves JAX_PLATFORMS unset and an accelerator plugin
