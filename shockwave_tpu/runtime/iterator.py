@@ -12,6 +12,19 @@ TPU-native notes (vs the reference's GavelIterator, gavel_iterator.py):
   plus a one-scalar device_get, which provably waits even through a
   relayed chip — so honest timing costs one device sync per lease
   check, not per step.
+- Async dispatch also lets the Python loop run arbitrarily far ahead of
+  the device, which breaks the lease protocol in two ways: the step
+  counter races to its renewal threshold in seconds while no compute
+  has finished, and the lease-boundary sync then has to drain the
+  whole dispatched backlog in one blocking call — minutes for
+  slow-step models — during which no renewal RPC (= heartbeat) is
+  sent, so the scheduler kills the job as unresponsive. The iterator
+  therefore bounds run-ahead with a sliding window of sync refs,
+  drained in batches: once SWTPU_RUNAHEAD_STEPS (default 8) extra
+  steps are queued past the window, it blocks on the oldest batch's
+  newest ref — one device round trip per `runahead` steps (amortized
+  for relayed chips), free when the device keeps up, an honest short
+  wait when it doesn't, keeping run-ahead under 2x the window.
 - Multi-chip jobs synchronize their exit with a global barrier across
   hosts so a gang checkpoint is consistent.
 - Checkpointing is delegated to caller functions (orbax-based helpers in
@@ -24,6 +37,7 @@ Environment contract (set by the dispatcher):
 from __future__ import annotations
 
 import atexit
+import collections
 import logging
 import os
 import time
@@ -56,11 +70,14 @@ def _device_sync(value: Any) -> None:
         from ..core.timing import fetch_scalar
         fetch_scalar(value)
     except Exception as e:  # noqa: BLE001
-        # Lease accounting degrades to dispatch-time on sync failure;
-        # say so rather than silently under-reporting durations.
+        # Sync is load-bearing twice over: honest durations AND the
+        # run-ahead bound that keeps renewal heartbeats timely. On
+        # persistent failure both degrade — say exactly that.
         logging.getLogger("lease_iterator").warning(
-            "device sync failed (%s: %s); step timing may under-report",
-            type(e).__name__, e)
+            "device sync failed (%s: %s); step timing may under-report "
+            "and the async run-ahead bound is not enforced (renewal "
+            "heartbeats may be late; scheduler may kill this job as "
+            "unresponsive)", type(e).__name__, e)
 
 
 class LeaseIterator:
@@ -117,6 +134,11 @@ class LeaseIterator:
         self._duration = 0.0
         self._done = False
         self._sync_ref: Any = None
+        # Sliding window bounding async run-ahead (module docstring).
+        self._runahead = max(
+            int(os.environ.get("SWTPU_RUNAHEAD_STEPS", "8")), 1)
+        self._sync_window: "collections.deque" = collections.deque()
+        self._last_windowed_ref: Any = None
         self._cached_batch = None
         self._lease = Lease(0, 0)
         self._write_on_close = write_on_close
@@ -152,6 +174,36 @@ class LeaseIterator:
         self._prev_time = now
 
         gang = self._gang_allreduce is not None
+        if not gang:
+            # Bound async run-ahead: enqueue the newest sync ref (the
+            # previous step's loss) and block on the ref from
+            # `runahead` steps back. Free when the device keeps up;
+            # otherwise an honest wait that keeps the step counter,
+            # the duration clock, and the dispatched backlog within
+            # `runahead` steps of the device — so lease checks fire on
+            # time and a lease-boundary sync never has to drain a
+            # minutes-deep queue while heartbeats are due. (Gangs get
+            # the same bound from their gang_sync_every boundary sync.)
+            if (self._sync_ref is not None
+                    and self._sync_ref is not self._last_windowed_ref):
+                self._sync_window.append(self._sync_ref)
+                self._last_windowed_ref = self._sync_ref
+            if len(self._sync_window) >= 2 * self._runahead:
+                # Steps execute in dispatch order (the donated train
+                # state chains them), so syncing the newest ref of the
+                # drained batch proves everything before it finished:
+                # one device round trip per `runahead` steps — amortized
+                # for relayed backends where each host fetch costs tens
+                # of ms — with run-ahead in [runahead, 2*runahead).
+                newest_drained = None
+                while len(self._sync_window) > self._runahead:
+                    newest_drained = self._sync_window.popleft()
+                _device_sync(newest_drained)
+                sync_now = time.time()
+                waited = sync_now - self._prev_time
+                self._duration += waited
+                elapsed += waited  # feeds the renewal countdown below
+                self._prev_time = sync_now
         # Gang members only evaluate time-based conditions at shared
         # K-step boundaries, on an agreed (max-allreduced) duration, so
         # the whole gang reaches the same verdict at the same step.

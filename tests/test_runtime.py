@@ -136,6 +136,81 @@ class TestLeaseIterator:
             server.stop(grace=0)
 
 
+    def test_async_runahead_bounded_and_renewal_timely(self, tmp_path,
+                                                       monkeypatch):
+        """Regression: JAX async dispatch let the Python loop race to the
+        steps-based renewal threshold in seconds, then the renewal's
+        device sync drained the whole dispatched backlog (minutes for
+        slow-step models) before the renewal RPC — the only heartbeat —
+        was sent, so the scheduler killed the job as unresponsive. The
+        run-ahead window must keep dispatch within SWTPU_RUNAHEAD_STEPS
+        of the device so every sync is short and renewals are timely."""
+        port = free_port()
+        step_time = 0.04
+        t0 = time.time()
+        renewal_walls = []
+
+        def update_lease(job_id, worker_id, steps, duration, max_steps,
+                         max_duration):
+            renewal_walls.append(time.time() - t0)
+            return (int(max_steps), float(max_duration), 0.0, 1e9)  # deny
+
+        server = serve_scheduler(port, {
+            "RegisterWorker": lambda **kw: ([0], 60.0),
+            "Done": lambda *a: None,
+            # 500-step lease, 1.2 s max duration: time expiry must win.
+            "InitJob": lambda job_id: (500, 1.2, 0.0),
+            "UpdateLease": update_lease,
+            "UpdateResourceRequirement": lambda *a: None,
+        })
+        monkeypatch.setenv("SWTPU_JOB_ID", "0")
+        monkeypatch.setenv("SWTPU_WORKER_ID", "0")
+        monkeypatch.setenv("SWTPU_ROUND_ID", "0")
+        monkeypatch.setenv("SWTPU_SCHED_ADDR", "localhost")
+        monkeypatch.setenv("SWTPU_SCHED_PORT", str(port))
+        monkeypatch.setenv("SWTPU_RUNAHEAD_STEPS", "4")
+
+        from shockwave_tpu.runtime import iterator as iterator_mod
+
+        def fake_device_sync(ref):
+            # The simulated device finishes step i at t0 + (i+1)*step_time;
+            # syncing on step i's ref waits until then.
+            if ref is None:
+                return
+            done_at = t0 + (ref[0] + 1) * step_time
+            wait = done_at - time.time()
+            if wait > 0:
+                time.sleep(wait)
+
+        monkeypatch.setattr(iterator_mod, "_device_sync", fake_device_sync)
+        try:
+            it = iterator_mod.LeaseIterator(
+                data_loader=list(range(1000)), checkpoint_dir=str(tmp_path),
+                load_checkpoint_func=lambda p: None,
+                save_checkpoint_func=lambda p, s: None,
+                synthetic_data=True)
+            dispatched = 0
+            try:
+                for _ in it:
+                    # Python dispatch is instant; the device is not.
+                    it.set_sync_ref([dispatched])
+                    dispatched += 1
+            except StopIteration:
+                pass
+            total_wall = time.time() - t0
+            assert it.done
+            # Expiry by time (~1.2 s) plus a <= runahead-deep drain — not
+            # after draining a hundreds-deep backlog (>= 10 s pre-fix).
+            assert total_wall < 3.0, total_wall
+            # Dispatch stayed within the window of the device: ~30 real
+            # steps fit in the lease; 500 would mean unbounded run-ahead.
+            assert dispatched <= 1.2 / step_time + 10, dispatched
+            # The renewal heartbeat went out near the 75% lease point.
+            assert renewal_walls and renewal_walls[0] < 2.0, renewal_walls
+        finally:
+            server.stop(grace=0)
+
+
 class StubWorkerDaemon:
     """In-process worker: simulates job execution at a fixed throughput
     instead of launching training subprocesses."""
