@@ -1,0 +1,230 @@
+#!/usr/bin/env python3
+"""Deployed-conditions oracle calibration via the real runtime stack.
+
+The in-process profiler (measure_throughput.py) times jitted steps with
+the job alone on the host. On a loopback deployment where the
+scheduler, worker daemon, training process, and the next job's
+early-dispatched startup all share the same cores, jobs run measurably
+slower than that solo rate (e.g. -29% for the LM family on a 1-core
+host), and each preemption cycle carries dead time outside the lease
+(exit + progress scrape + done RPC + round rollover + unhidden
+startup). Both effects are properties of the deployment, so — like the
+reference, whose oracle was measured through its runtime harness on
+the cluster it scheduled (scheduler/scripts/profiling) — they belong
+in the oracle, not in fudge factors.
+
+For each family this script runs a 2-job single-worker physical
+loopback (two same-family jobs force an alternating preempt/redispatch
+cycle, the regime contended traces live in) for a few rounds, then
+reads the per-round iterator logs to measure:
+
+  - deployed throughput: steps / in-lease seconds across all leases;
+  - round drain: mean cycle excess over the round duration
+    (init-to-init gap minus round), written to
+    __meta__.round_drain_s[worker_type];
+  - lease shortfall: round minus mean in-lease duration — the unhidden
+    startup that shrinks the step window, written to
+    __meta__.dispatch_overhead_s_by_type (and the scalar mean).
+
+The simulator consumes all three (sched/scheduler.py calibrated model).
+Calibration runs use dedicated 2-job traces, so validating a different
+trace against the resulting oracle is not circular.
+
+Example:
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \\
+      python scripts/profiling/measure_deployed.py --worker_type cpu \\
+      --oracle reproduce/fidelity/cpu_throughputs.json
+"""
+import argparse
+import datetime
+import glob
+import json
+import os
+import re
+import shlex
+import shutil
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, REPO)
+
+from shockwave_tpu.core.job_table import JOB_TABLE  # noqa: E402
+from shockwave_tpu.core.trace import job_to_trace_line  # noqa: E402
+from shockwave_tpu.core.job import Job  # noqa: E402
+
+LOG_TS = "%Y-%m-%d %H:%M:%S"
+
+
+def free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def run_calibration(template, steps_per_job, duration, round_s, rounds,
+                    data_dir, timeout):
+    """2-job loopback for `rounds` rounds; returns the checkpoint dir
+    holding the per-round iterator logs."""
+    ckpt = tempfile.mkdtemp(prefix="swtpu_deployed_")
+    trace = os.path.join(ckpt, "cal.trace")
+    with open(trace, "w") as f:
+        for _ in range(2):
+            job = Job(None, template.model, template.command,
+                      template.working_directory, template.num_steps_arg,
+                      needs_data_dir=template.needs_data_dir,
+                      total_steps=steps_per_job, duration=duration)
+            f.write(job_to_trace_line(job, 0.0) + "\n")
+    port = free_port()
+    sched = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts/drivers/run_physical.py"),
+         "--trace", trace, "--policy", "max_min_fairness",
+         "--throughputs", os.path.join(REPO, "data/tacc_throughputs.json"),
+         "--expected_num_workers", "1", "--round_duration", str(round_s),
+         "--port", str(port), "--timeout", str(timeout),
+         "--max_rounds", str(rounds),
+         "--output", os.path.join(ckpt, "out.pkl")],
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    time.sleep(4)
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "shockwave_tpu.runtime.worker",
+         "--worker_type", "cal", "--sched_addr", "127.0.0.1",
+         "--sched_port", str(port), "--worker_port", str(free_port()),
+         "--num_chips", "1", "--data_dir", data_dir,
+         "--checkpoint_dir", ckpt],
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        sched.wait(timeout=timeout + 120)
+    finally:
+        for p in (sched, worker):
+            if p.poll() is None:
+                p.kill()
+    return ckpt
+
+
+def parse_rounds(ckpt):
+    """[(round, load_end, lease_expiry, save_end, steps, lease_dur)]"""
+    out = []
+    for path in glob.glob(os.path.join(
+            ckpt, "job_id=*", ".swtpu", "round=*", "worker=0.log")):
+        rnd = int(re.search(r"round=(\d+)", path).group(1))
+        load = exp = save_end = None
+        steps = dur = None
+        for line in open(path):
+            m = re.match(r"\[(.*?)\] \[(.*?)\] \[(.*?)\]\s*(.*)", line)
+            if not m:
+                continue
+            t = datetime.datetime.strptime(m.group(1), LOG_TS)
+            ev, st, msg = m.group(2), m.group(3), m.group(4)
+            if ev == "LOAD CHECKPOINT" and st == "END":
+                load = t
+            elif ev == "LEASE" and st in ("EXPIRED", "COMPLETE"):
+                exp = t
+                sm = re.match(r"(\d+) / \S+ steps, ([\d.]+)", msg)
+                if sm:
+                    steps, dur = int(sm.group(1)), float(sm.group(2))
+            elif ev == "SAVE CHECKPOINT" and st == "END":
+                save_end = t
+        if load is not None:
+            out.append((rnd, load, exp, save_end, steps, dur))
+    return sorted(out)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--worker_type", required=True)
+    p.add_argument("--oracle", required=True)
+    p.add_argument("--families", nargs="+",
+                   default=["ResNet-18 (batch size 32)", "LM (batch size 20)",
+                            "Recommendation (batch size 512)"])
+    p.add_argument("--round_duration", type=float, default=120.0)
+    p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--data_dir", default="/tmp/swtpu_data")
+    p.add_argument("--timeout", type=float, default=1500.0)
+    args = p.parse_args()
+
+    by_model = {t.model: t for t in JOB_TABLE}
+    with open(args.oracle) as f:
+        oracle = json.load(f)
+    rows = oracle.setdefault(args.worker_type, {})
+    meta = oracle.setdefault("__meta__", {})
+    drains, shortfalls, detail = [], [], {}
+
+    for family in args.families:
+        template = by_model[family]
+        # Enough steps that neither job finishes inside the calibration
+        # window: rate is taken from solo profile when present, else a
+        # conservative 0.2 steps/s.
+        solo = rows.get(f"('{family}', 1)", {}).get("null") or 0.2
+        steps_per_job = int(solo * args.round_duration * args.rounds)
+        duration = int(args.rounds * args.round_duration * 4)
+        ckpt = run_calibration(
+            template, steps_per_job, duration, args.round_duration,
+            args.rounds, args.data_dir, args.timeout)
+        try:
+            recs = parse_rounds(ckpt)
+        finally:
+            shutil.rmtree(ckpt, ignore_errors=True)
+        # Skip round 0 (cold compile cache perturbs it).
+        leases = [(s, d) for rnd, _, _, _, s, d in recs
+                  if rnd > 0 and s and d]
+        if not leases:
+            raise SystemExit(f"{family}: no usable leases measured")
+        tput = sum(s for s, _ in leases) / sum(d for _, d in leases)
+        lease_durs = [d for _, d in leases]
+        gaps = []
+        prev_exit = None
+        for rnd, load, exp, save_end, s, d in recs:
+            end = save_end or exp
+            if prev_exit is not None and load is not None and rnd > 0:
+                gaps.append((load - prev_exit).total_seconds())
+            if end is not None:
+                prev_exit = end
+        # Cycle excess over the round: everything outside the lease.
+        cycle_excess = [
+            g + (args.round_duration - min(d, args.round_duration))
+            for g, d in zip(gaps, lease_durs)]
+        drain = statistics.mean(cycle_excess) if cycle_excess else 0.0
+        shortfall = max(
+            args.round_duration - statistics.mean(lease_durs), 0.0)
+        rows[f"('{family}', 1)"] = {"null": round(tput, 4)}
+        meta.setdefault("dispatch_overhead_s_by_type", {}).setdefault(
+            args.worker_type, {})[family] = round(shortfall, 2)
+        drains.append(drain)
+        shortfalls.append(shortfall)
+        detail[family] = {
+            "deployed_steps_per_s": round(tput, 4),
+            "solo_steps_per_s": solo,
+            "leases": len(leases),
+            "mean_lease_s": round(statistics.mean(lease_durs), 1),
+            "mean_cycle_excess_s": round(drain, 1),
+        }
+        print(f"{family}: deployed {tput:.4f} steps/s "
+              f"(solo {solo}), lease shortfall {shortfall:.1f}s, "
+              f"cycle excess {drain:.1f}s")
+
+    meta.setdefault("dispatch_overhead_s", {})[args.worker_type] = round(
+        statistics.mean(shortfalls), 2)
+    meta.setdefault("round_drain_s", {})[args.worker_type] = round(
+        statistics.mean(drains), 2)
+    meta.setdefault("deployed_calibration", {})[args.worker_type] = {
+        "measured_at": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "method": "2-job alternating loopback via the real runtime; "
+                  "steps/in-lease-second; cycle excess over round",
+        "round_duration": args.round_duration,
+        "per_family": detail,
+    }
+    with open(args.oracle, "w") as f:
+        json.dump(oracle, f, indent=1)
+        f.write("\n")
+    print(f"round_drain_s[{args.worker_type}] = "
+          f"{meta['round_drain_s'][args.worker_type]} -> {args.oracle}")
+
+
+if __name__ == "__main__":
+    main()

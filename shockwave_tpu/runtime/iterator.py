@@ -139,6 +139,8 @@ class LeaseIterator:
             int(os.environ.get("SWTPU_RUNAHEAD_STEPS", "8")), 1)
         self._sync_window: "collections.deque" = collections.deque()
         self._last_windowed_ref: Any = None
+        self._steps_without_new_ref = 0
+        self._warned_static_ref = False
         self._cached_batch = None
         self._lease = Lease(0, 0)
         self._write_on_close = write_on_close
@@ -188,6 +190,20 @@ class LeaseIterator:
                     and self._sync_ref is not self._last_windowed_ref):
                 self._sync_window.append(self._sync_ref)
                 self._last_windowed_ref = self._sync_ref
+                self._steps_without_new_ref = 0
+            else:
+                # Without a fresh per-step ref the window cannot grow
+                # and the run-ahead bound silently disappears — warn
+                # once so the caller knows to set_sync_ref every step.
+                self._steps_without_new_ref += 1
+                if (self._steps_without_new_ref > 2 * self._runahead
+                        and not self._warned_static_ref):
+                    self._warned_static_ref = True
+                    self._logger.warning(
+                        "no fresh sync ref for %d steps: async run-ahead "
+                        "is unbounded and lease timing/heartbeats may "
+                        "degrade; call set_sync_ref(loss) every step",
+                        self._steps_without_new_ref)
             if len(self._sync_window) >= 2 * self._runahead:
                 # Steps execute in dispatch order (the donated train
                 # state chains them), so syncing the newest ref of the

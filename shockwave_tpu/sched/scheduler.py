@@ -126,6 +126,21 @@ class Scheduler:
         self._dispatch_overhead = self._config.dispatch_overhead_s
         if self._dispatch_overhead is None:
             self._dispatch_overhead = oracle_meta.get("dispatch_overhead_s")
+        # Optional per-job-type refinement: startup varies by family
+        # (model import + checkpoint size + compile), e.g. 23 s for
+        # ResNet vs 7 s for Recommendation on the CPU loopback host.
+        # {worker_type: {job_type: seconds}}; unlisted types fall back
+        # to the per-worker-type scalar.
+        self._dispatch_overhead_by_type = oracle_meta.get(
+            "dispatch_overhead_s_by_type", {})
+        # Measured per-cycle dead time OUTSIDE the lease (exit +
+        # progress scrape + done RPC + round rollover + unhidden next
+        # startup): physically every preemption cycle runs
+        # round_duration + drain, so the simulator shifts each cold
+        # dispatch's finish time by it without shrinking the step
+        # budget ({worker_type: seconds}, measured by
+        # scripts/profiling/measure_deployed.py).
+        self._round_drain = oracle_meta.get("round_drain_s", {})
         self._throughput_timeline: Dict[int, "collections.OrderedDict"] = {}
 
         # Cost / SLO / timeline observability.
@@ -1103,8 +1118,9 @@ class Scheduler:
                 # Reference-parity flat post-preemption charge — skipped
                 # when the calibrated cold-dispatch model already charged
                 # measured startup at dispatch time.
-                calibrated = (self._dispatch_overhead or {}).get(
-                    self.workers.id_to_type[worker_ids[0]]) is not None
+                calibrated = self._cold_dispatch_overhead(
+                    self.workers.id_to_type[worker_ids[0]],
+                    job_id) is not None
                 if current_round >= 2 and not calibrated:
                     prev_sched = self.rounds.per_round_schedule[current_round - 2]
                     for m in job_id.singletons():
@@ -1182,15 +1198,25 @@ class Scheduler:
 
             for job_id, worker_ids in assignments.items():
                 worker_type = self.workers.id_to_type[worker_ids[0]]
-                overhead = 0.0
+                overhead = drain = 0.0
                 if job_id not in warm_jobs:
-                    overhead = (self._dispatch_overhead or {}).get(
-                        worker_type, 0.0)
+                    cold = self._cold_dispatch_overhead(worker_type, job_id)
+                    if cold is not None:
+                        overhead = cold
+                        drain = self._round_drain.get(worker_type, 0.0)
                 all_num_steps, finish_time = self._steps_and_finish_time(
                     job_id, worker_type, overhead)
+                # Post-lease dead time shifts the cycle without eating
+                # the step budget (see _round_drain above). It is also
+                # excluded from execution-time accounting — shifting the
+                # recorded dispatch timestamp by the drain keeps
+                # execution_time = finish - dispatch equal to
+                # overhead + compute, so run-time/deadline/cost
+                # accounting never accrues phantom drain seconds.
+                finish_time += drain
                 heapq.heappush(
                     running, (-finish_time, job_id, worker_ids, all_num_steps,
-                              self._current_timestamp))
+                              self._current_timestamp + drain))
 
             current_round += 1
             self.rounds.num_completed_rounds += 1
@@ -1201,6 +1227,24 @@ class Scheduler:
         self.log.info("Simulation done: makespan %.1fs (%.2fh)",
                     self._current_timestamp, self._current_timestamp / 3600)
         return self._current_timestamp
+
+    def _cold_dispatch_overhead(self, worker_type: str, job_id: JobIdPair):
+        """Measured cold-dispatch charge for this job on this worker
+        type under the calibrated model, or None when not calibrated.
+        Explicit config beats everything (an operator override must not
+        be shadowed by stale oracle metadata); otherwise per-job-type
+        measurements win over the per-worker-type scalar; pairs charge
+        the slower-starting member."""
+        if self._config.dispatch_overhead_s is not None:
+            return self._config.dispatch_overhead_s.get(worker_type)
+        by_type = self._dispatch_overhead_by_type.get(worker_type, {})
+        typed = [by_type[self.acct.jobs[m].job_type]
+                 for m in job_id.singletons()
+                 if m in self.acct.jobs
+                 and self.acct.jobs[m].job_type in by_type]
+        if typed:
+            return max(typed)
+        return (self._dispatch_overhead or {}).get(worker_type)
 
     def _steps_and_finish_time(self, job_id: JobIdPair, worker_type: str,
                                overhead: float = 0.0):

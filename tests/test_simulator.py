@@ -112,6 +112,86 @@ class TestCalibratedDispatchOverhead:
                           num_workers=1)
         assert makespan == pytest.approx(base + 30.0, abs=2.0)
 
+    def test_per_job_type_overhead_wins_over_scalar(self, tmp_path):
+        """Measured per-type startup (e.g. ResNet 23 s vs Rec 7 s on the
+        loopback host) must override the per-worker-type mean."""
+        with open(os.path.join(DATA, "tacc_throughputs.json")) as f:
+            oracle = json.load(f)
+        oracle["__meta__"] = {
+            "dispatch_overhead_s": {"v100": 10.0},
+            "dispatch_overhead_s_by_type": {
+                "v100": {"ResNet-18 (batch size 32)": 40.0}}}
+        path = tmp_path / "oracle_meta.json"
+        path.write_text(json.dumps(oracle))
+        steps = int(self.RATE * 300)
+
+        def run(oracle_path):
+            policy = get_policy("max_min_fairness", seed=0)
+            sched = Scheduler(
+                policy, simulate=True, throughputs_file=str(oracle_path),
+                config=SchedulerConfig(time_per_iteration=120.0))
+            return sched.simulate(
+                {"v100": 1}, [0.0], [make_job(total_steps=steps)])
+
+        typed = run(path)
+        _, base = run_sim([make_job(total_steps=steps)], [0.0],
+                          num_workers=1)
+        # Single job lease-extends after round 1: exactly one cold
+        # charge, at the per-type 40 s, not the 10 s scalar.
+        assert typed == pytest.approx(base + 40.0, abs=2.0)
+
+    def test_round_drain_shifts_cycle_without_phantom_run_time(
+            self, tmp_path):
+        """round_drain_s is dead time OUTSIDE the lease: it must push
+        completion later but never accrue as job run time (which feeds
+        the 1.5x deadline and cost accounting)."""
+        with open(os.path.join(DATA, "tacc_throughputs.json")) as f:
+            oracle = json.load(f)
+        oracle["__meta__"] = {"dispatch_overhead_s": {"v100": 10.0},
+                              "round_drain_s": {"v100": 30.0}}
+        path = tmp_path / "oracle_drain.json"
+        path.write_text(json.dumps(oracle))
+        steps = int(self.RATE * 300)
+        policy = get_policy("max_min_fairness", seed=0)
+        sched = Scheduler(
+            policy, simulate=True, throughputs_file=str(path),
+            config=SchedulerConfig(time_per_iteration=120.0))
+        makespan = sched.simulate(
+            {"v100": 1}, [0.0], [make_job(total_steps=steps)])
+        _, base = run_sim([make_job(total_steps=steps)], [0.0],
+                          num_workers=1)
+        # One cold dispatch: +10 s budget loss inside, +30 s drain shift.
+        assert makespan == pytest.approx(base + 40.0, abs=2.0)
+        run_time = sum(
+            sum(per.values())
+            for per in sched.acct.run_time_per_worker.values())
+        # Accounted run time covers overhead + compute only — the 30 s
+        # drain must not appear in it.
+        assert run_time <= base + 10.0 + 2.0
+        assert run_time >= base - 2.0
+
+    def test_explicit_config_beats_oracle_by_type(self, tmp_path):
+        with open(os.path.join(DATA, "tacc_throughputs.json")) as f:
+            oracle = json.load(f)
+        oracle["__meta__"] = {
+            "dispatch_overhead_s": {"v100": 10.0},
+            "dispatch_overhead_s_by_type": {
+                "v100": {"ResNet-18 (batch size 32)": 40.0}}}
+        path = tmp_path / "oracle_cfg.json"
+        path.write_text(json.dumps(oracle))
+        steps = int(self.RATE * 300)
+        policy = get_policy("max_min_fairness", seed=0)
+        sched = Scheduler(
+            policy, simulate=True, throughputs_file=str(path),
+            config=SchedulerConfig(time_per_iteration=120.0,
+                                   dispatch_overhead_s={"v100": 15.0}))
+        makespan = sched.simulate(
+            {"v100": 1}, [0.0], [make_job(total_steps=steps)])
+        _, base = run_sim([make_job(total_steps=steps)], [0.0],
+                          num_workers=1)
+        # The operator's 15 s wins over both oracle values.
+        assert makespan == pytest.approx(base + 15.0, abs=2.0)
+
     def test_meta_key_invisible_to_throughput_readers(self, tmp_path):
         from shockwave_tpu.core.oracle import (read_oracle_meta,
                                                read_throughputs)
