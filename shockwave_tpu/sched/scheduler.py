@@ -141,6 +141,17 @@ class Scheduler:
         # budget ({worker_type: seconds}, measured by
         # scripts/profiling/measure_deployed.py).
         self._round_drain = oracle_meta.get("round_drain_s", {})
+        # Deployment-faithful mode (any calibration present): the
+        # physical round mechanism wall-clocks rounds — a job completing
+        # mid-round leaves its worker idle until the boundary — so the
+        # simulator floors each round at the full round duration instead
+        # of rolling at the last completion. Default (uncalibrated) DES
+        # keeps the reference's completion-rolled rounds for replay
+        # parity.
+        self._deployment_faithful = bool(
+            self._dispatch_overhead or self._dispatch_overhead_by_type
+            or self._round_drain)
+        self._sim_round_start: Optional[float] = None
         self._throughput_timeline: Dict[int, "collections.OrderedDict"] = {}
 
         # Cost / SLO / timeline observability.
@@ -1084,6 +1095,13 @@ class Scheduler:
             if running and -running[0][0] > max_ts:
                 max_ts = -running[0][0]
             if max_ts > 0:
+                if (self._deployment_faithful
+                        and self._sim_round_start is not None):
+                    # Wall-clocked rounds (see _deployment_faithful): a
+                    # round never rolls before its full duration even
+                    # when every micro-task finished early.
+                    max_ts = max(max_ts, self._sim_round_start
+                                 + self._time_per_iteration)
                 self._current_timestamp = max_ts
                 forced_resolve = False
             elif next_arrival is not None:
@@ -1217,6 +1235,7 @@ class Scheduler:
                 heapq.heappush(
                     running, (-finish_time, job_id, worker_ids, all_num_steps,
                               self._current_timestamp + drain))
+            self._sim_round_start = self._current_timestamp
 
             current_round += 1
             self.rounds.num_completed_rounds += 1
@@ -1224,6 +1243,19 @@ class Scheduler:
                     and self.rounds.num_completed_rounds >= self._config.max_rounds):
                 break
 
+        # Deployment-faithful mode: when the trace drained fully, rewind
+        # the exit clock from the padded final-round boundary to the
+        # last completion — the stamp the physical driver tears down at
+        # (get_last_completion_time) — so makespan AND every
+        # current-timestamp-based metric (utilization denominators,
+        # timelines) share the physical clock. Unfinished exits
+        # (max_rounds / no runnable work) keep the elapsed event clock,
+        # matching run_physical's all_jobs_completed fallback. Default
+        # mode is untouched: its exit clock already equals the last
+        # completion (replay parity).
+        if (self._deployment_faithful and remaining_jobs == 0
+                and self._last_completion_time > 0):
+            self._current_timestamp = self._last_completion_time
         self.log.info("Simulation done: makespan %.1fs (%.2fh)",
                     self._current_timestamp, self._current_timestamp / 3600)
         return self._current_timestamp
