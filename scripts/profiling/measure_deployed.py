@@ -194,6 +194,8 @@ def main():
         rows[f"('{family}', 1)"] = {"null": round(tput, 4)}
         meta.setdefault("dispatch_overhead_s_by_type", {}).setdefault(
             args.worker_type, {})[family] = round(shortfall, 2)
+        meta.setdefault("round_drain_s_by_type", {}).setdefault(
+            args.worker_type, {})[family] = round(drain, 2)
         drains.append(drain)
         shortfalls.append(shortfall)
         detail[family] = {

@@ -141,6 +141,11 @@ class Scheduler:
         # budget ({worker_type: seconds}, measured by
         # scripts/profiling/measure_deployed.py).
         self._round_drain = oracle_meta.get("round_drain_s", {})
+        # Optional per-job-type drain ({worker_type: {job_type: s}}):
+        # the dead time is dominated by the incoming job's startup, so
+        # it varies by family like the dispatch overhead does.
+        self._round_drain_by_type = oracle_meta.get(
+            "round_drain_s_by_type", {})
         # Deployment-faithful mode (any calibration present): the
         # physical round mechanism wall-clocks rounds — a job completing
         # mid-round leaves its worker idle until the boundary — so the
@@ -150,7 +155,7 @@ class Scheduler:
         # parity.
         self._deployment_faithful = bool(
             self._dispatch_overhead or self._dispatch_overhead_by_type
-            or self._round_drain)
+            or self._round_drain or self._round_drain_by_type)
         self._sim_round_start: Optional[float] = None
         self._throughput_timeline: Dict[int, "collections.OrderedDict"] = {}
 
@@ -1133,13 +1138,10 @@ class Scheduler:
                 # (not the previous round's end) keeps idle cluster gaps and a
                 # nonzero first arrival from inflating the measurement.
                 execution_time = finish_time - dispatch_time
-                # Reference-parity flat post-preemption charge — skipped
-                # when the calibrated cold-dispatch model already charged
-                # measured startup at dispatch time.
-                calibrated = self._cold_dispatch_overhead(
-                    self.workers.id_to_type[worker_ids[0]],
-                    job_id) is not None
-                if current_round >= 2 and not calibrated:
+                # Reference-parity flat post-preemption charge — replaced
+                # wholesale by the measured charges in deployment-faithful
+                # mode.
+                if current_round >= 2 and not self._deployment_faithful:
                     prev_sched = self.rounds.per_round_schedule[current_round - 2]
                     for m in job_id.singletons():
                         if m.integer_job_id() not in prev_sched:
@@ -1217,11 +1219,10 @@ class Scheduler:
             for job_id, worker_ids in assignments.items():
                 worker_type = self.workers.id_to_type[worker_ids[0]]
                 overhead = drain = 0.0
-                if job_id not in warm_jobs:
-                    cold = self._cold_dispatch_overhead(worker_type, job_id)
-                    if cold is not None:
-                        overhead = cold
-                        drain = self._round_drain.get(worker_type, 0.0)
+                if self._deployment_faithful and job_id not in warm_jobs:
+                    overhead = self._cold_dispatch_overhead(
+                        worker_type, job_id) or 0.0
+                    drain = self._cold_round_drain(worker_type, job_id)
                 all_num_steps, finish_time = self._steps_and_finish_time(
                     job_id, worker_type, overhead)
                 # Post-lease dead time shifts the cycle without eating
@@ -1269,14 +1270,30 @@ class Scheduler:
         the slower-starting member."""
         if self._config.dispatch_overhead_s is not None:
             return self._config.dispatch_overhead_s.get(worker_type)
-        by_type = self._dispatch_overhead_by_type.get(worker_type, {})
+        typed = self._per_type_max(
+            self._dispatch_overhead_by_type.get(worker_type, {}), job_id)
+        if typed is not None:
+            return typed
+        return (self._dispatch_overhead or {}).get(worker_type)
+
+    def _per_type_max(self, by_type: Dict[str, float], job_id: JobIdPair):
+        """Largest per-job-type calibration value among the pair's
+        members (the slower-starting member gates the pair), or None
+        when no member's job type is profiled."""
         typed = [by_type[self.acct.jobs[m].job_type]
                  for m in job_id.singletons()
                  if m in self.acct.jobs
                  and self.acct.jobs[m].job_type in by_type]
-        if typed:
-            return max(typed)
-        return (self._dispatch_overhead or {}).get(worker_type)
+        return max(typed) if typed else None
+
+    def _cold_round_drain(self, worker_type: str, job_id: JobIdPair) -> float:
+        """Post-lease dead time for a cold dispatch of this job; per-type
+        measurement wins over the per-worker-type mean."""
+        typed = self._per_type_max(
+            self._round_drain_by_type.get(worker_type, {}), job_id)
+        if typed is not None:
+            return typed
+        return self._round_drain.get(worker_type, 0.0)
 
     def _steps_and_finish_time(self, job_id: JobIdPair, worker_type: str,
                                overhead: float = 0.0):

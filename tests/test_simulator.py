@@ -170,6 +170,46 @@ class TestCalibratedDispatchOverhead:
         assert run_time <= base + 10.0 + 2.0
         assert run_time >= base - 2.0
 
+    def test_per_type_round_drain_wins_over_scalar(self, tmp_path):
+        """The headline fidelity artifact depends on the per-type drain
+        path: it must override the per-worker-type mean."""
+        with open(os.path.join(DATA, "tacc_throughputs.json")) as f:
+            oracle = json.load(f)
+        oracle["__meta__"] = {
+            "dispatch_overhead_s": {"v100": 0.0},
+            "round_drain_s": {"v100": 5.0},
+            "round_drain_s_by_type": {
+                "v100": {"ResNet-18 (batch size 32)": 35.0}}}
+        path = tmp_path / "oracle_drain_type.json"
+        path.write_text(json.dumps(oracle))
+        steps = int(self.RATE * 300)
+        policy = get_policy("max_min_fairness", seed=0)
+        sched = Scheduler(
+            policy, simulate=True, throughputs_file=str(path),
+            config=SchedulerConfig(time_per_iteration=120.0))
+        makespan = sched.simulate(
+            {"v100": 1}, [0.0], [make_job(total_steps=steps)])
+        _, base = run_sim([make_job(total_steps=steps)], [0.0],
+                          num_workers=1)
+        # One cold dispatch: the per-type 35 s drain shift, not 5 s.
+        assert makespan == pytest.approx(base + 35.0, abs=2.0)
+
+    def test_per_type_drain_alone_activates_faithful_mode(self, tmp_path):
+        """A by-type-only drain calibration must still flip the
+        simulator into deployment-faithful mode."""
+        with open(os.path.join(DATA, "tacc_throughputs.json")) as f:
+            oracle = json.load(f)
+        oracle["__meta__"] = {
+            "round_drain_s_by_type": {
+                "v100": {"ResNet-18 (batch size 32)": 35.0}}}
+        path = tmp_path / "oracle_drain_only.json"
+        path.write_text(json.dumps(oracle))
+        policy = get_policy("max_min_fairness", seed=0)
+        sched = Scheduler(
+            policy, simulate=True, throughputs_file=str(path),
+            config=SchedulerConfig(time_per_iteration=120.0))
+        assert sched._deployment_faithful
+
     def test_explicit_config_beats_oracle_by_type(self, tmp_path):
         with open(os.path.join(DATA, "tacc_throughputs.json")) as f:
             oracle = json.load(f)
