@@ -27,12 +27,13 @@ TRACE=${TRACE:-reproduce/fidelity/fidelity_3job.trace}
 WORKER_TYPE=${WORKER_TYPE:-v5e}
 ORACLE=${ORACLE:-data/v5e_throughputs.json}
 TOL=${TOL:-0.15}
+POLICY=${POLICY:-max_min_fairness}
 TIMEOUT=${TIMEOUT:-3600}
 CKPT=$(mktemp -d /tmp/swtpu_fidelity.XXXX)
 mkdir -p "$OUT"
 
 python scripts/drivers/run_physical.py \
-    --trace "$TRACE" --policy max_min_fairness \
+    --trace "$TRACE" --policy "$POLICY" \
     --throughputs "$ORACLE" \
     --expected_num_workers 1 --round_duration "$ROUND" --port "$PORT" \
     --timeout "$TIMEOUT" --timeline_dir "$OUT/timelines" \
@@ -51,7 +52,7 @@ wait "$SCHED_PID"
 kill "$WORKER_PID" 2>/dev/null || true
 
 python scripts/drivers/simulate.py \
-    --trace "$TRACE" --policy max_min_fairness \
+    --trace "$TRACE" --policy "$POLICY" \
     --throughputs "$ORACLE" \
     --cluster_spec "$WORKER_TYPE:1" --round_duration "$ROUND" \
     --output "$OUT/simulated_${WORKER_TYPE}.pkl"

@@ -1139,9 +1139,11 @@ class Scheduler:
                 # nonzero first arrival from inflating the measurement.
                 execution_time = finish_time - dispatch_time
                 # Reference-parity flat post-preemption charge — replaced
-                # wholesale by the measured charges in deployment-faithful
-                # mode.
-                if current_round >= 2 and not self._deployment_faithful:
+                # by the measured charges for calibrated worker types; an
+                # uncalibrated type in a partially calibrated oracle
+                # keeps the flat charge rather than costing nothing.
+                if current_round >= 2 and not self._worker_type_calibrated(
+                        self.workers.id_to_type[worker_ids[0]]):
                     prev_sched = self.rounds.per_round_schedule[current_round - 2]
                     for m in job_id.singletons():
                         if m.integer_job_id() not in prev_sched:
@@ -1219,7 +1221,8 @@ class Scheduler:
             for job_id, worker_ids in assignments.items():
                 worker_type = self.workers.id_to_type[worker_ids[0]]
                 overhead = drain = 0.0
-                if self._deployment_faithful and job_id not in warm_jobs:
+                if (job_id not in warm_jobs
+                        and self._worker_type_calibrated(worker_type)):
                     overhead = self._cold_dispatch_overhead(
                         worker_type, job_id) or 0.0
                     drain = self._cold_round_drain(worker_type, job_id)
@@ -1275,6 +1278,17 @@ class Scheduler:
         if typed is not None:
             return typed
         return (self._dispatch_overhead or {}).get(worker_type)
+
+    def _worker_type_calibrated(self, worker_type: str) -> bool:
+        """Whether any calibration entry covers this worker type — the
+        per-type switch between measured charges and the reference's
+        flat post-preemption charge (a partially calibrated oracle must
+        not zero out its uncalibrated types)."""
+        return (worker_type in (self._config.dispatch_overhead_s or {})
+                or worker_type in (self._dispatch_overhead or {})
+                or worker_type in self._dispatch_overhead_by_type
+                or worker_type in self._round_drain
+                or worker_type in self._round_drain_by_type)
 
     def _per_type_max(self, by_type: Dict[str, float], job_id: JobIdPair):
         """Largest per-job-type calibration value among the pair's

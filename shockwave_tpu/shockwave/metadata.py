@@ -208,6 +208,15 @@ class JobMetadata:
             runtime = 1.0
         else:
             durations = self.bs_epoch_duration_map()
+            # NOTE: for a single-epoch job at progress 0 the rebasing
+            # subtracts the whole (observed, in-progress) epoch and
+            # this legitimately evaluates to exactly 0 despite
+            # remaining > 0 — same algebra as the reference
+            # (JobMetaData.py:326-363). The planner's priority ratio
+            # guards the resulting zero fair-share averages
+            # (milp.py:_relaxation_priorities); flooring the estimate
+            # here instead would perturb the pinned canonical replay,
+            # which depends on exact-zero estimates for near-done jobs.
             runtime = (sum(rebased[bs] * durations[bs] for bs in rebased)
                        * remaining / inflated)
         self._posterior_cache[key] = runtime

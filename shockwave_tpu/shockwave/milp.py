@@ -259,10 +259,22 @@ def _relaxation_priorities(jobs, dirichlet, runavg, round_index,
         job.calibrate_profiled_epoch_duration()
         remaining = dirichlet[j]
         projected_finish = round_time + remaining / future_share
-        ratio = projected_finish / runavg[j]
+        # Guarded divide: a degenerate zero fair-share finish average
+        # (sub-epoch jobs, metadata.py) must not crash the solve. No
+        # cap: the pinned canonical replay ranks by astronomically
+        # large priorities for near-done jobs, and capping would
+        # reorder those ties.
+        ratio = projected_finish / max(runavg[j], 1e-6)
         if ratio > rhomax:
             power = PRIORITY_M if remaining < round_duration else lam
-            priorities.append(ratio ** power)
+            try:
+                priority = ratio ** power
+            except OverflowError:
+                # Degenerate runavg (sub-epoch jobs) can push the ratio
+                # past float range at power 100; a huge finite priority
+                # ranks identically without poisoning MILP coefficients.
+                priority = 1e300
+            priorities.append(priority)
         else:
             priorities.append(1.0)
     return priorities

@@ -168,6 +168,33 @@ class TestExtrapolateSf:
                 not in got["__meta__"]["estimated_rows"]["v5e"])
 
 
+class TestMeasureDeployedParser:
+    def test_parse_rounds_extracts_lease_records(self, tmp_path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "measure_deployed",
+            os.path.join(REPO, "scripts/profiling/measure_deployed.py"))
+        md = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(md)
+
+        log_dir = tmp_path / "job_id=0" / ".swtpu" / "round=1"
+        log_dir.mkdir(parents=True)
+        (log_dir / "worker=0.log").write_text(
+            "[2026-07-30 10:00:00] [PROGRESS] [STEPS] 0\n"
+            "[2026-07-30 10:00:00] [LOAD CHECKPOINT] [BEGIN] \n"
+            "[2026-07-30 10:00:01] [LOAD CHECKPOINT] [END] \n"
+            "[2026-07-30 10:02:00] [LEASE] [EXPIRED] 31 / 70 steps, "
+            "104.6354 / 104.6354 seconds\n"
+            "[2026-07-30 10:02:02] [SAVE CHECKPOINT] [BEGIN] \n"
+            "[2026-07-30 10:02:03] [SAVE CHECKPOINT] [END] \n")
+        recs = md.parse_rounds(str(tmp_path))
+        assert len(recs) == 1
+        rnd, load, exp, save_end, steps, dur = recs[0]
+        assert rnd == 1 and steps == 31
+        assert dur == pytest.approx(104.6354)
+        assert (save_end - load).total_seconds() == 122.0
+
+
 class TestBenchTpuFallback:
     def test_merges_newest_committed_artifact(self, tmp_path, monkeypatch):
         """With the chip unreachable, bench.py must report the newest

@@ -232,6 +232,31 @@ class TestCalibratedDispatchOverhead:
         # The operator's 15 s wins over both oracle values.
         assert makespan == pytest.approx(base + 15.0, abs=2.0)
 
+    def test_uncalibrated_type_keeps_flat_charge(self, tmp_path):
+        """A partially calibrated oracle (some other worker type) must
+        not zero out preemption costs for uncovered types: they keep
+        the reference's flat post-preemption charge."""
+        with open(os.path.join(DATA, "tacc_throughputs.json")) as f:
+            oracle = json.load(f)
+        oracle["__meta__"] = {"dispatch_overhead_s": {"v5e": 7.0}}
+        path = tmp_path / "oracle_partial.json"
+        path.write_text(json.dumps(oracle))
+        steps = int(self.RATE * 115)
+        policy = get_policy("max_min_fairness", seed=0)
+        sched = Scheduler(
+            policy, simulate=True, throughputs_file=str(path),
+            config=SchedulerConfig(time_per_iteration=120.0))
+        got = sched.simulate(
+            {"v100": 1}, [0.0, 0.0],
+            [make_job(total_steps=steps), make_job(total_steps=steps)])
+        # Two alternating jobs on the uncalibrated v100: identical to
+        # the fully uncalibrated run (flat charge applies), with the
+        # wall-clocked round floor being the only faithful-mode effect.
+        _, base = run_sim(
+            [make_job(total_steps=steps), make_job(total_steps=steps)],
+            [0.0, 0.0], num_workers=1)
+        assert got >= base * 0.98
+
     def test_meta_key_invisible_to_throughput_readers(self, tmp_path):
         from shockwave_tpu.core.oracle import (read_oracle_meta,
                                                read_throughputs)
@@ -421,6 +446,51 @@ class TestCostSLOTimelines:
         assert "SUBMITTED" in log
         assert "MICROTASK" in log
         assert "COMPLETED" in log
+
+
+class TestSubEpochJobs:
+    def test_priority_ratio_survives_zero_remaining_estimate(self):
+        """A single-epoch job's remaining estimate legitimately collapses
+        to exactly 0 (reference-parity Dirichlet algebra), so the
+        planner's priority ratio must guard the zero fair-share finish
+        average instead of dividing by it (hit by the 12-job fidelity
+        trace's 70-step jobs)."""
+        from shockwave_tpu.shockwave.metadata import JobMetadata
+        from shockwave_tpu.shockwave.milp import _relaxation_priorities
+        profile = {
+            "model": "ResNet-18", "dataset": "CIFAR-10", "num_epochs": 1,
+            "bs_every_epoch": [32], "duration_every_epoch": [424.0],
+            "mem_every_epoch": [1857], "util_every_epoch": [87.6],
+            "num_samples_per_epoch": 50000, "scale_factor": 1,
+            "duration": 424,
+        }
+        meta = JobMetadata(0, profile)
+        meta.register_submit(0.0)
+        assert meta.dirichlet_posterior_remaining_runtime(0) == 0.0
+        priorities = _relaxation_priorities(
+            [meta], dirichlet=[0.0], runavg=[0.0], round_index=0,
+            round_duration=120.0, future_share=0.5, rhomax=1.0, lam=5.0)
+        import math
+        assert len(priorities) == 1 and priorities[0] > 0
+        assert all(math.isfinite(p) for p in priorities)
+
+    def test_shockwave_simulates_sub_epoch_trace(self):
+        """End-to-end: the shockwave policy must plan a trace of
+        sub-epoch jobs without the relaxation-priority crash."""
+        from shockwave_tpu.core.oracle import read_throughputs
+        from shockwave_tpu.core.profiles import build_profiles
+        jobs = [make_job(total_steps=50, duration=424) for _ in range(3)]
+        tputs = read_throughputs(os.path.join(DATA, "tacc_throughputs.json"))
+        sched = Scheduler(
+            get_policy("shockwave", seed=0), simulate=True,
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            profiles=build_profiles(jobs, tputs),
+            config=SchedulerConfig(
+                time_per_iteration=120.0,
+                shockwave={"num_gpus": 1, "time_per_iteration": 120.0}))
+        makespan = sched.simulate({"v100": 1}, [0.0, 10.0, 20.0], jobs)
+        assert len(sched._completed_jobs) == 3
+        assert makespan > 0
 
 
 class TestJobMetadataCaches:
