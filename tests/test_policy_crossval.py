@@ -118,20 +118,13 @@ def achieved_min_ratio(alloc, job_ids, throughputs, sfs, norm):
         for j in job_ids)
 
 
-def independent_max_min_optimum(job_ids, throughputs, sfs, norm, cluster):
-    """From-scratch LP: maximize t s.t. per-job normalized effective
-    throughput >= t, per-job time <= 1, per-type capacity in
-    worker-seconds. Variables: x[j, w] row-major, then t."""
+def time_and_capacity_rows(job_ids, sfs, cluster, nv):
+    """Shared feasibility rows for every independent formulation:
+    per-job time <= 1 and per-type worker-seconds capacity, over
+    x[j, w] row-major in an nv-variable LP."""
     m, n = len(job_ids), len(WORKER_TYPES)
-    nv = m * n + 1
     A_ub, b_ub = [], []
-    for i, j in enumerate(job_ids):
-        row = np.zeros(nv)
-        for w, wt in enumerate(WORKER_TYPES):
-            row[i * n + w] = -throughputs[j][wt] * sfs[j] / norm[j]
-        row[-1] = 1.0
-        A_ub.append(row)
-        b_ub.append(0.0)
+    for i in range(m):
         row = np.zeros(nv)
         row[i * n:(i + 1) * n] = 1.0
         A_ub.append(row)
@@ -142,6 +135,23 @@ def independent_max_min_optimum(job_ids, throughputs, sfs, norm, cluster):
             row[i * n + w] = sfs[j]
         A_ub.append(row)
         b_ub.append(float(cluster[wt]))
+    return A_ub, b_ub
+
+
+def independent_max_min_optimum(job_ids, throughputs, sfs, norm, cluster):
+    """From-scratch LP: maximize t s.t. per-job normalized effective
+    throughput >= t, per-job time <= 1, per-type capacity in
+    worker-seconds. Variables: x[j, w] row-major, then t."""
+    m, n = len(job_ids), len(WORKER_TYPES)
+    nv = m * n + 1
+    A_ub, b_ub = time_and_capacity_rows(job_ids, sfs, cluster, nv)
+    for i, j in enumerate(job_ids):
+        row = np.zeros(nv)
+        for w, wt in enumerate(WORKER_TYPES):
+            row[i * n + w] = -throughputs[j][wt] * sfs[j] / norm[j]
+        row[-1] = 1.0
+        A_ub.append(row)
+        b_ub.append(0.0)
     c = np.zeros(nv)
     c[-1] = -1.0
     bounds = [(0.0, 1.0)] * (m * n) + [(None, None)]
@@ -227,6 +237,72 @@ class TestMaxMinOptimality:
         assert got == pytest.approx(want, rel=5e-3)
 
 
+class TestFinishTimeFairnessOptimality:
+    """Themis minimizes the max finish-time-fairness ratio rho; compare
+    the achieved rho against an independent scipy bisection over
+    feasibility LPs (formula shared, code not)."""
+
+    def _independent_iso_tput(self, job_ids, tputs, sfs, cluster):
+        # Reference-spec isolated share: c_w/m workers of each type,
+        # scaled by 1/sf, row capped at a full time share.
+        m = len(job_ids)
+        iso = {}
+        for j in job_ids:
+            x = {wt: cluster[wt] / m / sfs[j] for wt in WORKER_TYPES}
+            row = sum(x.values())
+            if row > 1.0:
+                x = {wt: v / row for wt, v in x.items()}
+            iso[j] = sum(tputs[j][wt] * x[wt] for wt in WORKER_TYPES)
+        return iso
+
+    def _independent_min_rho(self, job_ids, tputs, sfs, steps, iso_time,
+                             cluster):
+        m, n = len(job_ids), len(WORKER_TYPES)
+
+        def feasible(rho):
+            A_ub, b_ub = time_and_capacity_rows(job_ids, sfs, cluster, m * n)
+            for i, j in enumerate(job_ids):
+                row = np.zeros(m * n)
+                for w, wt in enumerate(WORKER_TYPES):
+                    row[i * n + w] = -tputs[j][wt]
+                A_ub.append(row)
+                b_ub.append(-steps[j] / (rho * iso_time[j]))
+            res = linprog(np.zeros(m * n), A_ub=np.array(A_ub),
+                          b_ub=np.array(b_ub),
+                          bounds=[(0.0, 1.0)] * (m * n), method="highs")
+            return res.status == 0
+
+        lo, hi = 1e-3, 10.0
+        while not feasible(hi) and hi < 1e7:
+            lo, hi = hi, hi * 10
+        while hi > lo * 1.01:
+            mid = (lo + hi) / 2
+            if feasible(mid):
+                hi = mid
+            else:
+                lo = mid
+        return hi
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_fresh_jobs_min_max_rho_matches(self, seed):
+        job_ids, tputs, sfs, prios, cluster = random_instance(seed)
+        steps = {j: 10000.0 for j in job_ids}
+        times = {j: 0.0 for j in job_ids}
+        alloc = get_policy("finish_time_fairness_perf").get_allocation(
+            tputs, sfs, prios, times, steps, cluster)
+        check_feasible(alloc, job_ids, sfs, cluster)
+        iso = self._independent_iso_tput(job_ids, tputs, sfs, cluster)
+        iso_time = {j: steps[j] / iso[j] for j in job_ids}
+        achieved = max(
+            steps[j] / max(sum(tputs[j][wt] * alloc[j].get(wt, 0.0)
+                               for wt in WORKER_TYPES), 1e-12) / iso_time[j]
+            for j in job_ids)
+        want = self._independent_min_rho(job_ids, tputs, sfs, steps,
+                                        iso_time, cluster)
+        # Both sides bisect to ~1%; allow the combined tolerance.
+        assert achieved == pytest.approx(want, rel=0.05)
+
+
 class TestMaxSumThroughputOptimality:
     @pytest.mark.parametrize("seed", range(5))
     def test_total_effective_throughput_is_optimal(self, seed):
@@ -238,20 +314,10 @@ class TestMaxSumThroughputOptimality:
         check_feasible(alloc, job_ids, sfs, cluster)
         m, n = len(job_ids), len(WORKER_TYPES)
         c = np.zeros(m * n)
-        A_ub, b_ub = [], []
         for i, j in enumerate(job_ids):
             for w, wt in enumerate(WORKER_TYPES):
                 c[i * n + w] = -tputs[j][wt]
-            row = np.zeros(m * n)
-            row[i * n:(i + 1) * n] = 1.0
-            A_ub.append(row)
-            b_ub.append(1.0)
-        for w, wt in enumerate(WORKER_TYPES):
-            row = np.zeros(m * n)
-            for i, j in enumerate(job_ids):
-                row[i * n + w] = sfs[j]
-            A_ub.append(row)
-            b_ub.append(float(cluster[wt]))
+        A_ub, b_ub = time_and_capacity_rows(job_ids, sfs, cluster, m * n)
         res = linprog(c, A_ub=np.array(A_ub), b_ub=np.array(b_ub),
                       bounds=[(0.0, 1.0)] * (m * n), method="highs")
         assert res.status == 0
