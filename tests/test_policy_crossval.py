@@ -303,6 +303,54 @@ class TestFinishTimeFairnessOptimality:
         assert achieved == pytest.approx(want, rel=0.05)
 
 
+class TestMinTotalDurationOptimality:
+    """OSSP minimizes the makespan horizon T via binary search on
+    feasibility LPs; compare the achieved horizon against an
+    independent scipy bisection."""
+
+    def _independent_min_T(self, job_ids, tputs, sfs, steps, cluster):
+        m, n = len(job_ids), len(WORKER_TYPES)
+
+        def feasible(T):
+            A_ub, b_ub = time_and_capacity_rows(job_ids, sfs, cluster, m * n)
+            for i, j in enumerate(job_ids):
+                row = np.zeros(m * n)
+                for w, wt in enumerate(WORKER_TYPES):
+                    row[i * n + w] = -tputs[j][wt]
+                A_ub.append(row)
+                b_ub.append(-steps[j] / T)
+            res = linprog(np.zeros(m * n), A_ub=np.array(A_ub),
+                          b_ub=np.array(b_ub),
+                          bounds=[(0.0, 1.0)] * (m * n), method="highs")
+            return res.status == 0
+
+        lo, hi = 1.0, 1e6
+        while not feasible(hi):
+            lo, hi = hi, hi * 10
+        while hi > lo * 1.01:
+            mid = (lo + hi) / 2
+            if feasible(mid):
+                hi = mid
+            else:
+                lo = mid
+        return hi
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_achieved_horizon_matches_independent(self, seed):
+        job_ids, tputs, sfs, prios, cluster = random_instance(seed)
+        steps = {j: 10000.0 for j in job_ids}
+        alloc = get_policy("min_total_duration_perf").get_allocation(
+            tputs, sfs, steps, cluster)
+        check_feasible(alloc, job_ids, sfs, cluster)
+        achieved = max(
+            steps[j] / max(sum(tputs[j][wt] * alloc[j].get(wt, 0.0)
+                               for wt in WORKER_TYPES), 1e-12)
+            for j in job_ids)
+        want = self._independent_min_T(job_ids, tputs, sfs, steps, cluster)
+        # The policy bisects to 5%, the independent side to 1%.
+        assert achieved == pytest.approx(want, rel=0.08)
+
+
 class TestMaxSumThroughputOptimality:
     @pytest.mark.parametrize("seed", range(5))
     def test_total_effective_throughput_is_optimal(self, seed):
