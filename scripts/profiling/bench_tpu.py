@@ -178,7 +178,11 @@ def main():
         print(json.dumps({"skip": f"backend={jax.default_backend()}"}))
         sys.exit(75)
 
-    result = {"peak_bf16_flops": peak_flops(jax.devices()[0])}
+    # device kind lives in the base dict so the printed JSON is
+    # self-describing even with --save_dir '' (persisting disabled);
+    # save_measurement tolerates the explicit field.
+    result = {"device": jax.devices()[0].device_kind,
+              "peak_bf16_flops": peak_flops(jax.devices()[0])}
     result.update(transformer_train_bench(batch=args.batch, steps=args.steps))
     if args.long_seq:
         # Compute-bound configuration: long-sequence flash regime, where
@@ -193,7 +197,8 @@ def main():
         # the reference's oracle JSONs): hardware claims stay checkable
         # even when the chip is later unreachable.
         from shockwave_tpu.core.artifacts import save_measurement
-        path, result = save_measurement(args.save_dir, "bench", result)
+        path, result = save_measurement(args.save_dir, "bench", result,
+                                        device_kind=result["device"])
         print(f"saved {path}", file=sys.stderr)
     print(json.dumps(result))
 

@@ -24,7 +24,10 @@ reads the per-round iterator logs to measure:
     __meta__.round_drain_s[worker_type];
   - lease shortfall: round minus mean in-lease duration — the unhidden
     startup that shrinks the step window, written to
-    __meta__.dispatch_overhead_s_by_type (and the scalar mean).
+    __meta__.lease_shortfall_s_by_type (and the scalar mean under
+    __meta__.lease_shortfall_s; the dispatch_overhead_s* keys belong to
+    measure_startup.py's spawn->exit proxy, which has different
+    semantics).
 
 The simulator consumes all three (sched/scheduler.py calibrated model).
 Calibration runs use dedicated 2-job traces, so validating a different
@@ -176,23 +179,33 @@ def main():
             raise SystemExit(f"{family}: no usable leases measured")
         tput = sum(s for s, _ in leases) / sum(d for _, d in leases)
         lease_durs = [d for _, d in leases]
-        gaps = []
+        # Gap and lease duration are paired PER ROUND RECORD: a round
+        # with a missing/unparsed lease line (e.g. process killed
+        # mid-round) is dropped whole, so one bad round can't shift
+        # every subsequent gap onto the wrong round's lease duration.
+        cycles = []
         prev_exit = None
         for rnd, load, exp, save_end, s, d in recs:
             end = save_end or exp
-            if prev_exit is not None and load is not None and rnd > 0:
-                gaps.append((load - prev_exit).total_seconds())
+            if (prev_exit is not None and load is not None and rnd > 0
+                    and s and d):
+                cycles.append(((load - prev_exit).total_seconds(), d))
             if end is not None:
                 prev_exit = end
         # Cycle excess over the round: everything outside the lease.
         cycle_excess = [
             g + (args.round_duration - min(d, args.round_duration))
-            for g, d in zip(gaps, lease_durs)]
+            for g, d in cycles]
         drain = statistics.mean(cycle_excess) if cycle_excess else 0.0
         shortfall = max(
             args.round_duration - statistics.mean(lease_durs), 0.0)
         rows[f"('{family}', 1)"] = {"null": round(tput, 4)}
-        meta.setdefault("dispatch_overhead_s_by_type", {}).setdefault(
+        # lease_shortfall_s* keys are OWNED by this script (in-lease
+        # shortfall via the real runtime); the spawn->exit proxy keys
+        # (dispatch_overhead_s*) are owned by measure_startup.py. The
+        # simulator prefers the shortfall when both are present
+        # (sched/scheduler.py:_cold_dispatch_overhead).
+        meta.setdefault("lease_shortfall_s_by_type", {}).setdefault(
             args.worker_type, {})[family] = round(shortfall, 2)
         meta.setdefault("round_drain_s_by_type", {}).setdefault(
             args.worker_type, {})[family] = round(drain, 2)
@@ -209,7 +222,7 @@ def main():
               f"(solo {solo}), lease shortfall {shortfall:.1f}s, "
               f"cycle excess {drain:.1f}s")
 
-    meta.setdefault("dispatch_overhead_s", {})[args.worker_type] = round(
+    meta.setdefault("lease_shortfall_s", {})[args.worker_type] = round(
         statistics.mean(shortfalls), 2)
     meta.setdefault("round_drain_s", {})[args.worker_type] = round(
         statistics.mean(drains), 2)

@@ -117,12 +117,12 @@ def main():
     with open(args.oracle) as f:
         oracle = json.load(f)
     meta = oracle.setdefault("__meta__", {})
-    # Scalar only (mean over the families measured in THIS run): the
-    # per-job-type key (dispatch_overhead_s_by_type) is owned by
-    # measure_deployed.py, whose values have different semantics
-    # (in-lease shortfall via the real runtime, not spawn->exit) —
-    # mixing the two definitions in one key would mis-calibrate the
-    # simulator's step budget.
+    # This script is the sole owner of the dispatch_overhead_s* keys
+    # (solo spawn->exit proxy). measure_deployed.py writes its in-lease
+    # shortfall — a different quantity — under lease_shortfall_s*,
+    # which the simulator prefers when both are present; keeping the
+    # keys disjoint means neither run can clobber the other's scalar
+    # with mismatched semantics.
     meta.setdefault("dispatch_overhead_s", {})[args.worker_type] = overhead
     meta.setdefault("dispatch_overhead_detail", {})[args.worker_type] = {
         "measured_at": datetime.datetime.now(

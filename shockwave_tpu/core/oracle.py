@@ -19,10 +19,14 @@ measurement metadata alongside the numbers it calibrates, e.g.
 worker type: the full spawn -> exit wall time of a 1-step run
 (interpreter + jax import, data load, checkpoint restore, first-step
 compile, and the exit-path checkpoint save) as measured by
-scripts/profiling/measure_startup.py; the simulator's calibrated
-overhead model consumes it (sched/scheduler.py). `read_throughputs`
-skips the entry so every existing consumer sees the plain oracle
-mapping.
+scripts/profiling/measure_startup.py. `lease_shortfall_s` (+
+`lease_shortfall_s_by_type`) is the deployed-conditions in-lease
+shortfall measured through the real runtime by
+scripts/profiling/measure_deployed.py — a different quantity under a
+deliberately different key, preferred by the simulator's calibrated
+overhead model when both are present (sched/scheduler.py
+`_cold_dispatch_overhead`). `read_throughputs` skips the entry so
+every existing consumer sees the plain oracle mapping.
 """
 from __future__ import annotations
 

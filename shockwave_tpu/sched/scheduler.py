@@ -133,6 +133,16 @@ class Scheduler:
         # to the per-worker-type scalar.
         self._dispatch_overhead_by_type = oracle_meta.get(
             "dispatch_overhead_s_by_type", {})
+        # Deployed-conditions in-lease shortfall (round minus mean
+        # in-lease duration), measured through the real runtime by
+        # scripts/profiling/measure_deployed.py. Distinct key from the
+        # solo spawn->exit proxy above so the two calibration methods
+        # can't clobber each other's scalars (they have different
+        # semantics); the deployed measurement is the more faithful
+        # step-budget charge, so it takes precedence when present.
+        self._lease_shortfall = oracle_meta.get("lease_shortfall_s", {})
+        self._shortfall_by_type = oracle_meta.get(
+            "lease_shortfall_s_by_type", {})
         # Measured per-cycle dead time OUTSIDE the lease (exit +
         # progress scrape + done RPC + round rollover + unhidden next
         # startup): physically every preemption cycle runs
@@ -155,6 +165,7 @@ class Scheduler:
         # parity.
         self._deployment_faithful = bool(
             self._dispatch_overhead or self._dispatch_overhead_by_type
+            or self._lease_shortfall or self._shortfall_by_type
             or self._round_drain or self._round_drain_by_type)
         self._sim_round_start: Optional[float] = None
         self._throughput_timeline: Dict[int, "collections.OrderedDict"] = {}
@@ -1267,12 +1278,27 @@ class Scheduler:
     def _cold_dispatch_overhead(self, worker_type: str, job_id: JobIdPair):
         """Measured cold-dispatch charge for this job on this worker
         type under the calibrated model, or None when not calibrated.
-        Explicit config beats everything (an operator override must not
-        be shadowed by stale oracle metadata); otherwise per-job-type
-        measurements win over the per-worker-type scalar; pairs charge
-        the slower-starting member."""
-        if self._config.dispatch_overhead_s is not None:
-            return self._config.dispatch_overhead_s.get(worker_type)
+        Precedence: an explicit config entry for THIS worker type beats
+        everything (an operator override must not be shadowed by stale
+        oracle metadata), but types the config dict does not cover fall
+        through to the oracle; within the oracle, the deployed in-lease
+        shortfall (by-type, then scalar) beats the solo spawn->exit
+        proxy (by-type, then scalar) — the shortfall was measured
+        through the real runtime, so it is the more faithful
+        step-budget charge. Pairs charge the slower-starting member."""
+        explicit = self._config.dispatch_overhead_s or {}
+        if worker_type in explicit:
+            return explicit[worker_type]
+        # A worker type the explicit dict does NOT cover falls through
+        # to the oracle values — otherwise a type calibrated only via
+        # oracle metadata would silently pay no startup cost while
+        # _worker_type_calibrated still disabled the flat charge.
+        typed = self._per_type_max(
+            self._shortfall_by_type.get(worker_type, {}), job_id)
+        if typed is not None:
+            return typed
+        if worker_type in self._lease_shortfall:
+            return self._lease_shortfall[worker_type]
         typed = self._per_type_max(
             self._dispatch_overhead_by_type.get(worker_type, {}), job_id)
         if typed is not None:
@@ -1287,6 +1313,8 @@ class Scheduler:
         return (worker_type in (self._config.dispatch_overhead_s or {})
                 or worker_type in (self._dispatch_overhead or {})
                 or worker_type in self._dispatch_overhead_by_type
+                or worker_type in self._lease_shortfall
+                or worker_type in self._shortfall_by_type
                 or worker_type in self._round_drain
                 or worker_type in self._round_drain_by_type)
 

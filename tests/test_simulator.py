@@ -232,6 +232,56 @@ class TestCalibratedDispatchOverhead:
         # The operator's 15 s wins over both oracle values.
         assert makespan == pytest.approx(base + 15.0, abs=2.0)
 
+    def test_lease_shortfall_preferred_over_startup_proxy(self, tmp_path):
+        """When both calibration methods wrote the oracle, the deployed
+        in-lease shortfall (lease_shortfall_s, measure_deployed.py) must
+        win over the solo spawn->exit proxy (dispatch_overhead_s,
+        measure_startup.py)."""
+        with open(os.path.join(DATA, "tacc_throughputs.json")) as f:
+            oracle = json.load(f)
+        oracle["__meta__"] = {
+            "dispatch_overhead_s": {"v100": 30.0},
+            "lease_shortfall_s": {"v100": 5.0}}
+        path = tmp_path / "oracle_shortfall.json"
+        path.write_text(json.dumps(oracle))
+        steps = int(self.RATE * 300)
+        policy = get_policy("max_min_fairness", seed=0)
+        sched = Scheduler(
+            policy, simulate=True, throughputs_file=str(path),
+            config=SchedulerConfig(time_per_iteration=120.0))
+        makespan = sched.simulate(
+            {"v100": 1}, [0.0], [make_job(total_steps=steps)])
+        _, base = run_sim([make_job(total_steps=steps)], [0.0],
+                          num_workers=1)
+        assert makespan == pytest.approx(base + 5.0, abs=2.0)
+
+    def test_explicit_config_falls_through_for_uncovered_type(
+            self, tmp_path):
+        """An explicit config dict covering only OTHER worker types must
+        not zero out a type the oracle calibrated: the uncovered type
+        falls through to the oracle values instead of paying nothing."""
+        with open(os.path.join(DATA, "tacc_throughputs.json")) as f:
+            oracle = json.load(f)
+        oracle["__meta__"] = {
+            "dispatch_overhead_s_by_type": {
+                "v100": {"ResNet-18 (batch size 32)": 40.0}}}
+        path = tmp_path / "oracle_other_type.json"
+        path.write_text(json.dumps(oracle))
+        steps = int(self.RATE * 300)
+        policy = get_policy("max_min_fairness", seed=0)
+        sched = Scheduler(
+            policy, simulate=True, throughputs_file=str(path),
+            config=SchedulerConfig(
+                time_per_iteration=120.0,
+                dispatch_overhead_s={"v5e": 15.0}))
+        makespan = sched.simulate(
+            {"v100": 1}, [0.0], [make_job(total_steps=steps)])
+        _, base = run_sim([make_job(total_steps=steps)], [0.0],
+                          num_workers=1)
+        # v100 is absent from the explicit dict -> the oracle's 40 s
+        # per-type charge applies, not 0.
+        assert makespan == pytest.approx(base + 40.0, abs=2.0)
+
     def test_uncalibrated_type_keeps_flat_charge(self, tmp_path):
         """A partially calibrated oracle (some other worker type) must
         not zero out preemption costs for uncovered types: they keep
