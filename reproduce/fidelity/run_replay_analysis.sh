@@ -1,0 +1,70 @@
+#!/bin/bash
+# Schedule-replay decomposition of a committed fidelity artifact
+# (VERDICT r4 #2): separates the simulator's pure timing-model error
+# from scheduling-decision divergence.
+#
+#   leg 1  replay          physical schedule + oracle rates
+#          -> physical-vs-replay delta = timing model only
+#   leg 2  replay+measured physical schedule + this run's measured rates
+#          -> residual when the rate model is removed too
+#   leg 3  free+measured   live policy + measured rates
+#          -> does feeding the planner the physically-experienced rates
+#             close the free-run gap? (it does not: divergence is
+#             intrinsic to the planner's feedback loop, not rate input)
+#
+# Usage: reproduce/fidelity/run_replay_analysis.sh ARTIFACT_DIR POLICY
+# e.g.   reproduce/fidelity/run_replay_analysis.sh \
+#            reproduce/fidelity/cpu_loopback_12job_shockwave shockwave
+set -eu -o pipefail
+cd "$(dirname "$0")/../.."
+DIR=${1:?artifact dir}
+POLICY=${2:?policy}
+TRACE=${TRACE:-reproduce/fidelity/fidelity_cpu_12job.trace}
+ORACLE=${ORACLE:-reproduce/fidelity/cpu_throughputs.json}
+ROUND=${ROUND:-120}
+OUT="$DIR/replay"
+mkdir -p "$OUT"
+
+run_sim() {  # extra-args... output
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      python scripts/drivers/simulate.py \
+        --trace "$TRACE" --policy "$POLICY" --throughputs "$ORACLE" \
+        --cluster_spec cpu:1 --round_duration "$ROUND" "$@"
+}
+
+run_sim --replay_schedule "$DIR/physical_cpu.pkl" \
+        --output "$OUT/replay_oracle_rates.pkl"
+python reproduce/analyze_fidelity.py "$DIR/physical_cpu.pkl" \
+    "$OUT/replay_oracle_rates.pkl" --tolerance 0.1 \
+    | tee "$OUT/replay_report.txt" || true
+
+run_sim --replay_schedule "$DIR/physical_cpu.pkl" \
+        --measured_rates "$DIR/physical_cpu.pkl" \
+        --output "$OUT/replay_measured_rates.pkl"
+python reproduce/analyze_fidelity.py "$DIR/physical_cpu.pkl" \
+    "$OUT/replay_measured_rates.pkl" --tolerance 0.1 \
+    | tee "$OUT/replay_measured_report.txt" || true
+
+run_sim --measured_rates "$DIR/physical_cpu.pkl" \
+        --output "$OUT/free_measured_rates.pkl"
+python reproduce/analyze_fidelity.py "$DIR/physical_cpu.pkl" \
+    "$OUT/free_measured_rates.pkl" --tolerance 0.1 \
+    | tee "$OUT/free_measured_report.txt" || true
+
+# Per-job completion deltas for each leg (the quantification the
+# aggregate deltas hide).
+python - "$DIR" "$OUT" <<'EOF' | tee "$OUT/per_job_deltas.txt"
+import pickle, statistics, sys
+d, out = sys.argv[1], sys.argv[2]
+phys = pickle.load(open(f"{d}/physical_cpu.pkl", "rb"))
+legs = [("free", f"{d}/simulated_cpu.pkl"),
+        ("replay", f"{out}/replay_oracle_rates.pkl"),
+        ("replay+measured", f"{out}/replay_measured_rates.pkl"),
+        ("free+measured", f"{out}/free_measured_rates.pkl")]
+for name, path in legs:
+    s = pickle.load(open(path, "rb"))
+    deltas = [sj - pj for sj, pj in zip(s["jct_list"], phys["jct_list"])]
+    med = statistics.median(abs(x) for x in deltas)
+    print(f"{name:16s} median|dJCT|={med:7.1f}s "
+          f"per-job={[round(x) for x in deltas]}")
+EOF

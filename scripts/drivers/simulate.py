@@ -42,6 +42,16 @@ def main():
     p.add_argument("--config", default=None,
                    help="JSON file of shockwave hyperparameters")
     p.add_argument("--output", default=None, help="metrics pickle path")
+    p.add_argument("--replay_schedule", default=None, metavar="PHYSICAL_PKL",
+                   help="fidelity analysis: execute this physical metric "
+                        "pickle's per_round_schedule verbatim instead of "
+                        "the live policy (physical-vs-replay deltas "
+                        "isolate the timing model from decision "
+                        "divergence)")
+    p.add_argument("--measured_rates", default=None, metavar="PHYSICAL_PKL",
+                   help="fidelity analysis: override each job's oracle "
+                        "rate with its mean measured throughput from this "
+                        "physical pickle's throughput_timeline")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args()
 
@@ -64,15 +74,34 @@ def main():
         shockwave_config["num_gpus"] = sum(cluster_spec.values())
         shockwave_config["time_per_iteration"] = args.round_duration
 
+    forced_schedule = None
+    if args.replay_schedule:
+        with open(args.replay_schedule, "rb") as f:
+            forced_schedule = pickle.load(f)["per_round_schedule"]
+
+    rate_override = None
+    if args.measured_rates:
+        with open(args.measured_rates, "rb") as f:
+            timeline = pickle.load(f)["throughput_timeline"]
+        # Mean of the per-round measured rates, skipping empty rounds
+        # (a killed micro-task records 0.0).
+        rate_override = {}
+        for int_id, rounds in timeline.items():
+            rates = [r for r, _ in rounds.values() if r > 0]
+            if rates:
+                rate_override[int_id] = sum(rates) / len(rates)
+
     policy = get_policy(args.policy, seed=args.seed)
     sched = Scheduler(
         policy, simulate=True, throughputs_file=args.throughputs,
         profiles=profiles,
         config=SchedulerConfig(
             time_per_iteration=args.round_duration, seed=args.seed,
-            max_rounds=args.max_rounds, shockwave=shockwave_config))
+            max_rounds=args.max_rounds, shockwave=shockwave_config,
+            rate_override=rate_override))
 
-    makespan = sched.simulate(cluster_spec, arrival_times, jobs)
+    makespan = sched.simulate(cluster_spec, arrival_times, jobs,
+                              forced_schedule=forced_schedule)
 
     jct = sched.get_average_jct()
     ftf_static, ftf_themis = sched.get_finish_time_fairness()

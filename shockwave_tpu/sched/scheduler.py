@@ -84,6 +84,13 @@ class SchedulerConfig:
     # Physical-mode deadlock watchdog: dump all thread tracebacks every
     # N seconds (reference: faulthandler at scheduler.py:451-455).
     watchdog_interval: Optional[float] = None
+    # Fidelity-analysis hook: per-job measured throughput overrides
+    # ({integer_job_id: steps_per_s}) replacing the oracle rate for
+    # those jobs on every worker type. Used by the schedule-replay
+    # methodology (reproduce/fidelity/) to feed the simulator the rates
+    # a physical run actually experienced, isolating rate-model error
+    # from decision divergence. None = oracle rates (default).
+    rate_override: Optional[Dict[int, float]] = None
 
 
 class Scheduler:
@@ -239,6 +246,14 @@ class Scheduler:
         self._throughputs[job_id] = {}
         for wt in self.workers.worker_types:
             self._set_initial_throughput(job_id, wt)
+        override = (self._config.rate_override or {}).get(
+            job_id.integer_job_id())
+        if override is not None:
+            # Fidelity-analysis hook (see SchedulerConfig.rate_override):
+            # both the timing model and the planner/policy read
+            # _throughputs, so the measured rate drives everything.
+            for wt in self.workers.worker_types:
+                self._throughputs[job_id][wt] = override
         if self._job_packing:
             self._populate_pair_throughputs(job_id)
 
@@ -739,6 +754,13 @@ class Scheduler:
         int_assignments = {
             job_id.integer_job_id(): ids for job_id, ids in assignments.items()
             if not job_id.is_pair()}
+        self._record_round(int_assignments)
+        return assignments
+
+    def _record_round(self, int_assignments: Dict[int, Sequence[int]]):
+        """Per-round bookkeeping shared by the live scheduler and the
+        replay path — keeping it in one place keeps the replay leg's
+        metrics structurally identical to the free run's."""
         self.rounds.per_round_schedule.append(int_assignments)
         self.rounds.jobs_in_round.append(len(self.acct.jobs))
         for job_id in self.acct.jobs:
@@ -747,6 +769,51 @@ class Scheduler:
                 self.rounds.num_scheduled_rounds[int_id] += 1
             else:
                 self.rounds.num_queued_rounds[int_id] += 1
+
+    def _replay_assignments(
+            self, recorded: Dict[int, Sequence[int]]
+    ) -> "collections.OrderedDict":
+        """Schedule-replay: execute one recorded physical round verbatim
+        (see simulate()'s forced_schedule). Entries whose job already
+        completed in the replay are dropped (logged, as is the
+        shouldn't-happen not-yet-arrived case — a lost lease would
+        contaminate the timing-model attribution); recorded chip ids
+        map identically onto this cluster, so the replay must be
+        constructed with the physical run's cluster_spec. Packed pairs
+        are not replayable (physical mode never packs — no MPS analog
+        on TPU)."""
+        assignments: "collections.OrderedDict[JobIdPair, Tuple[int, ...]]" = (
+            collections.OrderedDict())
+        seen_chips: Set[int] = set()
+        for int_id in sorted(recorded):
+            job_id = JobIdPair(int_id)
+            if job_id not in self.acct.jobs:
+                if job_id in self._completed_jobs:
+                    self.log.info(
+                        "replay: job %s already completed; dropping its "
+                        "recorded lease", int_id)
+                else:
+                    self.log.warning(
+                        "replay: job %s NOT YET ARRIVED at its recorded "
+                        "round — lost lease will inflate its completion "
+                        "delta", int_id)
+                continue
+            ids = tuple(recorded[int_id])
+            for w in ids:
+                if w not in self.workers.id_to_type:
+                    raise RuntimeError(
+                        f"recorded worker {w} absent from replay cluster "
+                        f"(cluster_spec mismatch with the physical run)")
+                if w in seen_chips:
+                    raise RuntimeError(
+                        f"recorded round assigns worker {w} twice "
+                        f"(corrupt per_round_schedule)")
+                seen_chips.add(w)
+            assignments[job_id] = ids
+            self.acct.latest_timestamps[job_id] = self.get_current_timestamp()
+            self._running_jobs.add(job_id)
+        self._record_round({j.integer_job_id(): ids
+                            for j, ids in assignments.items()})
         return assignments
 
     # ------------------------------------------------------------------
@@ -1063,7 +1130,9 @@ class Scheduler:
                  num_chips_per_server: Optional[Dict[str, int]] = None,
                  checkpoint_file: Optional[str] = None,
                  checkpoint_threshold: Optional[float] = None,
-                 resume_from: Optional[str] = None) -> float:
+                 resume_from: Optional[str] = None,
+                 forced_schedule: Optional[Sequence[Dict[int, Sequence[int]]]]
+                 = None) -> float:
         """Discrete-event simulation of a trace. Returns the makespan.
 
         With `checkpoint_file` + `checkpoint_threshold` in (0, 1), the full
@@ -1071,6 +1140,17 @@ class Scheduler:
         completed (a threshold of 1.0 never fires: the loop exits when the
         last job completes). With `resume_from`, the trace arguments are
         ignored and simulation continues from the pickled state.
+
+        With `forced_schedule` (one {integer_job_id: worker_ids} dict per
+        round, i.e. a physical metric pickle's per_round_schedule), the
+        live policy is bypassed and the recorded schedule is executed
+        verbatim — the schedule-replay leg of the fidelity methodology:
+        physical-vs-replay deltas isolate the simulator's pure timing
+        model (rates, cold charges, drains) from scheduling-decision
+        divergence (reference analog: reproduce/analyze_fidelity.py
+        compares free-running runs only). Rounds past the end of the
+        recording fall back to the live policy so a slower replay can
+        finish its stragglers.
         """
         if resume_from is not None:
             queued, running, remaining_jobs, current_round = (
@@ -1121,7 +1201,10 @@ class Scheduler:
                 self._current_timestamp = max_ts
                 forced_resolve = False
             elif next_arrival is not None:
-                self._current_timestamp = next_arrival
+                # max(): a burned replay round may already have pushed
+                # the clock past this arrival — never rewind it.
+                self._current_timestamp = max(self._current_timestamp,
+                                              next_arrival)
                 forced_resolve = False
             elif self.acct.jobs and not forced_resolve:
                 # Dead air: jobs are waiting but the allocation-reset
@@ -1214,7 +1297,27 @@ class Scheduler:
                 continue
 
             # Schedule the next round.
-            assignments = self._schedule_jobs_on_workers()
+            if (forced_schedule is not None
+                    and current_round < len(forced_schedule)):
+                assignments = self._replay_assignments(
+                    forced_schedule[current_round])
+                if not assignments:
+                    # The recorded round ran only jobs this replay has
+                    # already finished (clock skew between the two
+                    # runs): burn the round so later recorded rounds
+                    # keep their physical indices.
+                    self.rounds.current_assignments = assignments
+                    self._current_timestamp += self._time_per_iteration
+                    self._sim_round_start = self._current_timestamp
+                    current_round += 1
+                    self.rounds.num_completed_rounds += 1
+                    if (self._config.max_rounds is not None
+                            and self.rounds.num_completed_rounds
+                            >= self._config.max_rounds):
+                        break
+                    continue
+            else:
+                assignments = self._schedule_jobs_on_workers()
             for job_id in self.rounds.current_assignments:
                 if any(m in self.acct.jobs for m in job_id.singletons()):
                     self.rounds.num_lease_opportunities += 1

@@ -320,6 +320,77 @@ class TestCalibratedDispatchOverhead:
             "dispatch_overhead_s": {"cpu": 9.5}}
 
 
+class TestScheduleReplay:
+    """Schedule-replay mode (fidelity methodology): forced_schedule
+    executes a recorded per-round schedule verbatim."""
+
+    RATE = 42.97497938
+
+    def _free_run(self, jobs, arrivals, **cfg):
+        return run_sim(jobs, arrivals, num_workers=1, **cfg)
+
+    def _replay(self, jobs, arrivals, forced, **cfg):
+        policy = get_policy("max_min_fairness", seed=0)
+        sched = Scheduler(
+            policy, simulate=True,
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=120.0, **cfg))
+        makespan = sched.simulate({"v100": 1}, arrivals, jobs,
+                                  forced_schedule=forced)
+        return sched, makespan
+
+    def test_self_replay_is_bit_identical(self):
+        """Replaying a simulation's own recorded schedule must
+        reproduce its metrics exactly (the idempotence property the
+        decomposition methodology rests on)."""
+        jobs = lambda: [make_job(total_steps=int(self.RATE * 115))
+                        for _ in range(3)]
+        free, free_span = self._free_run(jobs(), [0.0, 0.0, 0.0])
+        recorded = [{j: tuple(ids) for j, ids in rnd.items()}
+                    for rnd in free.rounds.per_round_schedule]
+        replay, replay_span = self._replay(jobs(), [0.0, 0.0, 0.0], recorded)
+        assert replay_span == free_span
+        assert (replay.get_average_jct()[3] == free.get_average_jct()[3])
+
+    def test_replay_falls_back_to_policy_after_recording(self):
+        """A recording shorter than the replay needs must not starve
+        the leftover jobs: rounds past the recording use the live
+        policy."""
+        # Recording covers only round 0 for job 0; job 1 needs the
+        # fallback to ever run.
+        steps = int(self.RATE * 115)
+        jobs = [make_job(total_steps=steps), make_job(total_steps=steps)]
+        sched, makespan = self._replay(jobs, [0.0, 0.0], [{0: (0,)}])
+        assert len(sched._completed_jobs) == 2
+        assert makespan > 0
+
+    def test_replay_skips_completed_jobs_and_burns_empty_rounds(self):
+        """Recorded rounds whose jobs already finished in the replay
+        are burned (clock advances a full round) so later recorded
+        rounds keep their physical indices."""
+        steps = int(self.RATE * 60)  # finishes inside round 0
+        jobs = [make_job(total_steps=steps),
+                make_job(total_steps=int(self.RATE * 115))]
+        # Recording: job 0 twice (second occurrence is already done in
+        # the replay), then job 1.
+        sched, makespan = self._replay(
+            jobs, [0.0, 0.0], [{0: (0,)}, {0: (0,)}, {1: (0,)}])
+        assert len(sched._completed_jobs) == 2
+        # Job 1 ran in recorded round 2, i.e. after the burned round.
+        assert sched.rounds.per_round_schedule[1] == {}
+        assert 1 in sched.rounds.per_round_schedule[2]
+
+    def test_rate_override_replaces_oracle_rate(self):
+        """rate_override drives both the timing model and completion:
+        halving the rate doubles the single-job makespan."""
+        steps = int(self.RATE * 115)
+        _, base = self._free_run([make_job(total_steps=steps)], [0.0])
+        _, slow = self._free_run(
+            [make_job(total_steps=steps)], [0.0],
+            rate_override={0: self.RATE / 2})
+        assert slow == pytest.approx(2 * base, rel=0.02)
+
+
 class TestContention:
     def test_two_jobs_one_worker_share(self):
         jobs = [make_job(total_steps=20000), make_job(total_steps=20000)]
