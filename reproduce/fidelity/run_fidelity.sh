@@ -29,6 +29,8 @@ ORACLE=${ORACLE:-data/v5e_throughputs.json}
 TOL=${TOL:-0.15}
 POLICY=${POLICY:-max_min_fairness}
 TIMEOUT=${TIMEOUT:-3600}
+# Chips on the (single) worker daemon; >1 enables gang (sf>1) traces.
+NUM_CHIPS=${NUM_CHIPS:-1}
 CKPT=$(mktemp -d /tmp/swtpu_fidelity.XXXX)
 mkdir -p "$OUT"
 
@@ -45,7 +47,8 @@ trap '[ -n "$WORKER_PID" ] && kill "$WORKER_PID" 2>/dev/null || true' EXIT
 sleep 5
 python -m shockwave_tpu.runtime.worker --worker_type "$WORKER_TYPE" \
     --sched_addr 127.0.0.1 --sched_port "$PORT" --worker_port "$((PORT+1))" \
-    --num_chips 1 --data_dir /tmp/swtpu_data --checkpoint_dir "$CKPT" &
+    --num_chips "$NUM_CHIPS" --data_dir /tmp/swtpu_data \
+    --checkpoint_dir "$CKPT" &
 WORKER_PID=$!
 
 wait "$SCHED_PID"
@@ -54,7 +57,8 @@ kill "$WORKER_PID" 2>/dev/null || true
 python scripts/drivers/simulate.py \
     --trace "$TRACE" --policy "$POLICY" \
     --throughputs "$ORACLE" \
-    --cluster_spec "$WORKER_TYPE:1" --round_duration "$ROUND" \
+    --cluster_spec "$WORKER_TYPE:$NUM_CHIPS" \
+    --chips_per_server "$NUM_CHIPS" --round_duration "$ROUND" \
     --output "$OUT/simulated_${WORKER_TYPE}.pkl"
 
 python reproduce/analyze_fidelity.py \

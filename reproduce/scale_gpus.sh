@@ -2,7 +2,10 @@
 # Scale microbenchmark: generated workloads on 64/128/256-chip simulated
 # clusters (reference: reproduce/scale_{64,128,256}gpus.sh; paper Fig 9).
 # Usage: reproduce/scale_gpus.sh <num_chips> [output_dir]
-set -u
+# -e -o pipefail: a failed simulate must abort the script, or the
+# solve-quality gate below would happily validate a stale pickle from
+# an earlier run and exit 0.
+set -eu -o pipefail
 cd "$(dirname "$0")/.."
 CHIPS=${1:?usage: scale_gpus.sh <num_chips> [output_dir]}
 OUT=${2:-reproduce/pickles/scale_${CHIPS}}
@@ -23,3 +26,19 @@ do
         --output "$OUT/${POLICY}.pkl" \
         | tee "$OUT/${POLICY}.json"
 done
+
+# Solve-quality gate: at scale the MILP must be producing real
+# schedules, not silently degrading to the greedy fallback (the
+# reference bounds its solver but never verifies what it achieved).
+python3 - "$OUT/shockwave.pkl" <<'EOF'
+import pickle, sys
+stats = pickle.load(open(sys.argv[1], "rb")).get("milp_solve_stats", [])
+assert stats, "no MILP solve telemetry in scale pickle"
+paths = [s["path"] for s in stats]
+rate = paths.count("greedy") / len(paths)
+hist = {p: paths.count(p) for p in sorted(set(paths))}
+gaps = [s["mip_gap"] for s in stats if s["mip_gap"] is not None]
+print(f"MILP solves={len(paths)} paths={hist} greedy_rate={rate:.1%}"
+      + (f" max_gap={max(gaps):.2e}" if gaps else ""))
+assert rate < 0.05, f"greedy fallback rate {rate:.1%} >= 5%"
+EOF

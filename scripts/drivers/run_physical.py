@@ -145,6 +145,7 @@ def main():
         "per_round_schedule": sched.rounds.per_round_schedule,
         "time_per_iteration": args.round_duration,
         "throughput_timeline": sched.get_throughput_timeline(),
+        "milp_solve_stats": sched.get_solve_stats(),
     }
     if args.output:
         with open(args.output, "wb") as f:

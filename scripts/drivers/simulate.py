@@ -37,6 +37,10 @@ def main():
     p.add_argument("--cluster_spec", default="v100:32",
                    help="worker_type:count[,worker_type:count...]")
     p.add_argument("--round_duration", type=float, default=360.0)
+    p.add_argument("--chips_per_server", type=int, default=1,
+                   help="chips per simulated worker daemon (mirror a "
+                        "multi-chip physical host, e.g. a gang loopback "
+                        "worker)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--max_rounds", type=int, default=None)
     p.add_argument("--config", default=None,
@@ -100,8 +104,11 @@ def main():
             max_rounds=args.max_rounds, shockwave=shockwave_config,
             rate_override=rate_override))
 
-    makespan = sched.simulate(cluster_spec, arrival_times, jobs,
-                              forced_schedule=forced_schedule)
+    makespan = sched.simulate(
+        cluster_spec, arrival_times, jobs,
+        num_chips_per_server={wt: args.chips_per_server
+                              for wt in cluster_spec},
+        forced_schedule=forced_schedule)
 
     jct = sched.get_average_jct()
     ftf_static, ftf_themis = sched.get_finish_time_fairness()
@@ -129,6 +136,7 @@ def main():
         "per_round_schedule": sched.rounds.per_round_schedule,
         "time_per_iteration": args.round_duration,
         "throughput_timeline": sched.get_throughput_timeline(),
+        "milp_solve_stats": sched.get_solve_stats(),
     }
 
     unfair = unfair_fraction(ftf_static)

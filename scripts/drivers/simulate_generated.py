@@ -117,8 +117,10 @@ def main():
                 "extension_percentage": ext_pct,
                 "per_round_schedule": sched.rounds.per_round_schedule,
                 "time_per_iteration": args.round_duration,
+                "milp_solve_stats": sched.get_solve_stats(),
             }, f)
-    print(json.dumps({
+    solve_stats = sched.get_solve_stats()
+    summary = {
         "policy": args.policy,
         "num_jobs": args.num_jobs,
         "lam": args.lam,
@@ -126,7 +128,18 @@ def main():
         "avg_jct": round(jct[0], 2) if jct else None,
         "unfair_fraction": round(unfair, 4),
         "cluster_util": round(util, 4),
-    }))
+    }
+    if solve_stats:
+        paths = [s["path"] for s in solve_stats]
+        gaps = [s["mip_gap"] for s in solve_stats
+                if s["mip_gap"] is not None]
+        summary["milp_solves"] = len(paths)
+        summary["milp_paths"] = {p: paths.count(p) for p in sorted(set(paths))}
+        summary["milp_greedy_rate"] = round(
+            paths.count("greedy") / len(paths), 4)
+        if gaps:
+            summary["milp_max_gap"] = round(max(gaps), 6)
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

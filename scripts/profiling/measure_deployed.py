@@ -70,9 +70,15 @@ def free_port():
 
 
 def run_calibration(template, steps_per_job, duration, round_s, rounds,
-                    data_dir, timeout):
+                    data_dir, timeout, scale_factor=1, num_chips=None):
     """2-job loopback for `rounds` rounds; returns the checkpoint dir
-    holding the per-round iterator logs."""
+    holding the per-round iterator logs.
+
+    With scale_factor > 1 the two jobs are gangs (each needs all
+    `num_chips` chips, so they alternate rounds exactly like the sf=1
+    calibration). With num_chips > scale_factor * 1 capacity the two
+    sf=1 jobs instead run CONCURRENTLY every round — the co-resident
+    regime a multi-chip loopback cluster puts same-round jobs in."""
     ckpt = tempfile.mkdtemp(prefix="swtpu_deployed_")
     trace = os.path.join(ckpt, "cal.trace")
     with open(trace, "w") as f:
@@ -80,7 +86,8 @@ def run_calibration(template, steps_per_job, duration, round_s, rounds,
             job = Job(None, template.model, template.command,
                       template.working_directory, template.num_steps_arg,
                       needs_data_dir=template.needs_data_dir,
-                      total_steps=steps_per_job, duration=duration)
+                      total_steps=steps_per_job, duration=duration,
+                      scale_factor=scale_factor)
             f.write(job_to_trace_line(job, 0.0) + "\n")
     port = free_port()
     sched = subprocess.Popen(
@@ -97,7 +104,7 @@ def run_calibration(template, steps_per_job, duration, round_s, rounds,
         [sys.executable, "-m", "shockwave_tpu.runtime.worker",
          "--worker_type", "cal", "--sched_addr", "127.0.0.1",
          "--sched_port", str(port), "--worker_port", str(free_port()),
-         "--num_chips", "1", "--data_dir", data_dir,
+         "--num_chips", str(num_chips or scale_factor), "--data_dir", data_dir,
          "--checkpoint_dir", ckpt],
         cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     try:
@@ -110,10 +117,16 @@ def run_calibration(template, steps_per_job, duration, round_s, rounds,
 
 
 def parse_rounds(ckpt):
-    """[(round, load_end, lease_expiry, save_end, steps, lease_dur)]"""
-    out = []
+    """[(round, load_end, lease_expiry, save_end, steps, lease_dur)]
+
+    Gang ranks are aggregated per (job, round) with total-steps
+    semantics (steps sum across ranks; duration is the slowest rank;
+    load is the earliest rank in, save is the last rank out), so a
+    record's steps/dur IS the gang's aggregate rate."""
+    per_rank = {}
     for path in glob.glob(os.path.join(
-            ckpt, "job_id=*", ".swtpu", "round=*", "worker=0.log")):
+            ckpt, "job_id=*", ".swtpu", "round=*", "worker=*.log")):
+        job = int(re.search(r"job_id=(\d+)", path).group(1))
         rnd = int(re.search(r"round=(\d+)", path).group(1))
         load = exp = save_end = None
         steps = dur = None
@@ -133,8 +146,20 @@ def parse_rounds(ckpt):
             elif ev == "SAVE CHECKPOINT" and st == "END":
                 save_end = t
         if load is not None:
-            out.append((rnd, load, exp, save_end, steps, dur))
-    return sorted(out)
+            per_rank.setdefault((rnd, job), []).append(
+                (load, exp, save_end, steps, dur))
+    out = []
+    for (rnd, job), ranks in sorted(per_rank.items()):
+        load = min(r[0] for r in ranks)
+        exps = [r[1] for r in ranks if r[1] is not None]
+        saves = [r[2] for r in ranks if r[2] is not None]
+        step_vals = [r[3] for r in ranks if r[3] is not None]
+        dur_vals = [r[4] for r in ranks if r[4] is not None]
+        out.append((rnd, load, max(exps) if exps else None,
+                    max(saves) if saves else None,
+                    sum(step_vals) if step_vals else None,
+                    max(dur_vals) if dur_vals else None))
+    return out
 
 
 def main():
@@ -148,7 +173,19 @@ def main():
     p.add_argument("--rounds", type=int, default=5)
     p.add_argument("--data_dir", default="/tmp/swtpu_data")
     p.add_argument("--timeout", type=float, default=1500.0)
+    p.add_argument("--scale_factor", type=int, default=1,
+                   help="calibrate gang jobs: 2 sf=N jobs alternating "
+                        "on an N-chip worker (jax.distributed gangs "
+                        "through the real dispatch path); writes "
+                        "('Family', N) oracle rows")
+    p.add_argument("--concurrent", action="store_true",
+                   help="calibrate the co-resident regime: 2 sf=1 jobs "
+                        "running EVERY round on a 2-chip worker (no "
+                        "preemption, so only rates are written — drains "
+                        "keep their preemption-cycle calibration)")
     args = p.parse_args()
+    if args.concurrent and args.scale_factor != 1:
+        p.error("--concurrent calibrates sf=1 co-residency")
 
     by_model = {t.model: t for t in JOB_TABLE}
     with open(args.oracle) as f:
@@ -157,17 +194,21 @@ def main():
     meta = oracle.setdefault("__meta__", {})
     drains, shortfalls, detail = [], [], {}
 
+    sf = args.scale_factor
     for family in args.families:
         template = by_model[family]
         # Enough steps that neither job finishes inside the calibration
         # window: rate is taken from solo profile when present, else a
-        # conservative 0.2 steps/s.
+        # conservative 0.2 steps/s. (A gang's aggregate rate on a
+        # timeshared loopback host is ~the sf=1 rate; on real chips
+        # it is higher and the jobs simply stop at max_rounds.)
         solo = rows.get(f"('{family}', 1)", {}).get("null") or 0.2
         steps_per_job = int(solo * args.round_duration * args.rounds)
         duration = int(args.rounds * args.round_duration * 4)
         ckpt = run_calibration(
             template, steps_per_job, duration, args.round_duration,
-            args.rounds, args.data_dir, args.timeout)
+            args.rounds, args.data_dir, args.timeout,
+            scale_factor=sf, num_chips=2 if args.concurrent else sf)
         try:
             recs = parse_rounds(ckpt)
         finally:
@@ -183,15 +224,20 @@ def main():
         # with a missing/unparsed lease line (e.g. process killed
         # mid-round) is dropped whole, so one bad round can't shift
         # every subsequent gap onto the wrong round's lease duration.
+        # Concurrent mode has no preemption cycle at all — consecutive
+        # records are the two co-resident jobs of the SAME round, so a
+        # gap chain would pair one job's load with the other's exit;
+        # skip the computation entirely.
         cycles = []
         prev_exit = None
-        for rnd, load, exp, save_end, s, d in recs:
-            end = save_end or exp
-            if (prev_exit is not None and load is not None and rnd > 0
-                    and s and d):
-                cycles.append(((load - prev_exit).total_seconds(), d))
-            if end is not None:
-                prev_exit = end
+        if not args.concurrent:
+            for rnd, load, exp, save_end, s, d in recs:
+                end = save_end or exp
+                if (prev_exit is not None and load is not None and rnd > 0
+                        and s and d):
+                    cycles.append(((load - prev_exit).total_seconds(), d))
+                if end is not None:
+                    prev_exit = end
         # Cycle excess over the round: everything outside the lease.
         cycle_excess = [
             g + (args.round_duration - min(d, args.round_duration))
@@ -199,38 +245,49 @@ def main():
         drain = statistics.mean(cycle_excess) if cycle_excess else 0.0
         shortfall = max(
             args.round_duration - statistics.mean(lease_durs), 0.0)
-        rows[f"('{family}', 1)"] = {"null": round(tput, 4)}
-        # lease_shortfall_s* keys are OWNED by this script (in-lease
-        # shortfall via the real runtime); the spawn->exit proxy keys
-        # (dispatch_overhead_s*) are owned by measure_startup.py. The
-        # simulator prefers the shortfall when both are present
-        # (sched/scheduler.py:_cold_dispatch_overhead).
-        meta.setdefault("lease_shortfall_s_by_type", {}).setdefault(
-            args.worker_type, {})[family] = round(shortfall, 2)
-        meta.setdefault("round_drain_s_by_type", {}).setdefault(
-            args.worker_type, {})[family] = round(drain, 2)
-        drains.append(drain)
-        shortfalls.append(shortfall)
+        rows[f"('{family}', {sf})"] = {"null": round(tput, 4)}
+        if not args.concurrent:
+            # lease_shortfall_s* keys are OWNED by this script (in-lease
+            # shortfall via the real runtime); the spawn->exit proxy keys
+            # (dispatch_overhead_s*) are owned by measure_startup.py. The
+            # simulator prefers the shortfall when both are present
+            # (sched/scheduler.py:_cold_dispatch_overhead). Concurrent
+            # mode has no preemption cycle, so drains/shortfalls keep
+            # their preemption-cycle calibration.
+            meta.setdefault("lease_shortfall_s_by_type", {}).setdefault(
+                args.worker_type, {})[family] = round(shortfall, 2)
+            meta.setdefault("round_drain_s_by_type", {}).setdefault(
+                args.worker_type, {})[family] = round(drain, 2)
+            drains.append(drain)
+            shortfalls.append(shortfall)
         detail[family] = {
             "deployed_steps_per_s": round(tput, 4),
             "solo_steps_per_s": solo,
+            "scale_factor": sf,
+            "concurrent": args.concurrent,
             "leases": len(leases),
             "mean_lease_s": round(statistics.mean(lease_durs), 1),
-            "mean_cycle_excess_s": round(drain, 1),
+            "mean_cycle_excess_s": (None if args.concurrent
+                                    else round(drain, 1)),
         }
-        print(f"{family}: deployed {tput:.4f} steps/s "
+        print(f"{family} sf={sf}: deployed {tput:.4f} steps/s "
               f"(solo {solo}), lease shortfall {shortfall:.1f}s, "
               f"cycle excess {drain:.1f}s")
 
-    meta.setdefault("lease_shortfall_s", {})[args.worker_type] = round(
-        statistics.mean(shortfalls), 2)
-    meta.setdefault("round_drain_s", {})[args.worker_type] = round(
-        statistics.mean(drains), 2)
-    meta.setdefault("deployed_calibration", {})[args.worker_type] = {
+    if shortfalls:
+        meta.setdefault("lease_shortfall_s", {})[args.worker_type] = round(
+            statistics.mean(shortfalls), 2)
+        meta.setdefault("round_drain_s", {})[args.worker_type] = round(
+            statistics.mean(drains), 2)
+    mode = ("2 concurrent co-resident jobs (2-chip worker)"
+            if args.concurrent else
+            f"2-job alternating loopback (sf={sf})")
+    meta.setdefault("deployed_calibration", {}).setdefault(
+        args.worker_type, {})[f"sf={sf}{'+concurrent' if args.concurrent else ''}"] = {
         "measured_at": datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds"),
-        "method": "2-job alternating loopback via the real runtime; "
-                  "steps/in-lease-second; cycle excess over round",
+        "method": f"{mode} via the real runtime; steps/in-lease-second; "
+                  "cycle excess over round",
         "round_duration": args.round_duration,
         "per_family": detail,
     }
@@ -238,7 +295,8 @@ def main():
         json.dump(oracle, f, indent=1)
         f.write("\n")
     print(f"round_drain_s[{args.worker_type}] = "
-          f"{meta['round_drain_s'][args.worker_type]} -> {args.oracle}")
+          f"{meta.get('round_drain_s', {}).get(args.worker_type)} "
+          f"-> {args.oracle}")
 
 
 if __name__ == "__main__":

@@ -54,6 +54,22 @@ def common_parser(description: str, steps_args=("--num_steps",)) -> argparse.Arg
     return p
 
 
+def parse_args(parser: argparse.ArgumentParser):
+    """Parse workload CLI args and, for gang members, join the
+    jax.distributed cluster BEFORE the caller touches JAX.
+
+    Every workload main must use this instead of parser.parse_args():
+    jax.distributed.initialize refuses to run once the XLA backend is
+    initialized, and the mains' first act after parsing is model.init —
+    a backend-initializing computation. (Found by the first real
+    2-process gang run; the stub-worker gang tests never launch a
+    training process.)"""
+    args = parser.parse_args()
+    maybe_initialize_distributed(args.coordinator, args.num_processes,
+                                 args.process_id)
+    return args
+
+
 def _host_fingerprint() -> str:
     """Short hash of the host's architecture + CPU feature flags.
 
@@ -385,7 +401,13 @@ class Trainer:
         return steps_done
 
     def _save(self, path, state):
-        save_checkpoint(path, state)
+        # Gang members hold replicated state; only rank 0 writes (the
+        # reference's DDP rank-0 torch.save convention) — two ranks
+        # racing os.replace on one path lose the .tmp file. The lease
+        # iterator's exit barrier has already synchronized the gang by
+        # the time save runs, so rank 0's state is the gang's state.
+        if jax.process_index() == 0:
+            save_checkpoint(path, state)
 
     def _load(self, path):
         return load_checkpoint(path, jax.device_get(self.state))

@@ -91,12 +91,24 @@ def local_batch_slice(global_batch_size: int, process_index: Optional[int] = Non
     return slice(process_index * per, (process_index + 1) * per)
 
 
+_distributed_initialized = False
+
+
 def maybe_initialize_distributed(coordinator: Optional[str],
                                  num_processes: Optional[int],
                                  process_id: Optional[int]) -> None:
-    """Join a multi-host JAX cluster when dispatched as part of a gang."""
-    if coordinator and num_processes and num_processes > 1:
+    """Join a multi-host JAX cluster when dispatched as part of a gang.
+
+    MUST run before any JAX computation (model init included): jax
+    refuses to initialize the distributed runtime once the XLA backend
+    exists. Workload mains therefore call this through
+    train_common.parse_args() as their very first JAX-touching act;
+    the Trainer's own call is a no-op by then (idempotent)."""
+    global _distributed_initialized
+    if (coordinator and num_processes and num_processes > 1
+            and not _distributed_initialized):
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id)
+        _distributed_initialized = True

@@ -12,6 +12,7 @@ scripts), and writes a PNG. Usable as a CLI:
 from __future__ import annotations
 
 import argparse
+import os
 import pickle
 from typing import Dict, List, Optional
 
@@ -120,6 +121,102 @@ def plot_schedule_heatmap(metrics: dict, output: str,
     return output
 
 
+def plot_worker_gantt(metrics: Optional[dict] = None,
+                      output: str = "gantt.png",
+                      timeline_dir: Optional[str] = None) -> str:
+    """Worker-occupancy Gantt: worker x time, one colored span per
+    job lease (reference analog:
+    scripts/utils/postprocess_simulator_log.py, which reconstructs
+    per-job worker occupancy from run logs).
+
+    Two sources:
+    - a metric pickle (sim or physical): round-quantized spans from
+      `per_round_schedule` x `time_per_iteration`;
+    - a physical run's `--timeline_dir`: exact in-lease spans parsed
+      from the iterator event logs (LOAD CHECKPOINT BEGIN ->
+      SAVE CHECKPOINT END per dispatch), which also expose the
+      dead time between leases that the round-quantized view hides.
+    """
+    # spans: {worker_id: [(start, length, job_id)]}
+    spans: Dict[int, list] = {}
+    if timeline_dir:
+        import datetime
+        import glob
+        import re
+        fmt = "%Y-%m-%d %H:%M:%S"
+        events = []  # (job, worker, wall_ts, event, state)
+        for path in glob.glob(os.path.join(timeline_dir, "job_id=*.log")):
+            job = int(re.search(r"job_id=(\d+)", path).group(1))
+            for line in open(path):
+                m = re.match(
+                    r"t=[\d.]+ ITERATOR worker=(\d+) \[(.*?)\] "
+                    r"\[(.*?)\] \[(.*?)\]", line)
+                if m:
+                    ts = datetime.datetime.strptime(m.group(2), fmt)
+                    events.append((job, int(m.group(1)), ts,
+                                   m.group(3), m.group(4)))
+        if not events:
+            raise ValueError(f"no iterator events under {timeline_dir}")
+        t0 = min(e[2] for e in events)
+        open_spans: Dict[tuple, float] = {}
+        last_seen: Dict[tuple, float] = {}
+        for job, worker, ts, ev, st in sorted(events, key=lambda e: e[2]):
+            rel = (ts - t0).total_seconds()
+            key = (job, worker)
+            last_seen[key] = rel
+            if ev == "LOAD CHECKPOINT" and st == "BEGIN":
+                open_spans[key] = rel
+            elif ev == "SAVE CHECKPOINT" and st == "END":
+                # Only the save end closes a span: LEASE COMPLETE
+                # precedes the final checkpoint save, which belongs to
+                # the lease's occupancy.
+                start = open_spans.pop(key, None)
+                if start is not None and rel > start:
+                    spans.setdefault(worker, []).append(
+                        (start, rel - start, job))
+        # A dispatch that never reached its save (kill, crash, rank>0 of
+        # a gang whose save is rank-0-only) closes at its last event.
+        for (job, worker), start in open_spans.items():
+            end = last_seen[(job, worker)]
+            if end > start:
+                spans.setdefault(worker, []).append(
+                    (start, end - start, job))
+    else:
+        if metrics is None:
+            raise ValueError("need a metric pickle or --timeline_dir")
+        round_s = metrics.get("time_per_iteration") or 1.0
+        for r, rnd in enumerate(metrics["per_round_schedule"]):
+            for j, worker_ids in rnd.items():
+                ids = (worker_ids if hasattr(worker_ids, "__iter__")
+                       else [worker_ids])
+                for w in ids:
+                    spans.setdefault(int(w), []).append(
+                        (r * round_s, round_s, int(j)))
+    if not spans:
+        raise ValueError("no occupancy spans found")
+    jobs = sorted({j for sp in spans.values() for _, _, j in sp})
+    cmap = plt.get_cmap("tab20")
+    color = {j: cmap(i % 20) for i, j in enumerate(jobs)}
+    workers = sorted(spans)
+    fig, ax = plt.subplots(figsize=(9, 0.6 * max(len(workers), 3) + 1.5))
+    for row, w in enumerate(workers):
+        ax.broken_barh([(s, d) for s, d, _ in spans[w]],
+                       (row - 0.4, 0.8),
+                       facecolors=[color[j] for _, _, j in spans[w]],
+                       edgecolor="black", linewidth=0.3)
+    ax.set_yticks(range(len(workers)))
+    ax.set_yticklabels([f"worker {w}" for w in workers])
+    ax.set_xlabel("time (s)")
+    ax.grid(axis="x", alpha=0.3)
+    handles = [plt.Rectangle((0, 0), 1, 1, color=color[j]) for j in jobs]
+    ax.legend(handles, [f"job {j}" for j in jobs], ncol=min(len(jobs), 6),
+              fontsize=7, loc="upper center", bbox_to_anchor=(0.5, -0.18))
+    fig.tight_layout()
+    fig.savefig(output, dpi=150)
+    plt.close(fig)
+    return output
+
+
 def plot_utilization(results: Dict[str, dict], output: str) -> str:
     """Per-round cluster utilization timeline per policy."""
     fig, ax = plt.subplots(figsize=(6, 3.5))
@@ -150,14 +247,21 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--metric", required=True,
                    choices=["jct", "ftf", "ftf_themis", "bars", "heatmap",
-                            "utilization"])
-    p.add_argument("--pickles", nargs="+", required=True,
+                            "utilization", "gantt"])
+    p.add_argument("--pickles", nargs="+", default=None,
                    help="label=path pairs of driver metric pickles")
     p.add_argument("--bar_metric", default="makespan")
+    p.add_argument("--timeline_dir", default=None,
+                   help="gantt only: physical run timeline dir for "
+                        "exact in-lease spans instead of round-"
+                        "quantized pickle spans")
     p.add_argument("--output", required=True)
     args = p.parse_args()
+    if not args.pickles and not (args.metric == "gantt"
+                                 and args.timeline_dir):
+        p.error("--pickles is required (except gantt --timeline_dir)")
 
-    results = _load(args.pickles)
+    results = _load(args.pickles or [])
     if args.metric == "jct":
         plot_jct_cdf(results, args.output)
     elif args.metric == "ftf":
@@ -170,6 +274,10 @@ def main():
         plot_schedule_heatmap(next(iter(results.values())), args.output)
     elif args.metric == "utilization":
         plot_utilization(results, args.output)
+    elif args.metric == "gantt":
+        plot_worker_gantt(
+            next(iter(results.values())) if results else None,
+            args.output, timeline_dir=args.timeline_dir)
     print(args.output)
 
 

@@ -1595,3 +1595,12 @@ class Scheduler:
         """Per-job {round: (throughput, batch_size)} measurement history."""
         return {job_id: dict(tl)
                 for job_id, tl in self._throughput_timeline.items()}
+
+    def get_solve_stats(self):
+        """Per-solve MILP quality telemetry (shockwave planner only):
+        list of dicts with path/status/mip_gap/wall_s per re-solve, or
+        [] for LP policies."""
+        if self._shockwave_planner is None:
+            return []
+        from dataclasses import asdict
+        return [asdict(s) for s in self._shockwave_planner.solve_stats]

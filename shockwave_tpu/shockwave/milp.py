@@ -52,6 +52,28 @@ class MilpOptions:
     logapx_origin: float = 1e-6
 
 
+@dataclass
+class SolveStats:
+    """Per-plan_schedule solve-quality telemetry (the reference bounds
+    its solver with MIPGap/TimeLimit, configurations/tacc_32gpus.json,
+    but never records what the solver actually achieved; scale runs
+    need that to prove the fallback chain stays cold).
+
+    `path` is the outcome of the fallback chain:
+      ftf            — first attempt (with FTF constraints) solved
+      relaxed        — FTF infeasible/timed out; relaxed solve succeeded
+      relaxed_retry  — relaxed solve needed the long-budget retry
+      greedy         — every MILP failed; greedy fallback schedule
+    """
+    round_index: int
+    njobs: int
+    path: str
+    wall_s: float
+    status: Optional[int] = None       # scipy milp status of final solve
+    mip_gap: Optional[float] = None    # achieved relative gap, if exposed
+    ftf_infeasible: bool = False       # FTF caps provably infeasible
+
+
 def finish_time_momentumed_average(series, round_index, momentum=0.9) -> float:
     """Running average of finish-time estimates weighted by how long each
     estimate was current, blended with the latest estimate
@@ -104,8 +126,26 @@ def _solve(c, A_ub, b_ub, A_eq, b_eq, integrality, ub, opts: MilpOptions,
 
 def plan_schedule(jobs, round_index: int, future_nrounds: int,
                   round_duration: float, ngpus: int, share_series: List[list],
-                  opts: MilpOptions) -> np.ndarray:
-    """Returns a boolean (njobs x future_nrounds) schedule matrix."""
+                  opts: MilpOptions,
+                  stats_out: Optional[list] = None) -> np.ndarray:
+    """Returns a boolean (njobs x future_nrounds) schedule matrix.
+
+    With `stats_out`, appends one SolveStats record describing which
+    arm of the fallback chain produced the schedule and the solver's
+    achieved quality (status / MIP gap / wall time)."""
+    import time as _time
+    _t0 = _time.monotonic()
+
+    def _record(path, res=None, ftf_infeasible=False):
+        if stats_out is not None:
+            gap = getattr(res, "mip_gap", None) if res is not None else None
+            stats_out.append(SolveStats(
+                round_index=round_index, njobs=len(jobs), path=path,
+                wall_s=round(_time.monotonic() - _t0, 3),
+                status=getattr(res, "status", None) if res is not None
+                else None,
+                mip_gap=None if gap is None else float(gap),
+                ftf_infeasible=ftf_infeasible))
     njobs = len(jobs)
     bases = list(opts.logapx_bases)
     assert bases[0] == 0.0
@@ -212,6 +252,7 @@ def plan_schedule(jobs, round_index: int, future_nrounds: int,
         res = _solve(*model, opts, scale)
     if model is not None and res.x is not None and res.status in (0, 1):
         x = _extract(res.x, L, njobs, future_nrounds)
+        _record("ftf", res)
         return x
 
     # -- fallback: relax FTF, boost violating jobs' utilities -------------
@@ -221,21 +262,26 @@ def plan_schedule(jobs, round_index: int, future_nrounds: int,
     else:
         logger.info("FTF constraints infeasible at round %d; relaxing",
                     round_index)
+    ftf_infeasible = model is None
     priorities = _relaxation_priorities(
         jobs, dirichlet, runavg, round_index, round_duration, future_share,
         opts.rhomax, opts.lam)
     model = assemble(priorities, with_ftf=False)
     res = _solve(*model, opts, scale)
+    retried = False
     if res.x is None and res.status == 1:
         # Timed out before finding any incumbent: one longer attempt is
         # much better than degrading to the greedy schedule.
         logger.info("relaxed MILP hit its time limit; retrying at %.0fs",
                     retry_budget)
         res = _solve(*model, opts, retry_budget / opts.timeout)
+        retried = True
     if res.x is None:
         logger.warning("relaxed MILP failed (%s); greedy fallback", res.status)
+        _record("greedy", res, ftf_infeasible)
         return _greedy_fallback(jobs, future_nrounds, ngpus, dirichlet)
     x = _extract(res.x, L, njobs, future_nrounds)
+    _record("relaxed_retry" if retried else "relaxed", res, ftf_infeasible)
     return _rank_in_schedule(x, priorities, nworkers, ngpus, opts,
                              time_limit=solve_budget)
 
@@ -271,12 +317,25 @@ def _relaxation_priorities(jobs, dirichlet, runavg, round_index,
                 priority = ratio ** power
             except OverflowError:
                 # Degenerate runavg (sub-epoch jobs) can push the ratio
-                # past float range at power 100; a huge finite priority
-                # ranks identically without poisoning MILP coefficients.
+                # past float range at power 100.
                 priority = 1e300
             priorities.append(priority)
         else:
             priorities.append(1.0)
+    # Only RELATIVE priorities matter — they are NSW objective weights
+    # (scale-invariant trade-offs) and rank keys — but their absolute
+    # magnitude reaches HiGHS as objective coefficients, and ratio**100
+    # boosts (up to the 1e300 overflow guard) make HiGHS return
+    # "model_status Unknown" instantly, silently degrading every such
+    # re-solve to the greedy fallback schedule (found by the round-5
+    # solve telemetry: 12/16 solves on the 12-job fidelity trace).
+    # Normalizing the maximum to 1e6 preserves the exact ranking and
+    # relative weighting while keeping coefficients in HiGHS's
+    # comfortable range.
+    top = max(priorities)
+    if top > 1e6:
+        scale = 1e6 / top
+        priorities = [p * scale for p in priorities]
     return priorities
 
 

@@ -32,6 +32,10 @@ class ShockwavePlanner:
         self._resolve = True
         self._reestimate_share = True
         self.share_series: Dict[int, list] = {}
+        # Per-solve quality telemetry (milp.SolveStats), appended by
+        # every plan_schedule call; drivers persist it so scale runs
+        # can prove the fallback chain stays cold.
+        self.solve_stats: list = []
 
     @classmethod
     def from_config(cls, config: dict) -> "ShockwavePlanner":
@@ -119,7 +123,7 @@ class ShockwavePlanner:
 
         x = plan_schedule(jobs, self.round_ptr, self.future_nrounds,
                           self.round_duration, self.ngpus, share_series,
-                          self.opts)
+                          self.opts, stats_out=self.solve_stats)
         self.schedules = self._construct_schedules(x, job_ids, jobs)
         self._resolve = False
         return self.schedules[self.round_ptr]

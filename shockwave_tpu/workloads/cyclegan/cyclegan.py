@@ -26,10 +26,9 @@ from shockwave_tpu.models import data
 from shockwave_tpu.models.cyclegan import Discriminator, Generator
 from shockwave_tpu.models.train_common import (checkpoint_path, common_parser,
                                                enable_compile_cache,
-                                               load_checkpoint,
+                                               load_checkpoint, parse_args,
                                                save_checkpoint)
-from shockwave_tpu.parallel.mesh import (data_parallel_sharding, make_mesh,
-                                         maybe_initialize_distributed)
+from shockwave_tpu.parallel.mesh import data_parallel_sharding, make_mesh
 from shockwave_tpu.runtime.iterator import LeaseIterator
 
 
@@ -89,11 +88,9 @@ def main():
     p.add_argument("--img_size", type=int, default=128)
     p.add_argument("--lr", type=float, default=2e-4)
     p.add_argument("--decay_epoch", type=int, default=0)
-    args = p.parse_args()
+    args = parse_args(p)
     enable_compile_cache()
 
-    maybe_initialize_distributed(args.coordinator, args.num_processes,
-                                 args.process_id)
     mesh = make_mesh(batch_size=args.batch_size)
     batch_sharding, repl_sharding = data_parallel_sharding(mesh)
 

@@ -16,14 +16,14 @@ import optax
 
 from shockwave_tpu.models import data
 from shockwave_tpu.models.resnet import ResNet18
-from shockwave_tpu.models.train_common import Trainer, common_parser
+from shockwave_tpu.models.train_common import Trainer, common_parser, parse_args
 
 
 def main():
     p = common_parser("ResNet-18 on CIFAR-10", steps_args=("--num_steps",))
     p.add_argument("--data_dir", default=None)
     p.add_argument("--batch_size", type=int, default=128)
-    args = p.parse_args()
+    args = parse_args(p)
 
     model = ResNet18()
     rng = jax.random.PRNGKey(0)

@@ -16,7 +16,7 @@ import optax
 
 from shockwave_tpu.models import data
 from shockwave_tpu.models.resnet import ResNet50
-from shockwave_tpu.models.train_common import Trainer, common_parser
+from shockwave_tpu.models.train_common import Trainer, common_parser, parse_args
 
 
 def main():
@@ -25,7 +25,7 @@ def main():
     p.add_argument("-j", "--workers", type=int, default=4)
     p.add_argument("-a", "--arch", default="resnet50")
     p.add_argument("-b", "--batch_size", type=int, default=64)
-    args = p.parse_args()
+    args = parse_args(p)
 
     model = ResNet50()
     rng = jax.random.PRNGKey(0)

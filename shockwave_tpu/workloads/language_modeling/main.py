@@ -16,7 +16,7 @@ import optax
 
 from shockwave_tpu.models import data
 from shockwave_tpu.models.lm import LSTMLanguageModel
-from shockwave_tpu.models.train_common import Trainer, common_parser
+from shockwave_tpu.models.train_common import Trainer, common_parser, parse_args
 
 
 def main():
@@ -24,7 +24,7 @@ def main():
     # --cuda (trace-command compatibility) comes from common_parser.
     p.add_argument("--data", default=None)
     p.add_argument("--batch_size", type=int, default=20)
-    args = p.parse_args()
+    args = parse_args(p)
 
     model = LSTMLanguageModel()
     rng = jax.random.PRNGKey(0)

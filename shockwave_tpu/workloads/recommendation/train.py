@@ -15,14 +15,14 @@ import jax
 
 from shockwave_tpu.models import data
 from shockwave_tpu.models.recommendation import AutoEncoder, multinomial_nll
-from shockwave_tpu.models.train_common import Trainer, common_parser
+from shockwave_tpu.models.train_common import Trainer, common_parser, parse_args
 
 
 def main():
     p = common_parser("AutoEncoder on ML-20M", steps_args=("-n", "--num_steps"))
     p.add_argument("--data_dir", default=None)
     p.add_argument("--batch_size", type=int, default=2048)
-    args = p.parse_args()
+    args = parse_args(p)
 
     model = AutoEncoder()
     rng = jax.random.PRNGKey(0)

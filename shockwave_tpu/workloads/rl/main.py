@@ -26,7 +26,7 @@ from shockwave_tpu.models.a3c import (ActorCritic, build_a3c_update,
                                       env_observe, env_reset)
 from shockwave_tpu.models.train_common import (checkpoint_path, common_parser,
                                                enable_compile_cache,
-                                               load_checkpoint,
+                                               load_checkpoint, parse_args,
                                                save_checkpoint)
 from shockwave_tpu.runtime.iterator import LeaseIterator
 
@@ -56,7 +56,7 @@ def main():
     p.add_argument("--amsgrad", default="True")
     p.add_argument("--unroll", type=int, default=20)
     p.add_argument("--seed", type=int, default=1)
-    args = p.parse_args()
+    args = parse_args(p)
     enable_compile_cache()
 
     model = ActorCritic()

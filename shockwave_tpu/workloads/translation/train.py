@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import optax
 
 from shockwave_tpu.models import data
-from shockwave_tpu.models.train_common import Trainer, common_parser
+from shockwave_tpu.models.train_common import Trainer, common_parser, parse_args
 from shockwave_tpu.models.transformer import Seq2SeqTransformer
 
 
@@ -30,7 +30,7 @@ def main():
                    default=None,
                    help="fused pallas attention (default: on for TPU; "
                         "--no-use_flash forces the einsum path)")
-    args = p.parse_args()
+    args = parse_args(p)
 
     use_flash = (jax.default_backend() == "tpu"
                  if args.use_flash is None else args.use_flash)
