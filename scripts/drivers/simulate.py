@@ -67,6 +67,13 @@ def main():
     throughputs = read_throughputs(args.throughputs)
     profiles = build_profiles(jobs, throughputs)
     cluster_spec = parse_cluster_spec(args.cluster_spec)
+    for wt, count in cluster_spec.items():
+        if count % args.chips_per_server:
+            # The scheduler registers count // chips_per_server workers, so a
+            # remainder would silently simulate a smaller cluster.
+            raise SystemExit(
+                f"--cluster_spec {wt}:{count} is not divisible by "
+                f"--chips_per_server {args.chips_per_server}")
 
     shockwave_config = None
     if args.config:

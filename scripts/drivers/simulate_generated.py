@@ -100,6 +100,7 @@ def main():
     ftf_static, ftf_themis = sched.get_finish_time_fairness()
     util, util_list = sched.get_cluster_utilization()
     unfair = unfair_fraction(ftf_static)
+    solve_stats = sched.get_solve_stats()
     if args.output:
         with open(args.output, "wb") as f:
             ext_pct, ext, opp = sched.get_num_lease_extensions()
@@ -117,9 +118,8 @@ def main():
                 "extension_percentage": ext_pct,
                 "per_round_schedule": sched.rounds.per_round_schedule,
                 "time_per_iteration": args.round_duration,
-                "milp_solve_stats": sched.get_solve_stats(),
+                "milp_solve_stats": solve_stats,
             }, f)
-    solve_stats = sched.get_solve_stats()
     summary = {
         "policy": args.policy,
         "num_jobs": args.num_jobs,

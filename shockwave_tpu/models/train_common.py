@@ -130,6 +130,14 @@ def save_checkpoint(path: str, state: dict) -> None:
     os.replace(tmp, path)  # atomic so a preemption can't corrupt it
 
 
+def save_checkpoint_rank0(path: str, state: dict) -> None:
+    """Gang-safe save: members hold replicated state; only rank 0 writes
+    (the reference's DDP rank-0 torch.save convention) — two ranks racing
+    os.replace on one path lose the .tmp file."""
+    if jax.process_index() == 0:
+        save_checkpoint(path, state)
+
+
 def load_checkpoint(path: str, template: dict) -> Optional[dict]:
     if not os.path.exists(path):
         return None
@@ -401,13 +409,9 @@ class Trainer:
         return steps_done
 
     def _save(self, path, state):
-        # Gang members hold replicated state; only rank 0 writes (the
-        # reference's DDP rank-0 torch.save convention) — two ranks
-        # racing os.replace on one path lose the .tmp file. The lease
-        # iterator's exit barrier has already synchronized the gang by
-        # the time save runs, so rank 0's state is the gang's state.
-        if jax.process_index() == 0:
-            save_checkpoint(path, state)
+        # The lease iterator's exit barrier has already synchronized the
+        # gang by the time save runs, so rank 0's state is the gang's state.
+        save_checkpoint_rank0(path, state)
 
     def _load(self, path):
         return load_checkpoint(path, jax.device_get(self.state))

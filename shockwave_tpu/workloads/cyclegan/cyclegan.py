@@ -27,7 +27,7 @@ from shockwave_tpu.models.cyclegan import Discriminator, Generator
 from shockwave_tpu.models.train_common import (checkpoint_path, common_parser,
                                                enable_compile_cache,
                                                load_checkpoint, parse_args,
-                                               save_checkpoint)
+                                               save_checkpoint_rank0)
 from shockwave_tpu.parallel.mesh import data_parallel_sharding, make_mesh
 from shockwave_tpu.runtime.iterator import LeaseIterator
 
@@ -120,7 +120,7 @@ def main():
     if args.enable_lease_iterator:
         iterator = LeaseIterator(loader, args.checkpoint_dir,
                                  load_checkpoint_func=load,
-                                 save_checkpoint_func=save_checkpoint,
+                                 save_checkpoint_func=save_checkpoint_rank0,
                                  synthetic_data=args.synthetic_data)
         restored = iterator.load_checkpoint(ckpt)
     else:
@@ -164,7 +164,7 @@ def main():
         if iterator is not None:
             iterator.save_checkpoint(ckpt, state)
         else:
-            save_checkpoint(ckpt, state)
+            save_checkpoint_rank0(ckpt, state)
     print(f"TRAINED {steps_done} steps (cumulative {start_step + steps_done})",
           flush=True)
 

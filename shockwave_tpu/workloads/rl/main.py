@@ -27,7 +27,7 @@ from shockwave_tpu.models.a3c import (ActorCritic, build_a3c_update,
 from shockwave_tpu.models.train_common import (checkpoint_path, common_parser,
                                                enable_compile_cache,
                                                load_checkpoint, parse_args,
-                                               save_checkpoint)
+                                               save_checkpoint_rank0)
 from shockwave_tpu.runtime.iterator import LeaseIterator
 
 INFINITY = 10 ** 9
@@ -77,7 +77,7 @@ def main():
     if args.enable_lease_iterator:
         iterator = LeaseIterator(_TickLoader(budget), args.checkpoint_dir,
                                  load_checkpoint_func=load,
-                                 save_checkpoint_func=save_checkpoint,
+                                 save_checkpoint_func=save_checkpoint_rank0,
                                  synthetic_data=args.synthetic_data)
         restored = iterator.load_checkpoint(ckpt)
     else:
@@ -113,7 +113,7 @@ def main():
         if iterator is not None:
             iterator.save_checkpoint(ckpt, train_state)
         else:
-            save_checkpoint(ckpt, train_state)
+            save_checkpoint_rank0(ckpt, train_state)
     print(f"TRAINED {steps_done} steps (cumulative {start_step + steps_done})",
           flush=True)
 

@@ -34,6 +34,7 @@ if probe.returncode != 0:
 import jax
 import jax.numpy as jnp
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from shockwave_tpu.ops import flash_attention
 
 if jax.default_backend() != "tpu":
