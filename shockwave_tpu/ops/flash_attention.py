@@ -155,7 +155,12 @@ def _blockwise_stats(q, k, kv_mask, scale, causal, block_k):
         def body(carry, blk):
             m, l = carry
             kj, maskj, j = blk
-            s = (qb @ kj.T).astype(jnp.float32) * scale
+            # Matmul in the storage dtype (bf16 on the MXU's native path)
+            # with f32 accumulation — an f32 x f32 matmul would run at a
+            # fraction of the bf16 MXU rate.
+            s = lax.dot_general(
+                qb, kj, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
             if causal:
                 q_pos = lax.broadcasted_iota(jnp.int32, (tq, block_k), 0)
                 k_pos = j * block_k + lax.broadcasted_iota(
@@ -174,7 +179,7 @@ def _blockwise_stats(q, k, kv_mask, scale, causal, block_k):
             (kb_blocks, mask_blocks, jnp.arange(nk)))
         return m, l
 
-    return jax.vmap(per_bh)(q.astype(jnp.float32), k, kv_mask)
+    return jax.vmap(per_bh)(q, k, kv_mask)
 
 
 def _backward_impl(q, k, v, kv_mask, out, g, scale, causal, block_k):
@@ -187,7 +192,16 @@ def _backward_impl(q, k, v, kv_mask, out, g, scale, causal, block_k):
     delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
 
     nk = tk // block_k
-    q32, g32 = q.astype(jnp.float32), g.astype(jnp.float32)
+    g16 = g.astype(q.dtype)  # matmul operand dtype; accumulation is f32
+
+    def mm(a, b, contract):
+        # All backward matmuls run with storage-dtype (bf16) operands and
+        # f32 accumulation (the Dao et al. recipe): an f32 x f32 matmul
+        # would fall off the MXU's native bf16 path and dominate the
+        # training step (measured 12.9% -> see EXPERIMENTS.md for the
+        # compute-bound MFU this change recovers).
+        return lax.dot_general(a, b, (contract, ((), ())),
+                               preferred_element_type=jnp.float32)
 
     def per_bh(qb, kb, vb, gb, mb, lb, db, maskb):
         kb_blocks = kb.reshape(nk, block_k, d)
@@ -196,7 +210,7 @@ def _backward_impl(q, k, v, kv_mask, out, g, scale, causal, block_k):
 
         def body(dq, blk):
             kj, vj, maskj, j = blk
-            s = (qb @ kj.T).astype(jnp.float32) * scale  # (T, block_k)
+            s = mm(qb, kj, ((1,), (1,))) * scale         # (T, block_k) f32
             if causal:
                 q_pos = lax.broadcasted_iota(jnp.int32, (t, block_k), 0)
                 k_pos = j * block_k + lax.broadcasted_iota(
@@ -204,11 +218,12 @@ def _backward_impl(q, k, v, kv_mask, out, g, scale, causal, block_k):
                 s = jnp.where(q_pos >= k_pos, s, NEG_INF)
             s = jnp.where(maskj[None, :] > 0, s, NEG_INF)
             p = jnp.exp(s - mb[:, None]) / jnp.maximum(lb, 1e-30)[:, None]
-            dp = gb @ vj.T.astype(jnp.float32)           # (T, block_k)
-            ds = p * (dp - db[:, None]) * scale          # (T, block_k)
-            dq = dq + ds @ kj.astype(jnp.float32)
-            dkj = ds.T @ qb.astype(jnp.float32)          # (block_k, d)
-            dvj = p.T @ gb                               # (block_k, d)
+            dp = mm(gb, vj, ((1,), (1,)))                # (T, block_k) f32
+            ds = (p * (dp - db[:, None]) * scale).astype(qb.dtype)
+            p16 = p.astype(qb.dtype)
+            dq = dq + mm(ds, kj, ((1,), (0,)))
+            dkj = mm(ds, qb, ((0,), (0,)))               # (block_k, d) f32
+            dvj = mm(p16, gb, ((0,), (0,)))              # (block_k, d) f32
             return dq, (dkj, dvj)
 
         dq, (dk_blocks, dv_blocks) = lax.scan(
@@ -216,7 +231,7 @@ def _backward_impl(q, k, v, kv_mask, out, g, scale, causal, block_k):
             (kb_blocks, vb_blocks, mask_blocks, jnp.arange(nk)))
         return dq, dk_blocks.reshape(tk, d), dv_blocks.reshape(tk, d)
 
-    dq, dk, dv = jax.vmap(per_bh)(q32, k, v, g32, m, l, delta, kv_mask)
+    dq, dk, dv = jax.vmap(per_bh)(q, k, v, g16, m, l, delta, kv_mask)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
