@@ -64,6 +64,13 @@ def main():
     p.add_argument("--timeline_dir", default=None)
     p.add_argument("--watchdog", type=float, default=None,
                    help="dump all thread tracebacks every N seconds")
+    p.add_argument("--completion_buffer", type=float, default=None,
+                   help="seconds past the round end before the "
+                        "unresponsive-kill watchdog fires (default 60)")
+    p.add_argument("--first_init_grace", type=float, default=300.0,
+                   help="seconds a freshly dispatched job may stay silent "
+                        "before it can be killed (slow relayed-TPU "
+                        "backend init; 0 disables)")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args()
 
@@ -93,7 +100,9 @@ def main():
         config=SchedulerConfig(
             time_per_iteration=args.round_duration, seed=args.seed,
             max_rounds=args.max_rounds, shockwave=shockwave_config,
-            watchdog_interval=args.watchdog))
+            watchdog_interval=args.watchdog,
+            job_completion_buffer_s=args.completion_buffer,
+            first_init_grace_s=args.first_init_grace))
 
     start_time = time.time()
     submitter = threading.Thread(

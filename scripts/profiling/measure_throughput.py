@@ -207,6 +207,53 @@ def measure(model_name: str, bs: int, sf: int, steps: int, warmup: int):
     return 1.0 / dt
 
 
+def measure_pair(fam_a, bs_a, fam_b, bs_b, steps, warmup, dt_cache=None):
+    """Packed-pair steps/s: both jobs co-resident on one chip.
+
+    The reference's --packed grid (scheduler/scripts/profiling/
+    measure_throughput.py) co-schedules two processes on one GPU via MPS.
+    TPUs have no MPS: co-located jobs time-share the chip, so the honest
+    pair rate is round-robin time-slicing with a step ratio k_a:k_b chosen
+    from the isolated step times so each job gets ~equal device time (what
+    a fair time-slicing executor would grant). Returns
+    (rate_a, rate_b, dt_a, dt_b) — pair rates plus the isolated marginal
+    step times measured along the way."""
+    state_a, step_a, batch_a = build_family(fam_a, bs_a)
+    state_b, step_b, batch_b = build_family(fam_b, bs_b)
+    n1 = max(steps // 4, 2)
+    # Isolated marginal step times are per-row quantities; cache them so a
+    # --packed grid of n rows measures n of them, not n^2.
+    if dt_cache is None:
+        dt_cache = {}
+    if (fam_a, bs_a) not in dt_cache:
+        dt_cache[(fam_a, bs_a)] = marginal_step_time(
+            step_a, state_a, batch_a, n1=n1, n2=steps, warmup=warmup)
+    if (fam_b, bs_b) not in dt_cache:
+        dt_cache[(fam_b, bs_b)] = marginal_step_time(
+            step_b, state_b, batch_b, n1=n1, n2=steps, warmup=warmup)
+    dt_a, dt_b = dt_cache[(fam_a, bs_a)], dt_cache[(fam_b, bs_b)]
+    if dt_a <= dt_b:
+        k_a, k_b = max(1, round(dt_b / dt_a)), 1
+    else:
+        k_a, k_b = 1, max(1, round(dt_a / dt_b))
+
+    def quantum(state, _):
+        sa, sb = state
+        la = lb = None
+        for _ in range(k_a):
+            sa, la = step_a(sa, batch_a)
+        for _ in range(k_b):
+            sb, lb = step_b(sb, batch_b)
+        # Sum the two losses so the closing fetch waits for BOTH chains.
+        loss = (jnp.asarray(la).astype(jnp.float32).ravel()[0]
+                + jnp.asarray(lb).astype(jnp.float32).ravel()[0])
+        return (sa, sb), loss
+
+    dt_q = marginal_step_time(quantum, (state_a, state_b), None,
+                              n1=2, n2=8, warmup=max(1, warmup // 2))
+    return k_a / dt_q, k_b / dt_q, dt_a, dt_b
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--worker_type", default="v5e")
@@ -218,6 +265,11 @@ def main():
                         "the reference profiler takes explicit job types "
                         "the same way")
     p.add_argument("--scale_factors", nargs="*", type=int, default=[1, 2, 4, 8])
+    p.add_argument("--packed", action="store_true",
+                   help="also measure every unordered pair (including "
+                        "self-pairs) of the resolved rows co-resident on "
+                        "one chip (sf=1 only — the reference likewise "
+                        "does not profile distributed+packed)")
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--merge", action="store_true",
@@ -260,6 +312,23 @@ def main():
             table.setdefault(key, {})["null"] = round(tput, 4)
             print(f"{args.worker_type} {key}: {tput:.3f} steps/s",
                   flush=True)
+
+    if args.packed:
+        import itertools
+        dt_cache = {}
+        for (fam_a, bs_a), (fam_b, bs_b) in \
+                itertools.combinations_with_replacement(rows, 2):
+            rate_a, rate_b, _, _ = measure_pair(
+                fam_a, bs_a, fam_b, bs_b, args.steps, args.warmup,
+                dt_cache=dt_cache)
+            key_a = str((oracle_job_type(fam_a, bs_a), 1))
+            key_b = str((oracle_job_type(fam_b, bs_b), 1))
+            table.setdefault(key_a, {})[key_b] = [round(rate_a, 4),
+                                                  round(rate_b, 4)]
+            table.setdefault(key_b, {})[key_a] = [round(rate_b, 4),
+                                                  round(rate_a, 4)]
+            print(f"{args.worker_type} {key_a} + {key_b}: "
+                  f"{rate_a:.3f} / {rate_b:.3f} steps/s", flush=True)
 
     os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
     with open(args.output, "w") as f:

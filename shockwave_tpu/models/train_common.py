@@ -18,6 +18,8 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import signal
+import sys
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -65,6 +67,11 @@ def parse_args(parser: argparse.ArgumentParser):
     2-process gang run; the stub-worker gang tests never launch a
     training process.)"""
     args = parser.parse_args()
+    # The dispatcher kills with SIGTERM-then-SIGKILL; converting SIGTERM
+    # to SystemExit lets the mains' finally blocks (checkpoint save,
+    # lease-iterator teardown) and atexit (relayed-TPU client disconnect,
+    # which otherwise wedges the chip grant) run before exit.
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     maybe_initialize_distributed(args.coordinator, args.num_processes,
                                  args.process_id)
     return args

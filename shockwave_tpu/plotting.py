@@ -91,6 +91,12 @@ def plot_policy_bars(results: Dict[str, dict], output: str,
     return output
 
 
+def _schedule_key_members(key):
+    """A per_round_schedule key is a bare int job id, or a tuple of
+    member ids for a packed-pair dispatch; yield the member ids."""
+    return tuple(key) if isinstance(key, tuple) else (int(key),)
+
+
 def plot_schedule_heatmap(metrics: dict, output: str,
                           max_rounds: Optional[int] = None) -> str:
     """Rounds x jobs occupancy map from `per_round_schedule`
@@ -98,7 +104,8 @@ def plot_schedule_heatmap(metrics: dict, output: str,
     schedule = metrics["per_round_schedule"]
     if max_rounds:
         schedule = schedule[:max_rounds]
-    job_ids = sorted({int(j) for rnd in schedule for j in rnd})
+    job_ids = sorted({m for rnd in schedule for j in rnd
+                      for m in _schedule_key_members(j)})
     if not job_ids:
         raise ValueError("empty per_round_schedule")
     col = {j: i for i, j in enumerate(job_ids)}
@@ -106,9 +113,10 @@ def plot_schedule_heatmap(metrics: dict, output: str,
     for r, rnd in enumerate(schedule):
         for j, worker_ids in rnd.items():
             # Values are the assigned worker-id tuples; plot chip counts.
-            grid[r, col[int(j)]] = (len(worker_ids)
-                                    if hasattr(worker_ids, "__len__")
-                                    else worker_ids)
+            for m in _schedule_key_members(j):
+                grid[r, col[m]] = (len(worker_ids)
+                                   if hasattr(worker_ids, "__len__")
+                                   else worker_ids)
     fig, ax = plt.subplots(figsize=(6, 4))
     im = ax.imshow(grid.T, aspect="auto", interpolation="nearest",
                    cmap="viridis", origin="lower")
@@ -189,9 +197,14 @@ def plot_worker_gantt(metrics: Optional[dict] = None,
             for j, worker_ids in rnd.items():
                 ids = (worker_ids if hasattr(worker_ids, "__iter__")
                        else [worker_ids])
+                members = _schedule_key_members(j)
+                # Packed pairs time-share the chip: split the round span
+                # between the members so neither bar occludes the other.
+                frac = round_s / len(members)
                 for w in ids:
-                    spans.setdefault(int(w), []).append(
-                        (r * round_s, round_s, int(j)))
+                    for mi, m in enumerate(members):
+                        spans.setdefault(int(w), []).append(
+                            (r * round_s + mi * frac, frac, m))
     if not spans:
         raise ValueError("no occupancy spans found")
     jobs = sorted({j for sp in spans.values() for _, _, j in sp})

@@ -168,15 +168,36 @@ class Dispatcher:
 
     # -- control -----------------------------------------------------------
 
-    def kill_job(self, job_id: int):
+    def kill_job(self, job_id: int, grace_s: float = 15.0):
         with self._lock:
             proc = self._processes.get(job_id)
         if proc is not None and proc.poll() is None:
             logger.info("killing job %d (pid %d)", job_id, proc.pid)
+            # SIGTERM first so the job's handler (train_common.parse_args)
+            # can run its finally/atexit cleanup — on relayed TPU backends
+            # a SIGKILLed client wedges the chip grant for minutes and
+            # every subsequent dispatch hangs behind it.
             try:
-                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                pgid = os.getpgid(proc.pid)
+                os.killpg(pgid, signal.SIGTERM)
             except ProcessLookupError:
-                pass
+                return
+
+            def escalate():
+                try:
+                    proc.wait(timeout=grace_s)
+                except subprocess.TimeoutExpired:
+                    logger.warning("job %d survived SIGTERM for %.0fs; "
+                                   "SIGKILL", job_id, grace_s)
+                    try:
+                        os.killpg(pgid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+
+            # Escalate off-thread: the KillJob RPC handler (and with it the
+            # scheduler's _kill_job, which holds its condition variable
+            # across the RPC) must not block for the grace window.
+            threading.Thread(target=escalate, daemon=True).start()
 
     def reset(self):
         with self._lock:

@@ -35,6 +35,10 @@ EARLY_INIT_THRESHOLD = 3.0
 # Must stay below JOB_COMPLETION_BUFFER_TIME so the round-end kill
 # watchdog still leaves room for the expiry checkpoint.
 INIT_LEASE_FLOOR_S = 45.0
+# A job whose latest heartbeat is younger than this is never killed as
+# unresponsive — the kill timer re-arms once instead (it may be running
+# its lease-expiry checkpoint right now).
+KILL_HEARTBEAT_FRESHNESS_S = 30.0
 BASE_JOB_PORT = 60570
 MAX_PORT = 65535
 
@@ -56,6 +60,10 @@ class PhysicalScheduler(Scheduler):
         self._available_workers: "queue.Queue[int]" = queue.Queue()
         self._lease_update_requests: Dict[JobIdPair, list] = {}
         self._last_heartbeat: Dict[JobIdPair, float] = {}
+        # Jobs that have reached at least one RPC since their LATEST
+        # dispatch — only these may be unresponsive-killed before the
+        # first-init grace expires (see SchedulerConfig.first_init_grace_s).
+        self._ever_signaled: set = set()
         self._max_steps_consensus: Dict[JobIdPair, Optional[int]] = {}
         self._completion_events: Dict[JobIdPair, threading.Timer] = {}
         self._redispatch_assignments: "collections.OrderedDict" = collections.OrderedDict()
@@ -101,6 +109,7 @@ class PhysicalScheduler(Scheduler):
         # grow without bound (and a straggler RPC cannot resurrect it).
         for m in job_id.singletons():
             self._last_heartbeat.pop(m, None)
+            self._ever_signaled.discard(m)
             self._lease_update_requests.pop(m, None)
             self._max_steps_consensus.pop(m, None)
 
@@ -149,6 +158,7 @@ class PhysicalScheduler(Scheduler):
             for m in job_id.singletons():
                 self._running_jobs.add(m)
                 self._last_heartbeat[m] = self.get_current_timestamp()
+                self._ever_signaled.add(m)
 
             job = self.acct.jobs[job_id]
             remaining = int(math.ceil(
@@ -187,6 +197,7 @@ class PhysicalScheduler(Scheduler):
             self._lease_update_requests[job_id].append(
                 (steps, duration, max_steps, max_duration))
             self._last_heartbeat[job_id] = self.get_current_timestamp()
+            self._ever_signaled.add(job_id)
 
             scale_factor = job.scale_factor
             remaining = int(math.ceil(
@@ -258,6 +269,7 @@ class PhysicalScheduler(Scheduler):
                 if m in self.acct.jobs:
                     self.acct.latest_timestamps[m] = self.get_current_timestamp()
                     self._last_heartbeat[m] = self.get_current_timestamp()
+                    self._ever_signaled.add(m)
             self._available_workers.put(worker_id)
 
             timer = self._completion_events.pop(job_id, None)
@@ -358,6 +370,7 @@ class PhysicalScheduler(Scheduler):
             # The liveness clock starts at dispatch: process launch +
             # imports + jit compile all happen before the first RPC.
             self._last_heartbeat[m] = self.get_current_timestamp()
+            self._ever_signaled.discard(m)  # cold spawn: init grace re-arms
         for rank, worker_id in enumerate(worker_ids):
             descriptions = []
             for m in job_id.singletons():
@@ -457,7 +470,9 @@ class PhysicalScheduler(Scheduler):
                 continue
             delay = round_end - now
             if job_id not in self.rounds.extended_leases:
-                delay += JOB_COMPLETION_BUFFER_TIME
+                delay += (self._config.job_completion_buffer_s
+                          if self._config.job_completion_buffer_s is not None
+                          else JOB_COMPLETION_BUFFER_TIME)
                 action = self._kill_job
             else:
                 action = self._done_callback_extended_lease
@@ -514,6 +529,41 @@ class PhysicalScheduler(Scheduler):
                 if (job_id in self.rounds.completed_in_round
                         and job_id not in self.rounds.extended_leases):
                     return
+            grace = self._config.first_init_grace_s
+            if grace and not any(m in self._ever_signaled
+                                 for m in job_id.singletons()):
+                dispatched = min((self._last_heartbeat.get(m, 0.0)
+                                  for m in job_id.singletons()), default=0.0)
+                waited = self.get_current_timestamp() - dispatched
+                if waited < grace:
+                    # Cold dispatch through a relayed TPU can spend minutes
+                    # in backend init waiting for the chip grant; killing
+                    # the waiter (SIGKILL) wedges the relay so the NEXT
+                    # dispatch hangs too — a kill->wedge->kill livelock
+                    # observed live on the v5e tunnel. Re-arm instead.
+                    self.log.warning(
+                        "job %s silent %.0fs after dispatch; granting "
+                        "first-init grace (%.0fs)", job_id, waited, grace)
+                    timer = threading.Timer(max(grace - waited, 1.0),
+                                            self._kill_job, args=(job_id,))
+                    timer.daemon = True
+                    timer.start()
+                    self._completion_events[job_id] = timer
+                    return
+            # A job that signaled moments ago (e.g. its first InitJob landed
+            # just before the re-armed grace timer fired) is alive and mid-
+            # checkpoint, not unresponsive: give it one short re-arm window
+            # instead of killing it seconds after its first RPC.
+            now = self.get_current_timestamp()
+            youngest = max((self._last_heartbeat.get(m, 0.0)
+                            for m in job_id.singletons()), default=0.0)
+            if now - youngest < KILL_HEARTBEAT_FRESHNESS_S:
+                timer = threading.Timer(KILL_HEARTBEAT_FRESHNESS_S,
+                                        self._kill_job, args=(job_id,))
+                timer.daemon = True
+                timer.start()
+                self._completion_events[job_id] = timer
+                return
             self.log.warning("killing unresponsive job %s", job_id)
             worker_ids = self.rounds.current_assignments[job_id]
             servers = set()
@@ -561,7 +611,10 @@ class PhysicalScheduler(Scheduler):
                           for m in job_id.singletons()
                           if m in self.acct.jobs), default=now)
             if now - oldest > (self._time_per_iteration
-                               + JOB_COMPLETION_BUFFER_TIME):
+                               + (self._config.job_completion_buffer_s
+                                  if self._config.job_completion_buffer_s
+                                  is not None
+                                  else JOB_COMPLETION_BUFFER_TIME)):
                 # No signal for over a round: job is unresponsive.
                 kill = True
             elif job_id in self._completion_events:

@@ -84,6 +84,18 @@ class SchedulerConfig:
     # Physical-mode deadlock watchdog: dump all thread tracebacks every
     # N seconds (reference: faulthandler at scheduler.py:451-455).
     watchdog_interval: Optional[float] = None
+    # Physical mode: how long past the round end a dispatched job may run
+    # before the unresponsive-kill watchdog fires (None = the default
+    # JOB_COMPLETION_BUFFER_TIME). Raise on platforms with slow dispatch.
+    job_completion_buffer_s: Optional[float] = None
+    # Physical mode: a job that has NEVER reached its first RPC (InitJob/
+    # UpdateLease/Done) is granted this long from dispatch before the
+    # unresponsive-kill watchdog may kill it. Cold dispatch through a
+    # relayed TPU legitimately spends minutes in backend init waiting for
+    # the chip grant, and killing the waiter wedges the relay so every
+    # subsequent dispatch hangs too (observed live on the v5e tunnel —
+    # the kill->wedge->kill livelock). 0 disables the grace.
+    first_init_grace_s: float = 300.0
     # Fidelity-analysis hook: per-job measured throughput overrides
     # ({integer_job_id: steps_per_s}) replacing the oracle rate for
     # those jobs on every worker type. Used by the schedule-replay
@@ -751,11 +763,23 @@ class Scheduler:
         scheduled = self._select_jobs_for_round(worker_types)
         assignments = self._assign_workers(scheduled, worker_types)
 
-        int_assignments = {
-            job_id.integer_job_id(): ids for job_id, ids in assignments.items()
-            if not job_id.is_pair()}
+        int_assignments = {}
+        for job_id, ids in assignments.items():
+            # Packed pairs are recorded as a tuple of member ids (sorted),
+            # singles as the bare int — consumers use _in_recorded_round.
+            key = (tuple(sorted(m.integer_job_id()
+                                for m in job_id.singletons()))
+                   if job_id.is_pair() else job_id.integer_job_id())
+            int_assignments[key] = ids
         self._record_round(int_assignments)
         return assignments
+
+    @staticmethod
+    def _in_recorded_round(sched: Dict, int_id: int) -> bool:
+        """Membership in a recorded round's schedule for either key form:
+        bare int ids (single jobs) or member-id tuples (packed pairs)."""
+        return int_id in sched or any(
+            isinstance(k, tuple) and int_id in k for k in sched)
 
     def _record_round(self, int_assignments: Dict[int, Sequence[int]]):
         """Per-round bookkeeping shared by the live scheduler and the
@@ -765,7 +789,7 @@ class Scheduler:
         self.rounds.jobs_in_round.append(len(self.acct.jobs))
         for job_id in self.acct.jobs:
             int_id = job_id.integer_job_id()
-            if int_id in int_assignments:
+            if self._in_recorded_round(int_assignments, int_id):
                 self.rounds.num_scheduled_rounds[int_id] += 1
             else:
                 self.rounds.num_queued_rounds[int_id] += 1
@@ -785,7 +809,14 @@ class Scheduler:
         assignments: "collections.OrderedDict[JobIdPair, Tuple[int, ...]]" = (
             collections.OrderedDict())
         seen_chips: Set[int] = set()
-        for int_id in sorted(recorded):
+        pair_keys = [k for k in recorded if isinstance(k, tuple)]
+        if pair_keys:
+            # Physical mode never packs (no MPS analog on TPU), so a
+            # recorded pair key means a packed SIM pickle was passed.
+            self.log.warning("replay: dropping packed-pair entries %s "
+                             "(pair replay unsupported)", pair_keys)
+        for int_id in sorted(k for k in recorded
+                             if not isinstance(k, tuple)):
             job_id = JobIdPair(int_id)
             if job_id not in self.acct.jobs:
                 if job_id in self._completed_jobs:
@@ -1240,7 +1271,8 @@ class Scheduler:
                         self.workers.id_to_type[worker_ids[0]]):
                     prev_sched = self.rounds.per_round_schedule[current_round - 2]
                     for m in job_id.singletons():
-                        if m.integer_job_id() not in prev_sched:
+                        if not self._in_recorded_round(prev_sched,
+                                                       m.integer_job_id()):
                             # Preempted last round: charge checkpoint/restore.
                             if (execution_time != 0 and
                                     self._time_per_iteration - 5 < execution_time):
