@@ -549,6 +549,82 @@ class TestExtendedLeaseLiveness:
             sched._server.stop(grace=0)
 
 
+class TestFirstInitGrace:
+    """A freshly dispatched job that has not yet reached its first RPC is
+    re-armed, not killed: cold dispatch through a relayed TPU can wait
+    minutes for the chip grant, and SIGKILLing the waiter wedges the
+    relay so the NEXT dispatch hangs too (observed live on the v5e
+    tunnel)."""
+
+    def _make_sched(self, **cfg):
+        return PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=100.0, **cfg),
+            expected_num_workers=1, port=free_port())
+
+    def _add_dispatched_job(self, sched):
+        job = Job(None, "ResNet-18 (batch size 32)",
+                  "python3 main.py --batch_size 32",
+                  "image_classification/cifar10", "--num_steps",
+                  total_steps=100, duration=1000)
+        job_id = sched.add_job(job)
+        sched.rounds.current_assignments[job_id] = (0,)
+        sched._last_heartbeat[job_id] = sched.get_current_timestamp()
+        return job_id
+
+    def test_never_signaled_job_rearms_within_grace(self):
+        sched = self._make_sched(first_init_grace_s=300.0)
+        try:
+            job_id = self._add_dispatched_job(sched)
+            assert job_id not in sched._ever_signaled
+            sched._kill_job(job_id)  # no worker connections: would raise
+            timer = sched._completion_events.get(job_id)
+            assert timer is not None, "grace must re-arm the kill timer"
+            timer.cancel()
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+    def test_fresh_heartbeat_rearms_even_after_init(self):
+        sched = self._make_sched(first_init_grace_s=300.0)
+        try:
+            job_id = self._add_dispatched_job(sched)
+            sched._ever_signaled.add(job_id)  # first RPC just landed
+            sched._kill_job(job_id)
+            timer = sched._completion_events.get(job_id)
+            assert timer is not None, "fresh heartbeat must re-arm"
+            timer.cancel()
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+    def test_stale_signaled_job_is_killed(self):
+        sched = self._make_sched(first_init_grace_s=300.0)
+        try:
+            job_id = self._add_dispatched_job(sched)
+            sched._ever_signaled.add(job_id)
+            sched._last_heartbeat[job_id] -= 10_000.0
+
+            class _StubClient:
+                addr, port = "127.0.0.1", 0
+                killed = []
+
+                def kill_job(self, int_id):
+                    self.killed.append(int_id)
+
+            sched._worker_connections[0] = _StubClient()
+            sched._cv.wait = lambda timeout=None: False
+            done = []
+            sched.done_callback = lambda *a: done.append(a)
+            sched._kill_job(job_id)
+            assert _StubClient.killed == [job_id.integer_job_id()]
+            assert done, "missing workers must get a zero-step done"
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+
 class TestInitLeaseFloor:
     """A job whose startup (imports + jit) eats most of the round must not
     be granted a sliver lease that expires before one step — that

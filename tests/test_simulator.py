@@ -425,6 +425,31 @@ class TestMultiChipJobs:
             assert len(round_sched) <= 1
 
 
+class TestPackedScheduleRecording:
+    def test_pair_dispatches_recorded_as_tuple_keys(self):
+        # Two same-type jobs on one worker under a packing policy: the
+        # pair oracle entries exist in tacc_throughputs.json, so the
+        # policy packs them and the record must show the tuple key
+        # (previously pairs were silently dropped from
+        # per_round_schedule).
+        jobs = [make_job(total_steps=30000), make_job(total_steps=30000)]
+        sched, _ = run_sim(jobs, [0.0, 0.0],
+                           policy_name="max_min_fairness_packed",
+                           num_workers=1)
+        pair_rounds = [rnd for rnd in sched.rounds.per_round_schedule
+                       if (0, 1) in rnd]
+        assert pair_rounds, "no packed-pair dispatch recorded"
+        assert all(not isinstance(k, tuple) or k == (0, 1)
+                   for rnd in sched.rounds.per_round_schedule for k in rnd)
+        # Membership helper sees members through the tuple key.
+        assert sched._in_recorded_round(pair_rounds[0], 0)
+        assert sched._in_recorded_round(pair_rounds[0], 1)
+        assert not sched._in_recorded_round(pair_rounds[0], 7)
+        # Both members complete and count their scheduled rounds.
+        assert len(sched._completed_jobs) == 2
+        assert sched.rounds.num_scheduled_rounds[0] >= len(pair_rounds)
+
+
 class TestAdaptation:
     def test_gns_job_doubles_bs(self):
         # ResNet-18 bs16 sf1 GNS doubles at epoch 31; give it enough epochs.
