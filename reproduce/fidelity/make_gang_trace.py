@@ -1,0 +1,80 @@
+#!/usr/bin/env python3
+"""Emit the gang (sf>1) fidelity trace from the calibrated CPU oracle.
+
+Mirrors the reference's multi-GPU trace mix (scheduler/utils.py:96-106
+scales jobs across 1/2/4/8 GPUs): two sf=2 gangs plus four sf=1 singles
+on a 2-chip worker force gang dispatch, consensus leases, the exit
+barrier, and gang preemption/redispatch cycles under max_min_fairness.
+
+Step budgets are sized from the measured deployed rates (steps =
+rate * target_runtime) so the trace's `duration` column matches each
+job's isolated runtime — the force-complete deadline (1.5x duration)
+then never fakes a completion.
+
+Usage:
+    python reproduce/fidelity/make_gang_trace.py \
+        [--oracle reproduce/fidelity/cpu_throughputs.json] \
+        [--output reproduce/fidelity/fidelity_cpu_gang.trace]
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, REPO)
+
+from shockwave_tpu.core.job import Job  # noqa: E402
+from shockwave_tpu.core.job_table import JOB_TABLE  # noqa: E402
+from shockwave_tpu.core.trace import job_to_trace_line  # noqa: E402
+
+# (family, scale_factor, target isolated runtime s, arrival s)
+MIX = [
+    ("ResNet-18 (batch size 32)", 2, 450, 0),
+    ("LM (batch size 20)", 1, 420, 20),
+    ("Recommendation (batch size 512)", 1, 420, 45),
+    ("LM (batch size 20)", 2, 400, 80),
+    ("Recommendation (batch size 512)", 1, 360, 120),
+    ("ResNet-18 (batch size 32)", 1, 400, 150),
+]
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--oracle",
+                   default=os.path.join(os.path.dirname(__file__),
+                                        "cpu_throughputs.json"))
+    p.add_argument("--worker_type", default="cpu")
+    p.add_argument("--output",
+                   default=os.path.join(os.path.dirname(__file__),
+                                        "fidelity_cpu_gang.trace"))
+    args = p.parse_args()
+
+    with open(args.oracle) as f:
+        rows = json.load(f)[args.worker_type]
+    by_model = {t.model: t for t in JOB_TABLE}
+
+    lines = []
+    for family, sf, runtime, arrival in MIX:
+        key = f"('{family}', {sf})"
+        if key not in rows:
+            raise SystemExit(
+                f"{key} missing from {args.oracle} — run "
+                f"scripts/profiling/measure_deployed.py --scale_factor {sf} "
+                f"first")
+        rate = rows[key]["null"]
+        steps = max(int(rate * runtime), sf)
+        t = by_model[family]
+        job = Job(None, family, t.command, t.working_directory,
+                  t.num_steps_arg, needs_data_dir=True, total_steps=steps,
+                  duration=runtime, scale_factor=sf, mode="static")
+        lines.append(job_to_trace_line(job, float(arrival)))
+
+    with open(args.output, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {args.output} ({len(lines)} jobs, "
+          f"{sum(1 for _, sf, _, _ in MIX if sf > 1)} gangs)")
+
+
+if __name__ == "__main__":
+    main()

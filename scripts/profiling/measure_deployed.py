@@ -76,13 +76,16 @@ def run_calibration(template, steps_per_job, duration, round_s, rounds,
 
     With scale_factor > 1 the two jobs are gangs (each needs all
     `num_chips` chips, so they alternate rounds exactly like the sf=1
-    calibration). With num_chips > scale_factor * 1 capacity the two
-    sf=1 jobs instead run CONCURRENTLY every round — the co-resident
-    regime a multi-chip loopback cluster puts same-round jobs in."""
+    calibration). With num_chips > scale_factor capacity, THREE sf=1
+    jobs rotate over the chips — the co-resident regime a multi-chip
+    loopback cluster puts same-round jobs in, with the odd job out
+    guaranteeing lease turnover every round (a 2-job variant extends
+    leases indefinitely and only records on chance chip swaps)."""
     ckpt = tempfile.mkdtemp(prefix="swtpu_deployed_")
+    concurrent = num_chips is not None and num_chips > scale_factor
     trace = os.path.join(ckpt, "cal.trace")
     with open(trace, "w") as f:
-        for _ in range(2):
+        for _ in range(3 if concurrent else 2):
             job = Job(None, template.model, template.command,
                       template.working_directory, template.num_steps_arg,
                       needs_data_dir=template.needs_data_dir,
@@ -179,10 +182,15 @@ def main():
                         "through the real dispatch path); writes "
                         "('Family', N) oracle rows")
     p.add_argument("--concurrent", action="store_true",
-                   help="calibrate the co-resident regime: 2 sf=1 jobs "
-                        "running EVERY round on a 2-chip worker (no "
-                        "preemption, so only rates are written — drains "
-                        "keep their preemption-cycle calibration)")
+                   help="calibrate the co-resident regime: 3 sf=1 jobs "
+                        "rotating over a 2-chip worker, so the running "
+                        "pair is co-resident and the odd job out forces "
+                        "lease turnover every round (only rates are "
+                        "written — drains keep their preemption-cycle "
+                        "calibration). "
+                        "OVERWRITES the ('family', 1) rate rows: point "
+                        "--oracle at a dedicated copy (multi-chip-on-one-"
+                        "host loopbacks), never at the main sf=1 oracle")
     args = p.parse_args()
     if args.concurrent and args.scale_factor != 1:
         p.error("--concurrent calibrates sf=1 co-residency")
@@ -246,20 +254,29 @@ def main():
         shortfall = max(
             args.round_duration - statistics.mean(lease_durs), 0.0)
         rows[f"('{family}', {sf})"] = {"null": round(tput, 4)}
-        if not args.concurrent:
+        if not args.concurrent and sf == 1:
             # lease_shortfall_s* keys are OWNED by this script (in-lease
             # shortfall via the real runtime); the spawn->exit proxy keys
             # (dispatch_overhead_s*) are owned by measure_startup.py. The
             # simulator prefers the shortfall when both are present
             # (sched/scheduler.py:_cold_dispatch_overhead). Concurrent
             # mode has no preemption cycle, so drains/shortfalls keep
-            # their preemption-cycle calibration.
+            # their preemption-cycle calibration; gang (sf>1) cycles
+            # have their own (longer) excess, which must not clobber the
+            # sf=1 calibration the committed artifacts are built on —
+            # it stays visible in deployed_calibration["sf=N"] detail.
             meta.setdefault("lease_shortfall_s_by_type", {}).setdefault(
                 args.worker_type, {})[family] = round(shortfall, 2)
             meta.setdefault("round_drain_s_by_type", {}).setdefault(
                 args.worker_type, {})[family] = round(drain, 2)
             drains.append(drain)
             shortfalls.append(shortfall)
+        elif not args.concurrent:
+            # Gang (sf>1) preemption cycles cost measurably more than
+            # sf=1 ones (2-process exit + rendezvous + redispatch); they
+            # go under a per-sf key the simulator prefers for sf>1 jobs,
+            # never clobbering the sf=1 calibration.
+            drains.append(drain)
         detail[family] = {
             "deployed_steps_per_s": round(tput, 4),
             "solo_steps_per_s": solo,
@@ -279,7 +296,12 @@ def main():
             statistics.mean(shortfalls), 2)
         meta.setdefault("round_drain_s", {})[args.worker_type] = round(
             statistics.mean(drains), 2)
-    mode = ("2 concurrent co-resident jobs (2-chip worker)"
+    elif drains and sf > 1:
+        meta.setdefault("round_drain_s_by_sf", {}).setdefault(
+            args.worker_type, {})[str(sf)] = round(
+            statistics.mean(drains), 2)
+    mode = ("3 jobs rotating over a 2-chip worker (co-resident pairs, "
+            "odd job out forces lease turnover)"
             if args.concurrent else
             f"2-job alternating loopback (sf={sf})")
     meta.setdefault("deployed_calibration", {}).setdefault(

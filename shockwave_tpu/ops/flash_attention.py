@@ -6,10 +6,15 @@ the f32 accumulator and running max/normalizer live in VMEM scratch
 across k-steps and the full (T x T) score matrix never materializes in
 HBM. Scores hit the MXU via `jnp.dot(..., preferred_element_type=f32)`.
 
-Backward recomputes the (m, l) softmax statistics and the attention
-probabilities blockwise with `lax.scan` in plain JAX — per-step
-transients are O(BH * Tq * block_k), never the full score matrix —
-using the standard flash-attention gradient formulas (Dao et al. '22).
+Backward is two Pallas kernels using the standard flash-attention
+gradient formulas (Dao et al. '22): a dq pass (k-blocks innermost, the
+forward's grid layout) and a dk/dv pass (q-blocks innermost), each
+accumulating in VMEM scratch. The forward emits a per-row logsumexp
+residual (`lse`, (BH, Tq, 8)-tiled) so the backward recovers
+p = exp(s - lse) without re-running the online softmax; every matmul
+runs bf16 operands with f32 accumulation to stay on the MXU's native
+path, and causal k/q-blocks past the diagonal skip their FLOPs in both
+passes.
 
 The single-chip complement to parallel/ring_attention.py (which shards
 the sequence across chips); the reference has no attention kernel at all
@@ -32,7 +37,7 @@ LANES = 128
 SUBLANES = 8  # f32 tile height: mask/bias operands pad to this
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, kbias_ref, o_ref, m_scr, l_scr,
+def _fa_kernel(q_ref, k_ref, v_ref, kbias_ref, o_ref, lse_ref, m_scr, l_scr,
                acc_scr, *, scale: float, causal: bool, block_q: int,
                block_k: int):
     qi = pl.program_id(1)
@@ -85,6 +90,12 @@ def _fa_kernel(q_ref, k_ref, v_ref, kbias_ref, o_ref, m_scr, l_scr,
     def _finish():
         l = l_scr[:, :1]  # (block_q, 1)
         o_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # Per-row logsumexp residual for the backward kernels: p can then
+        # be recovered as exp(s - lse) without re-running the online
+        # softmax. Stored (block_q, SUBLANES)-tiled — same broadcast
+        # pattern as the m/l scratch, no in-kernel transpose needed.
+        lse = m_scr[:, :1] + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 
 def _pad_axis(x, axis: int, to: int):
@@ -96,6 +107,16 @@ def _pad_axis(x, axis: int, to: int):
     return jnp.pad(x, widths)
 
 
+def _kbias(kv_mask, bh, tk):
+    """Mosaic requires operand blocks whose last two dims tile to (8, 128),
+    so the (BH, Tk) key mask travels as a (BH, SUBLANES, Tk) f32 additive
+    bias (0 = attend, NEG_INF = masked), replicated across sublanes —
+    shared by the forward and both backward kernels so the masking
+    encoding cannot drift between them."""
+    bias = jnp.where(kv_mask > 0, 0.0, NEG_INF).astype(jnp.float32)
+    return jnp.broadcast_to(bias[:, None, :], (bh, SUBLANES, tk))
+
+
 def _forward_impl(q, k, v, kv_mask, scale, causal, block_q, block_k,
                   interpret):
     """q: (BH, Tq, D); k,v: (BH, Tk, D); kv_mask: (BH, Tk) int8."""
@@ -104,17 +125,13 @@ def _forward_impl(q, k, v, kv_mask, scale, causal, block_q, block_k,
     nq, nk = tq // block_q, tk // block_k
     grid = (bh, nq, nk)
 
-    # Mosaic requires operand blocks whose last two dims tile to (8, 128),
-    # so the (BH, Tk) key mask travels as a (BH, SUBLANES, Tk) f32 additive
-    # bias (0 = attend, NEG_INF = masked), replicated across sublanes.
-    kbias = jnp.where(kv_mask > 0, 0.0, NEG_INF).astype(jnp.float32)
-    kbias = jnp.broadcast_to(kbias[:, None, :], (bh, SUBLANES, tk))
+    kbias = _kbias(kv_mask, bh, tk)
 
     kernel = functools.partial(
         _fa_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -127,9 +144,16 @@ def _forward_impl(q, k, v, kv_mask, scale, causal, block_q, block_k,
             pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (b, 0, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, SUBLANES), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, SUBLANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),  # running max
             pltpu.VMEM((block_q, LANES), jnp.float32),  # running normalizer
@@ -137,120 +161,219 @@ def _forward_impl(q, k, v, kv_mask, scale, causal, block_q, block_k,
         ],
         interpret=interpret,
     )(q, k, v, kbias)
-    return out
+    return out, lse
 
 
-def _blockwise_stats(q, k, kv_mask, scale, causal, block_k):
-    """Recompute per-row (m, l) softmax statistics with the same blocked
-    online-softmax recurrence as the forward kernel, so the transient is
-    O(BH * Tq * block_k), never the full score matrix."""
-    tq = q.shape[1]
-    tk = k.shape[1]
-    nk = tk // block_k
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, kbias_ref,
+               dq_ref, dq_scr, *, scale: float, causal: bool, block_q: int,
+               block_k: int):
+    """dQ: grid (BH, q-block, k-block), k innermost (forward's layout);
+    dq accumulates in VMEM scratch across k-steps."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    def per_bh(qb, kb, maskb):
-        kb_blocks = kb.reshape(nk, block_k, -1)
-        mask_blocks = maskb.reshape(nk, block_k)
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
 
-        def body(carry, blk):
-            m, l = carry
-            kj, maskj, j = blk
-            # Matmul in the storage dtype (bf16 on the MXU's native path)
-            # with f32 accumulation — an f32 x f32 matmul would run at a
-            # fraction of the bf16 MXU rate.
-            s = lax.dot_general(
-                qb, kj, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
-            if causal:
-                q_pos = lax.broadcasted_iota(jnp.int32, (tq, block_k), 0)
-                k_pos = j * block_k + lax.broadcasted_iota(
-                    jnp.int32, (tq, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-            s = jnp.where(maskj[None, :] > 0, s, NEG_INF)
-            m_new = jnp.maximum(m, jnp.max(s, axis=1))
-            l = l * jnp.exp(m - m_new) + jnp.sum(
-                jnp.exp(s - m_new[:, None]), axis=1)
-            return (m_new, l), None
-
-        (m, l), _ = lax.scan(
-            body,
-            (jnp.full((tq,), NEG_INF, jnp.float32),
-             jnp.zeros((tq,), jnp.float32)),
-            (kb_blocks, mask_blocks, jnp.arange(nk)))
-        return m, l
-
-    return jax.vmap(per_bh)(q, k, kv_mask)
-
-
-def _backward_impl(q, k, v, kv_mask, out, g, scale, causal, block_k):
-    """Flash-attention gradients by blockwise recompute (Dao et al.)."""
-    bh, t, d = q.shape
-    tk = k.shape[1]
+    should_run = True
     if causal:
-        assert q.shape[1] == k.shape[1], "causal requires Tq == Tk"
-    m, l = _blockwise_stats(q, k, kv_mask, scale, causal, block_k)
-    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
+        should_run = ki * block_k <= qi * block_q + (block_q - 1)
 
-    nk = tk // block_k
-    g16 = g.astype(q.dtype)  # matmul operand dtype; accumulation is f32
+    @pl.when(should_run)
+    def _step():
+        q = q_ref[0]                        # (block_q, d)
+        k = k_ref[0]                        # (block_k, d)
+        v = v_ref[0]
+        g = g_ref[0]                        # (block_q, d)
+        lse = lse_ref[0][:, :1]             # (block_q, 1) f32
+        delta = delta_ref[0][:, :1]         # (block_q, 1) f32
+        # bf16 operands + f32 accumulation on every matmul (the Dao et
+        # al. recipe): f32 x f32 would fall off the MXU's native path.
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = s + jnp.max(kbias_ref[0], axis=0, keepdims=True)
+        # Masked/causal-excluded entries sit at the NEG_INF floor; so does
+        # lse for a FULLY masked row (no visible key), where exp(s - lse)
+        # would become O(1) garbage that leaks into valid keys' dk/dv.
+        # Zero them explicitly (the standard flash backward guard).
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    def mm(a, b, contract):
-        # All backward matmuls run with storage-dtype (bf16) operands and
-        # f32 accumulation (the Dao et al. recipe): an f32 x f32 matmul
-        # would fall off the MXU's native bf16 path and dominate the
-        # training step (measured 12.9% -> see EXPERIMENTS.md for the
-        # compute-bound MFU this change recovers).
-        return lax.dot_general(a, b, (contract, ((), ())),
-                               preferred_element_type=jnp.float32)
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
-    def per_bh(qb, kb, vb, gb, mb, lb, db, maskb):
-        kb_blocks = kb.reshape(nk, block_k, d)
-        vb_blocks = vb.reshape(nk, block_k, d)
-        mask_blocks = maskb.reshape(nk, block_k)
 
-        def body(dq, blk):
-            kj, vj, maskj, j = blk
-            s = mm(qb, kj, ((1,), (1,))) * scale         # (T, block_k) f32
-            if causal:
-                q_pos = lax.broadcasted_iota(jnp.int32, (t, block_k), 0)
-                k_pos = j * block_k + lax.broadcasted_iota(
-                    jnp.int32, (t, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-            s = jnp.where(maskj[None, :] > 0, s, NEG_INF)
-            p = jnp.exp(s - mb[:, None]) / jnp.maximum(lb, 1e-30)[:, None]
-            dp = mm(gb, vj, ((1,), (1,)))                # (T, block_k) f32
-            ds = (p * (dp - db[:, None]) * scale).astype(qb.dtype)
-            p16 = p.astype(qb.dtype)
-            dq = dq + mm(ds, kj, ((1,), (0,)))
-            dkj = mm(ds, qb, ((0,), (0,)))               # (block_k, d) f32
-            dvj = mm(p16, gb, ((0,), (0,)))              # (block_k, d) f32
-            return dq, (dkj, dvj)
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, kbias_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                causal: bool, block_q: int, block_k: int):
+    """dK/dV: grid (BH, k-block, q-block), q innermost; dk/dv accumulate
+    in VMEM scratch across q-steps."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
 
-        dq, (dk_blocks, dv_blocks) = lax.scan(
-            body, jnp.zeros((t, d), jnp.float32),
-            (kb_blocks, vb_blocks, mask_blocks, jnp.arange(nk)))
-        return dq, dk_blocks.reshape(tk, d), dv_blocks.reshape(tk, d)
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    dq, dk, dv = jax.vmap(per_bh)(q, k, v, g16, m, l, delta, kv_mask)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    should_run = True
+    if causal:
+        # q-blocks strictly above the diagonal see none of this k-block.
+        should_run = ki * block_k <= qi * block_q + (block_q - 1)
+
+    @pl.when(should_run)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        g = g_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = s + jnp.max(kbias_ref[0], axis=0, keepdims=True)
+        # Same fully-masked-row guard as _dq_kernel.
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        dp = jax.lax.dot_general(
+            g, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        p16 = p.astype(q.dtype)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p16, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _backward_impl(q, k, v, kv_mask, out, lse, g, scale, causal, block_q,
+                   block_k, interpret):
+    """Flash-attention gradients as two Pallas kernels (Dao et al.): a dq
+    pass (k innermost, forward's grid layout) and a dk/dv pass (q
+    innermost), both reading the forward's per-row logsumexp residual
+    instead of re-running the online softmax."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq, nk = tq // block_q, tk // block_k
+    g16 = g.astype(q.dtype)
+
+    kbias = _kbias(kv_mask, bh, tk)
+    # delta_i = rowsum(dO_i * O_i), stored (BH, Tq, SUBLANES)-tiled like
+    # the lse residual so the kernels index both identically.
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[:, :, None], (bh, tq, SUBLANES))
+
+    def spec_q(index):
+        return pl.BlockSpec((1, block_q, d), index, memory_space=pltpu.VMEM)
+
+    def spec_k(index):
+        return pl.BlockSpec((1, block_k, d), index, memory_space=pltpu.VMEM)
+
+    def spec_row(index):
+        return pl.BlockSpec((1, block_q, SUBLANES), index,
+                            memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, nq, nk),
+        in_specs=[
+            spec_q(lambda b, i, j: (b, i, 0)),
+            spec_k(lambda b, i, j: (b, j, 0)),
+            spec_k(lambda b, i, j: (b, j, 0)),
+            spec_q(lambda b, i, j: (b, i, 0)),
+            spec_row(lambda b, i, j: (b, i, 0)),
+            spec_row(lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, SUBLANES, block_k), lambda b, i, j: (b, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=spec_q(lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g16, lse, delta, kbias)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, nk, nq),
+        in_specs=[
+            spec_q(lambda b, j, i: (b, i, 0)),
+            spec_k(lambda b, j, i: (b, j, 0)),
+            spec_k(lambda b, j, i: (b, j, 0)),
+            spec_q(lambda b, j, i: (b, i, 0)),
+            spec_row(lambda b, j, i: (b, i, 0)),
+            spec_row(lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, SUBLANES, block_k), lambda b, j, i: (b, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            spec_k(lambda b, j, i: (b, j, 0)),
+            spec_k(lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g16, lse, delta, kbias)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash_bhtd(q, k, v, kv_mask, scale, causal, block_q, block_k):
     interpret = jax.default_backend() != "tpu"
-    return _forward_impl(q, k, v, kv_mask, scale, causal, block_q, block_k,
-                         interpret)
+    out, _ = _forward_impl(q, k, v, kv_mask, scale, causal, block_q,
+                           block_k, interpret)
+    return out
 
 
 def _flash_bhtd_fwd(q, k, v, kv_mask, scale, causal, block_q, block_k):
-    out = _flash_bhtd(q, k, v, kv_mask, scale, causal, block_q, block_k)
-    return out, (q, k, v, kv_mask, out)
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _forward_impl(q, k, v, kv_mask, scale, causal, block_q,
+                             block_k, interpret)
+    return out, (q, k, v, kv_mask, out, lse)
 
 
 def _flash_bhtd_bwd(scale, causal, block_q, block_k, residuals, g):
-    q, k, v, kv_mask, out = residuals
-    dq, dk, dv = _backward_impl(q, k, v, kv_mask, out, g, scale, causal,
-                                block_k)
+    q, k, v, kv_mask, out, lse = residuals
+    interpret = jax.default_backend() != "tpu"
+    dq, dk, dv = _backward_impl(q, k, v, kv_mask, out, lse, g, scale,
+                                causal, block_q, block_k, interpret)
     return dq, dk, dv, None
 
 

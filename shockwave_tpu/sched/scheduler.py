@@ -175,6 +175,13 @@ class Scheduler:
         # it varies by family like the dispatch overhead does.
         self._round_drain_by_type = oracle_meta.get(
             "round_drain_s_by_type", {})
+        # Optional per-scale-factor drain ({worker_type: {"2": s}}):
+        # gang preemption cycles (multi-process exit + rendezvous +
+        # redispatch) cost measurably more than sf=1 ones, and must not
+        # clobber the sf=1 calibration — measured by
+        # measure_deployed.py --scale_factor N.
+        self._round_drain_by_sf = oracle_meta.get(
+            "round_drain_s_by_sf", {})
         # Deployment-faithful mode (any calibration present): the
         # physical round mechanism wall-clocks rounds — a job completing
         # mid-round leaves its worker idle until the boundary — so the
@@ -185,7 +192,8 @@ class Scheduler:
         self._deployment_faithful = bool(
             self._dispatch_overhead or self._dispatch_overhead_by_type
             or self._lease_shortfall or self._shortfall_by_type
-            or self._round_drain or self._round_drain_by_type)
+            or self._round_drain or self._round_drain_by_type
+            or self._round_drain_by_sf)
         self._sim_round_start: Optional[float] = None
         self._throughput_timeline: Dict[int, "collections.OrderedDict"] = {}
 
@@ -1451,7 +1459,8 @@ class Scheduler:
                 or worker_type in self._lease_shortfall
                 or worker_type in self._shortfall_by_type
                 or worker_type in self._round_drain
-                or worker_type in self._round_drain_by_type)
+                or worker_type in self._round_drain_by_type
+                or worker_type in self._round_drain_by_sf)
 
     def _per_type_max(self, by_type: Dict[str, float], job_id: JobIdPair):
         """Largest per-job-type calibration value among the pair's
@@ -1464,8 +1473,16 @@ class Scheduler:
         return max(typed) if typed else None
 
     def _cold_round_drain(self, worker_type: str, job_id: JobIdPair) -> float:
-        """Post-lease dead time for a cold dispatch of this job; per-type
-        measurement wins over the per-worker-type mean."""
+        """Post-lease dead time for a cold dispatch of this job. For
+        gangs (sf>1) a per-scale-factor measurement wins; otherwise the
+        per-type measurement wins over the per-worker-type mean."""
+        sf = max((self.acct.jobs[m].scale_factor
+                  for m in job_id.singletons() if m in self.acct.jobs),
+                 default=1)
+        if sf > 1:
+            by_sf = self._round_drain_by_sf.get(worker_type, {})
+            if str(sf) in by_sf:
+                return by_sf[str(sf)]
         typed = self._per_type_max(
             self._round_drain_by_type.get(worker_type, {}), job_id)
         if typed is not None:

@@ -68,6 +68,36 @@ class TestFlashAttention:
         for a, b in zip(g1, g2):
             assert float(jnp.max(jnp.abs(a - b))) < 5e-4
 
+    def test_fully_masked_row_leaks_no_gradient(self):
+        """causal + key 0 padded => query row 0 sees NO valid key. Its
+        backward contribution must be exactly zero: without the
+        p = where(s <= NEG_INF/2, 0, ...) guard, s and lse both sit at
+        the NEG_INF floor and exp(s - lse) injects O(1) garbage into
+        valid keys' dk/dv (measured up to 2.2 at multi-block grids)."""
+        rng = np.random.RandomState(5)
+        t = 128
+        q, k, v = rand_qkv(rng, 1, t, 2, 64)
+        kpm = jnp.ones((1, t), bool).at[0, 0].set(False)
+        row_ok = (jnp.arange(t) >= 1).astype(jnp.float32)
+
+        def flash_loss(q, k, v):
+            out = flash_attention(q, k, v, causal=True,
+                                  key_padding_mask=kpm,
+                                  block_q=32, block_k=32)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        def ref_loss_row0_excluded(q, k, v):
+            out = ref_attn(q, k, v, True, kpm).astype(jnp.float32)
+            return ((out * row_ok[None, :, None, None]) ** 2).sum()
+
+        g1 = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(ref_loss_row0_excluded, argnums=(0, 1, 2))(q, k, v)
+        # Row 0 contributes nothing anywhere; remaining grads match the
+        # reference with row 0 excluded from the loss.
+        assert float(jnp.max(jnp.abs(g1[0][0, 0]))) == 0.0
+        for a, b in zip(g1, g2):
+            assert float(jnp.max(jnp.abs(a - b))) < 5e-4
+
     def test_causal_cross_rejected(self):
         rng = np.random.RandomState(4)
         q, k, v = rand_qkv(rng, 1, 64, 2, 64, tk=128)
