@@ -190,6 +190,13 @@ def main():
         result.update(transformer_train_bench(
             batch=args.long_batch, steps=max(args.steps // 3, 5),
             seq=args.long_seq, prefix="transformer_long"))
+        # Same regime at a production long-context per-chip batch (4x the
+        # tokens): separates small-batch underutilization from kernel
+        # cost in the MFU number.
+        big = args.long_batch * 4
+        result.update(transformer_train_bench(
+            batch=big, steps=max(args.steps // 3, 5),
+            seq=args.long_seq, prefix=f"transformer_long_b{big}"))
     result.update(attention_bench())
 
     if args.save_dir:

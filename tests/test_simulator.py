@@ -194,6 +194,33 @@ class TestCalibratedDispatchOverhead:
         # One cold dispatch: the per-type 35 s drain shift, not 5 s.
         assert makespan == pytest.approx(base + 35.0, abs=2.0)
 
+    def test_per_sf_drain_wins_for_gangs(self, tmp_path):
+        """Gang (sf>1) cold dispatches charge the per-scale-factor drain
+        (measured ~3x the sf=1 cycle excess on the gang fidelity
+        artifact), never the sf=1 per-type/scalar calibration."""
+        with open(os.path.join(DATA, "tacc_throughputs.json")) as f:
+            oracle = json.load(f)
+        rate_sf2 = oracle["v100"]["('ResNet-18 (batch size 32)', 2)"]["null"]
+        oracle["__meta__"] = {
+            "dispatch_overhead_s": {"v100": 0.0},
+            "round_drain_s": {"v100": 5.0},
+            "round_drain_s_by_type": {
+                "v100": {"ResNet-18 (batch size 32)": 5.0}},
+            "round_drain_s_by_sf": {"v100": {"2": 40.0}}}
+        path = tmp_path / "oracle_drain_sf.json"
+        path.write_text(json.dumps(oracle))
+        steps = int(rate_sf2 * 300)
+        job = [make_job(total_steps=steps, scale_factor=2)]
+        policy = get_policy("max_min_fairness", seed=0)
+        sched = Scheduler(
+            policy, simulate=True, throughputs_file=str(path),
+            config=SchedulerConfig(time_per_iteration=120.0))
+        makespan = sched.simulate({"v100": 2}, [0.0], job)
+        _, base = run_sim([make_job(total_steps=steps, scale_factor=2)],
+                          [0.0], num_workers=2)
+        # One cold gang dispatch: the by-sf 40 s drain shift, not 5 s.
+        assert makespan == pytest.approx(base + 40.0, abs=2.0)
+
     def test_per_type_drain_alone_activates_faithful_mode(self, tmp_path):
         """A by-type-only drain calibration must still flip the
         simulator into deployment-faithful mode."""
