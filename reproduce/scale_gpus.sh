@@ -1,28 +1,37 @@
 #!/bin/bash
-# Scale microbenchmark: generated workloads on 64/128/256-chip simulated
-# clusters (reference: reproduce/scale_{64,128,256}gpus.sh; paper Fig 9).
-# Usage: reproduce/scale_gpus.sh <num_chips> [output_dir]
+# Scale study: the reference's shipped dynamic traces on 64/128/256-chip
+# simulated clusters (reference: reproduce/scale_{64,128,256}gpus.sh,
+# paper Fig 9 — 220/460/900-job staggered-arrival traces with per-scale
+# Shockwave hyperparameters; the traces and configs are declared copies
+# of the reference's inputs, the same provenance pattern as
+# data/canonical_120job.trace).
+# Usage: reproduce/scale_gpus.sh <64|128|256> [output_dir]
 # -e -o pipefail: a failed simulate must abort the script, or the
 # solve-quality gate below would happily validate a stale pickle from
 # an earlier run and exit 0.
 set -eu -o pipefail
 cd "$(dirname "$0")/.."
-CHIPS=${1:?usage: scale_gpus.sh <num_chips> [output_dir]}
+CHIPS=${1:?usage: scale_gpus.sh <64|128|256> [output_dir]}
 OUT=${2:-reproduce/pickles/scale_${CHIPS}}
-JOBS=$((CHIPS * 120 / 32))   # keep load proportional to the canonical run
+case "$CHIPS" in
+    64) TRACE=data/scale_220job.trace ;;
+    128) TRACE=data/scale_460job.trace ;;
+    256) TRACE=data/scale_900job.trace ;;
+    *) echo "unknown scale $CHIPS (64|128|256)"; exit 2 ;;
+esac
 mkdir -p "$OUT"
 
 for POLICY in shockwave max_min_fairness finish_time_fairness
 do
     echo "=== ${CHIPS} chips / $POLICY ==="
-    python3 scripts/drivers/simulate_generated.py \
-        --num_jobs "$JOBS" \
+    python3 scripts/drivers/simulate.py \
+        --trace "$TRACE" \
         --policy "$POLICY" \
         --throughputs data/tacc_throughputs.json \
         --cluster_spec "v100:${CHIPS}" \
         --round_duration 120 \
         --seed 0 \
-        --config configs/tacc_32gpus.json \
+        --config "configs/scale_${CHIPS}gpus.json" \
         --output "$OUT/${POLICY}.pkl" \
         | tee "$OUT/${POLICY}.json"
 done

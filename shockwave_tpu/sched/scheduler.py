@@ -229,6 +229,17 @@ class Scheduler:
             from ..shockwave.planner import ShockwavePlanner
             sw = dict(self._config.shockwave or {})
             sw.setdefault("time_per_iteration", self._time_per_iteration)
+            if not simulate:
+                # solver_budget_cap_rounds is simulation-only: a physical
+                # round loop must never stall on a hard MILP instance, so
+                # the per-solve bound is clamped to the half-round default
+                # regardless of what the config ships.
+                cap = sw.get("solver_budget_cap_rounds", 0.5)
+                if cap > 0.5:
+                    self.log.warning(
+                        "clamping solver_budget_cap_rounds %.2f -> 0.5 "
+                        "(physical mode)", cap)
+                    sw["solver_budget_cap_rounds"] = 0.5
             self._shockwave_planner = ShockwavePlanner.from_config(sw)
         self._scheduled_jobs_in_current_round: Optional[List[int]] = None
         self._scheduled_jobs_in_prev_round: Optional[List[int]] = None

@@ -50,6 +50,13 @@ class MilpOptions:
     lam: float = 12.0
     logapx_bases: Sequence[float] = (0.0, 0.2, 0.4, 0.6, 0.8, 1.0)
     logapx_origin: float = 1e-6
+    # Per-solve wall budget bound, in round-durations. 0.5 keeps a hard
+    # instance from stalling a PHYSICAL round loop; pure simulation can
+    # raise it (config key "solver_budget_cap_rounds") — at 900 jobs the
+    # single-threaded half-round budget is 6x less solver compute than
+    # the reference's 15 s x 24 Gurobi threads and measurably starves
+    # incumbent quality (gap 6.8e-2, no-incumbent greedy fallbacks).
+    budget_cap_rounds: float = 0.5
 
 
 @dataclass
@@ -236,12 +243,15 @@ def plan_schedule(jobs, round_index: int, future_nrounds: int,
     # grow with the boolean count or large instances (hundreds of jobs)
     # time out with no incumbent at all. Canonical-scale problems
     # (<= 120 jobs) keep the reference budget exactly. Budgets stay
-    # bounded by the round duration so a hard instance can never stall
-    # the physical round loop for multiple rounds: per-solve at most
-    # round/2, the one no-incumbent retry at most one full round.
+    # bounded by budget_cap_rounds round-durations per solve (2x that
+    # for the one no-incumbent retry); at the 0.5 default — which
+    # physical mode enforces (sched/scheduler.py clamps the config) — a
+    # hard instance can never stall the round loop beyond half a round
+    # per solve / one full round for the retry.
     timeout_scale = max(1.0, njobs / 120.0)
-    solve_budget = min(opts.timeout * timeout_scale, round_duration / 2.0)
-    retry_budget = min(4.0 * solve_budget, round_duration)
+    cap = round_duration * opts.budget_cap_rounds
+    solve_budget = min(opts.timeout * timeout_scale, cap)
+    retry_budget = min(4.0 * solve_budget, 2.0 * cap)
     scale = solve_budget / opts.timeout
 
     # -- first attempt: with FTF constraints ------------------------------

@@ -47,6 +47,7 @@ class ShockwavePlanner:
             lam=config.get("lambda", 12.0),
             logapx_bases=tuple(config.get(
                 "log_approximation_bases", (0.0, 0.2, 0.4, 0.6, 0.8, 1.0))),
+            budget_cap_rounds=config.get("solver_budget_cap_rounds", 0.5),
         )
         return cls(
             ngpus=config["num_gpus"],

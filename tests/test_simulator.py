@@ -452,6 +452,24 @@ class TestMultiChipJobs:
             assert len(round_sched) <= 1
 
 
+class TestSolverBudgetCap:
+    def test_cap_clamped_in_physical_mode(self):
+        """solver_budget_cap_rounds is simulation-only: a physical round
+        loop must never stall on a hard MILP instance, so the scheduler
+        clamps any larger configured cap back to the 0.5 default."""
+        cfg = SchedulerConfig(
+            time_per_iteration=120.0,
+            shockwave={"num_gpus": 4, "solver_budget_cap_rounds": 2.0})
+        sim = Scheduler(get_policy("shockwave", seed=0), simulate=True,
+                        throughputs_file=os.path.join(
+                            DATA, "tacc_throughputs.json"), config=cfg)
+        assert sim._shockwave_planner.opts.budget_cap_rounds == 2.0
+        phys = Scheduler(get_policy("shockwave", seed=0), simulate=False,
+                         throughputs_file=os.path.join(
+                             DATA, "tacc_throughputs.json"), config=cfg)
+        assert phys._shockwave_planner.opts.budget_cap_rounds == 0.5
+
+
 class TestPackedScheduleRecording:
     def test_pair_dispatches_recorded_as_tuple_keys(self):
         # Two same-type jobs on one worker under a packing policy: the
