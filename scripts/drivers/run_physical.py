@@ -71,6 +71,20 @@ def main():
                    help="seconds a freshly dispatched job may stay silent "
                         "before it can be killed (slow relayed-TPU "
                         "backend init; 0 disables)")
+    # Fault-tolerance knobs (defaults recorded in
+    # configs/fault_tolerance.json; see README "Failure model & recovery").
+    p.add_argument("--heartbeat_interval", type=float, default=10.0,
+                   help="worker liveness monitor cadence in seconds "
+                        "(0 disables the monitor)")
+    p.add_argument("--worker_timeout", type=float, default=30.0,
+                   help="seconds of worker silence before an active Ping "
+                        "probe is sent")
+    p.add_argument("--probe_failures", type=int, default=2,
+                   help="consecutive failed probes before a worker is "
+                        "declared dead and its jobs are requeued")
+    p.add_argument("--kill_wait", type=float, default=30.0,
+                   help="seconds _kill_job waits for the worker to confirm "
+                        "before synthesizing a zero-step completion")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args()
 
@@ -102,7 +116,11 @@ def main():
             max_rounds=args.max_rounds, shockwave=shockwave_config,
             watchdog_interval=args.watchdog,
             job_completion_buffer_s=args.completion_buffer,
-            first_init_grace_s=args.first_init_grace))
+            first_init_grace_s=args.first_init_grace,
+            heartbeat_interval_s=args.heartbeat_interval,
+            worker_timeout_s=args.worker_timeout,
+            worker_probe_failures=args.probe_failures,
+            kill_wait_s=args.kill_wait))
 
     start_time = time.time()
     submitter = threading.Thread(

@@ -341,6 +341,13 @@ class Trainer:
             self.state = jax.device_put(restored, self.repl_sharding)
         start_step = int(self.state["step"])
         budget = args.num_steps
+        if use_lease and budget is not None and start_step >= budget:
+            # Checkpoint is ahead of the scheduler's accounting (previous
+            # worker died post-checkpoint, pre-report): reconcile instead
+            # of exiting (0, 0) — the micro-task-failure signal — which
+            # would burn a failure attempt every round until the job is
+            # dropped despite being fully trained.
+            iterator.report_checkpoint_ahead()
 
         monitor = None
         if self.mode == "accordion" and self.initial_bs:

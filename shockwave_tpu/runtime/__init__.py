@@ -1,3 +1,6 @@
 from .lease import Lease
+from .resilience import (CircuitBreaker, CircuitOpenError, RetryPolicy,
+                         RpcUnavailableError)
 
-__all__ = ["Lease"]
+__all__ = ["Lease", "RetryPolicy", "CircuitBreaker", "RpcUnavailableError",
+           "CircuitOpenError"]

@@ -1,5 +1,13 @@
 """gRPC clients for all three control-plane directions
-(reference: runtime/rpc/{scheduler_client,worker_client,iterator_client}.py)."""
+(reference: runtime/rpc/{scheduler_client,worker_client,iterator_client}.py).
+
+Every call carries a deadline and rides the resilience layer
+(`resilience.py`): bounded exponential-backoff retry on transport
+failures, and — for the scheduler->worker direction — a circuit breaker
+per worker channel so one dead worker fails fast instead of costing
+every round a full retry budget. No call in this module can block
+indefinitely.
+"""
 from __future__ import annotations
 
 import logging
@@ -8,32 +16,72 @@ from typing import List, Optional, Sequence, Tuple
 import grpc
 
 from .proto import control_pb2 as pb
+from .resilience import (CircuitBreaker, RetryPolicy, call_with_retry,
+                         policy_from_env)
 from .rpc import Stub
 
 logger = logging.getLogger("shockwave_tpu.runtime")
+
+#: Scheduler -> worker: short deadlines — the scheduler holds its round
+#: lock across dispatch, so a dead worker must surface fast.
+WORKER_RPC_POLICY = RetryPolicy(deadline_s=10.0, total_budget_s=25.0,
+                                max_attempts=3)
+#: Worker/iterator -> scheduler: more patient (the scheduler may be
+#: solving a MILP), but still bounded.
+SCHED_RPC_POLICY = RetryPolicy(deadline_s=30.0, total_budget_s=90.0,
+                               max_attempts=4)
 
 
 class SchedulerToWorkerClient:
     """Scheduler -> one worker daemon."""
 
-    def __init__(self, addr: str, port: int):
+    def __init__(self, addr: str, port: int,
+                 policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.addr = addr
         self.port = port
+        self._policy = policy or WORKER_RPC_POLICY
+        self.breaker = breaker or CircuitBreaker()
         self._channel = grpc.insecure_channel(f"{addr}:{port}")
         self._stub = Stub(self._channel, "shockwave_tpu.SchedulerToWorker")
+
+    def _call(self, method: str, request, policy: Optional[RetryPolicy] = None):
+        return call_with_retry(
+            getattr(self._stub, method), request,
+            method=f"worker {self.addr}:{self.port}/{method}",
+            policy=policy or self._policy, breaker=self.breaker)
 
     def run_job(self, job_descriptions: Sequence[dict], worker_id: int,
                 round_id: int) -> None:
         request = pb.RunJobRequest(
             jobs=[pb.JobDescription(**d) for d in job_descriptions],
             worker_id=worker_id, round_id=round_id)
-        self._stub.RunJob(request)
+        self._call("RunJob", request)
 
-    def kill_job(self, job_id: int) -> None:
-        self._stub.KillJob(pb.KillJobRequest(job_id=job_id))
+    def kill_job(self, job_id: int, deadline_s: Optional[float] = None) -> None:
+        """With `deadline_s`, a single bounded attempt — for best-effort
+        kills issued under the scheduler lock, where the full retry
+        budget would stall the round pipeline."""
+        policy = None
+        if deadline_s is not None:
+            from dataclasses import replace
+            policy = replace(self._policy.one_shot(), deadline_s=deadline_s,
+                             total_budget_s=deadline_s)
+        self._call("KillJob", pb.KillJobRequest(job_id=job_id), policy=policy)
 
     def reset(self) -> None:
-        self._stub.Reset(pb.Empty())
+        self._call("Reset", pb.Empty())
+
+    def ping(self, deadline_s: Optional[float] = None) -> None:
+        """Single-attempt liveness probe; raises RpcUnavailableError (or
+        CircuitOpenError) on failure. The heartbeat monitor owns the
+        retry cadence, so no client-side retries here."""
+        policy = self._policy.one_shot()
+        if deadline_s is not None:
+            from dataclasses import replace
+            policy = replace(policy, deadline_s=deadline_s,
+                             total_budget_s=deadline_s)
+        self._call("Ping", pb.Empty(), policy=policy)
 
     def shutdown(self) -> None:
         try:
@@ -41,19 +89,39 @@ class SchedulerToWorkerClient:
         except grpc.RpcError:
             pass  # worker may exit before replying
 
+    def close(self) -> None:
+        self._channel.close()
+
 
 class WorkerToSchedulerClient:
     """Worker daemon -> scheduler."""
 
-    def __init__(self, sched_addr: str, sched_port: int):
+    def __init__(self, sched_addr: str, sched_port: int,
+                 policy: Optional[RetryPolicy] = None):
+        self._policy = policy or policy_from_env(SCHED_RPC_POLICY)
+        self._done_policy = self._policy
         self._channel = grpc.insecure_channel(f"{sched_addr}:{sched_port}")
         self._stub = Stub(self._channel, "shockwave_tpu.WorkerToScheduler")
 
+    def stretch_done_deadline(self, min_deadline_s: float) -> None:
+        """Raise Done's deadline floor. The scheduler's Done handler
+        legitimately blocks an early finisher until the round boundary,
+        so the deadline must cover a full round — the daemon calls this
+        once the round duration is known (at registration)."""
+        from dataclasses import replace
+        if min_deadline_s > self._done_policy.deadline_s:
+            self._done_policy = replace(
+                self._done_policy, deadline_s=min_deadline_s,
+                total_budget_s=max(self._done_policy.total_budget_s,
+                                   min_deadline_s * 1.5))
+
     def register_worker(self, worker_type: str, ip_addr: str, port: int,
                         num_chips: int) -> Tuple[List[int], float]:
+        # Single attempt with a deadline: the daemon's bring-up loop owns
+        # registration retries (with its own, much longer window).
         response = self._stub.RegisterWorker(pb.RegisterWorkerRequest(
             worker_type=worker_type, ip_addr=ip_addr, port=port,
-            num_chips=num_chips))
+            num_chips=num_chips), timeout=self._policy.deadline_s)
         if not response.success:
             raise RuntimeError(response.error_message)
         return list(response.worker_ids), response.round_duration
@@ -61,44 +129,58 @@ class WorkerToSchedulerClient:
     def notify_done(self, job_ids: Sequence[int], worker_id: int,
                     num_steps: Sequence[int], execution_times: Sequence[float],
                     iterator_logs: Optional[Sequence[str]] = None) -> None:
-        self._stub.Done(pb.DoneRequest(
-            job_ids=list(job_ids), worker_id=worker_id,
-            num_steps=[int(s) for s in num_steps],
-            execution_times=list(execution_times),
-            iterator_logs=list(iterator_logs or [])))
+        # Done is not idempotent (the scheduler aggregates each report
+        # into step accounting), so only connection-level failures are
+        # retried: a deadline expiry may mean the server is still
+        # processing attempt 1, and replaying would double-count.
+        call_with_retry(
+            self._stub.Done,
+            pb.DoneRequest(
+                job_ids=list(job_ids), worker_id=worker_id,
+                num_steps=[int(s) for s in num_steps],
+                execution_times=list(execution_times),
+                iterator_logs=list(iterator_logs or [])),
+            method="scheduler/Done", policy=self._done_policy,
+            retryable=frozenset({grpc.StatusCode.UNAVAILABLE}))
 
 
 class IteratorToSchedulerClient:
     """Training process (lease iterator) -> scheduler. A fresh channel per
-    call keeps the client robust to scheduler restarts, as in the reference."""
+    call keeps the client robust to scheduler restarts, as in the reference;
+    deadlines + bounded retry keep a dead scheduler from hanging the
+    training process inside a lease renewal."""
 
     def __init__(self, job_id: int, worker_id: int, sched_addr: str,
-                 sched_port: int):
+                 sched_port: int, policy: Optional[RetryPolicy] = None):
         self._job_id = job_id
         self._worker_id = worker_id
         self._target = f"{sched_addr}:{sched_port}"
+        self._policy = policy or policy_from_env(SCHED_RPC_POLICY)
 
     def _stub(self, channel):
         return Stub(channel, "shockwave_tpu.IteratorToScheduler")
 
-    def init(self) -> Tuple[int, float, float]:
+    def _call(self, method: str, request):
         with grpc.insecure_channel(self._target) as channel:
-            r = self._stub(channel).InitJob(pb.InitJobRequest(
-                job_id=self._job_id, worker_id=self._worker_id))
-            return r.max_steps, r.max_duration, r.extra_time
+            return call_with_retry(
+                getattr(self._stub(channel), method), request,
+                method=f"scheduler/{method}", policy=self._policy)
+
+    def init(self) -> Tuple[int, float, float]:
+        r = self._call("InitJob", pb.InitJobRequest(
+            job_id=self._job_id, worker_id=self._worker_id))
+        return r.max_steps, r.max_duration, r.extra_time
 
     def update_lease(self, steps: int, duration: float, max_steps: int,
                      max_duration: float) -> Tuple[int, float, float, float]:
-        with grpc.insecure_channel(self._target) as channel:
-            r = self._stub(channel).UpdateLease(pb.UpdateLeaseRequest(
-                job_id=self._job_id, worker_id=self._worker_id,
-                steps=int(steps), duration=duration, max_steps=int(max_steps),
-                max_duration=max_duration))
-            return r.max_steps, r.max_duration, r.run_time_so_far, r.deadline
+        r = self._call("UpdateLease", pb.UpdateLeaseRequest(
+            job_id=self._job_id, worker_id=self._worker_id,
+            steps=int(steps), duration=duration, max_steps=int(max_steps),
+            max_duration=max_duration))
+        return r.max_steps, r.max_duration, r.run_time_so_far, r.deadline
 
     def update_resource_requirement(self, big_bs: bool, small_bs: bool) -> None:
-        with grpc.insecure_channel(self._target) as channel:
-            self._stub(channel).UpdateResourceRequirement(
-                pb.UpdateResourceRequirementRequest(
-                    job_id=self._job_id, worker_id=self._worker_id,
-                    big_bs=big_bs, small_bs=small_bs))
+        self._call("UpdateResourceRequirement",
+                   pb.UpdateResourceRequirementRequest(
+                       job_id=self._job_id, worker_id=self._worker_id,
+                       big_bs=big_bs, small_bs=small_bs))

@@ -24,6 +24,11 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+import grpc
+
+from . import faults
+from .resilience import RpcUnavailableError
+
 logger = logging.getLogger("shockwave_tpu.runtime")
 
 _PROGRESS_RE = {
@@ -51,6 +56,11 @@ class Dispatcher:
         self._processes: Dict[int, subprocess.Popen] = {}  # job_id -> proc
         self._pool = []
         self._shutdown = threading.Event()
+        # RunJob is delivered at-least-once (the scheduler retries on
+        # UNAVAILABLE, which gRPC can return even after the handler ran):
+        # remember accepted (job_ids, worker_id, round_id) triples so a
+        # replay cannot spawn a second trainer for the same micro-task.
+        self._accepted_dispatches: Dict[tuple, int] = {}  # key -> round_id
 
     # -- command construction ---------------------------------------------
 
@@ -90,6 +100,15 @@ class Dispatcher:
             "JAX_VISIBLE_DEVICES": str(chip_id),
             "TPU_VISIBLE_CHIPS": str(chip_id),
         })
+        # RPC deadline for the job's lease iterator: InitJob can
+        # legitimately block at the scheduler until the round boundary
+        # (early dispatch), so the deadline must cover a full round —
+        # and the total retry budget must cover the deadline, or the
+        # first expiry would exhaust it and no retry would ever run.
+        # Operator-set values win.
+        deadline = max(60.0, 2 * self._round_duration + 60.0)
+        env.setdefault("SWTPU_RPC_DEADLINE_S", str(deadline))
+        env.setdefault("SWTPU_RPC_BUDGET_S", str(1.5 * deadline))
         return env
 
     # -- progress scraping -------------------------------------------------
@@ -114,6 +133,19 @@ class Dispatcher:
     # -- dispatch ----------------------------------------------------------
 
     def dispatch_jobs(self, jobs: List[dict], worker_id: int, round_id: int):
+        key = (tuple(j["job_id"] for j in jobs), worker_id, round_id)
+        with self._lock:
+            if key in self._accepted_dispatches:
+                logger.warning("dropping duplicate RunJob %s (retry of an "
+                               "already-accepted dispatch)", key)
+                return
+            self._accepted_dispatches[key] = round_id
+            # Bounded memory: anything two rounds stale can no longer be
+            # replayed (the scheduler's retry budget is well under two
+            # rounds).
+            for old in [k for k, r in self._accepted_dispatches.items()
+                        if r < round_id - 2]:
+                del self._accepted_dispatches[old]
         thread = threading.Thread(
             target=self._dispatch_jobs_helper, args=(jobs, worker_id, round_id),
             daemon=True)
@@ -126,6 +158,14 @@ class Dispatcher:
         results = []
         try:
             for job in jobs:
+                if faults.get_injector().should_freeze("dispatch"):
+                    # Injected wedge: hold the chip, launch nothing,
+                    # report nothing — exactly what a hung process looks
+                    # like to the scheduler's watchdogs.
+                    logger.warning("[job %d] frozen by fault injection",
+                                   job["job_id"])
+                    self._shutdown.wait()
+                    return
                 command = self._construct_command(job, chip_id, worker_id)
                 env = self._job_env(job, worker_id, round_id, chip_id)
                 cwd = self._run_dirs.get(job["mode"], ".")
@@ -160,11 +200,20 @@ class Dispatcher:
                 results.append((job["job_id"], steps, duration, iterator_log))
         finally:
             self._chip_queue.put(chip_id)
-        self._worker_rpc_client.notify_done(
-            job_ids=[r[0] for r in results], worker_id=worker_id,
-            num_steps=[r[1] for r in results],
-            execution_times=[r[2] for r in results],
-            iterator_logs=[r[3] for r in results])
+        try:
+            self._worker_rpc_client.notify_done(
+                job_ids=[r[0] for r in results], worker_id=worker_id,
+                num_steps=[r[1] for r in results],
+                execution_times=[r[2] for r in results],
+                iterator_logs=[r[3] for r in results])
+        except (RpcUnavailableError, grpc.RpcError) as e:
+            # The scheduler stayed unreachable through the retry budget.
+            # Progress is durable in the iterator log / checkpoint; the
+            # scheduler's round watchdog synthesizes a failed micro-task
+            # and requeues the job, so dropping the report is safe — and
+            # far better than a dispatch thread wedged forever.
+            logger.error("dropping Done report for jobs %s (round %d): %s",
+                         [r[0] for r in results], round_id, e)
 
     # -- control -----------------------------------------------------------
 
@@ -193,6 +242,23 @@ class Dispatcher:
                         os.killpg(pgid, signal.SIGKILL)
                     except ProcessLookupError:
                         pass
+                    return
+                # The group leader exited, but a forked helper (data
+                # loader) may have ignored SIGTERM and still hold the
+                # chip. Probe the group: killpg(pgid, 0) succeeds iff
+                # members remain (the leader's exit is known, so the
+                # pgid cannot have been recycled while the group lives —
+                # a pgid persists until its last member dies).
+                try:
+                    os.killpg(pgid, 0)
+                except ProcessLookupError:
+                    return  # whole group gone: clean exit
+                logger.warning("job %d leader exited but group %d has "
+                               "survivors; SIGKILL group", job_id, pgid)
+                try:
+                    os.killpg(pgid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
 
             # Escalate off-thread: the KillJob RPC handler (and with it the
             # scheduler's _kill_job, which holds its condition variable

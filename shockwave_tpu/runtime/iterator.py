@@ -289,6 +289,25 @@ class LeaseIterator:
             self._write_info()
         self._logger.info("", extra={"event": "LEASE", "status": "COMPLETE"})
 
+    def report_checkpoint_ahead(self) -> None:
+        """The restored checkpoint already satisfies the job's FULL step
+        budget although this dispatch ran 0 steps: the previous worker
+        died after the checkpoint was saved but before its progress
+        report reached the scheduler (the failed-in-round synthesis
+        reports 0 steps). The scheduler's missing delta is exactly what
+        it granted this dispatch (remaining = total - its own count), so
+        reporting the initial lease grant reconverges its accounting
+        with the durable checkpoint — instead of exiting (0, 0), the
+        micro-task-failure signal, every round until the job is dropped.
+        """
+        self._steps = int(self._lease.max_steps)
+        self._duration = max(self._duration, time.time() - self._prev_time,
+                             1e-3)
+        self._done = True
+        self._logger.info(
+            "checkpoint already at budget; reporting granted remainder %d",
+            self._steps, extra={"event": "LEASE", "status": "CKPT_AHEAD"})
+
     def update_resource_requirement(self, big_bs: bool, small_bs: bool) -> None:
         """Report a batch-size change request; job must checkpoint + exit."""
         self._done = True

@@ -1,0 +1,236 @@
+"""RPC resilience: per-call deadlines, bounded retry, circuit breakers.
+
+Every control-plane RPC in this runtime used to block indefinitely on a
+dead peer: a worker crash mid-round left the scheduler's dispatch (or a
+training job's lease renewal) hung inside a deadline-less gRPC call, and
+`_end_round` never regained liveness. This module is the single place
+that policy lives:
+
+- `RetryPolicy`: per-attempt deadline + bounded exponential backoff over
+  a total wall-clock budget. Backoff is deterministic (no jitter) so
+  fault-injection tests can assert exact return-time bounds.
+- `CircuitBreaker`: per-peer-channel failure counter. After
+  `failure_threshold` consecutive transport failures the circuit opens
+  and calls fail fast (`CircuitOpenError`) for `reset_timeout_s`; the
+  first call after that window is a half-open probe whose outcome closes
+  or re-opens the circuit. This keeps a dead worker from costing every
+  scheduler round a full retry budget.
+- `call_with_retry`: drives a gRPC callable under a policy + breaker.
+
+Only transport-level status codes (UNAVAILABLE, DEADLINE_EXCEEDED) are
+retried and counted against the breaker; any other status means the peer
+is alive and the error is the caller's to handle.
+
+Knobs are also readable from the environment (`SWTPU_RPC_*`) so the
+job-side lease iterator — which has no config object — gets deadlines
+too (see `policy_from_env`).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, replace
+
+import grpc
+
+logger = logging.getLogger("shockwave_tpu.runtime")
+
+#: Transport-level failures: the peer may be dead or unreachable. Anything
+#: else (INVALID_ARGUMENT, INTERNAL, ...) proves the peer answered.
+RETRYABLE_CODES = frozenset({
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+})
+
+
+def is_retryable(error: Exception) -> bool:
+    return (isinstance(error, grpc.RpcError)
+            and error.code() in RETRYABLE_CODES)
+
+
+class RpcUnavailableError(RuntimeError):
+    """The peer stayed unreachable through the whole retry budget."""
+
+    def __init__(self, method: str, attempts: int, last_code=None):
+        super().__init__(
+            f"{method} unreachable after {attempts} attempt(s)"
+            f" (last status: {last_code})")
+        self.method = method
+        self.attempts = attempts
+        self.last_code = last_code
+
+
+class CircuitOpenError(RpcUnavailableError):
+    """Failed fast: the peer's circuit breaker is open."""
+
+    def __init__(self, method: str):
+        RuntimeError.__init__(self, f"{method}: circuit open (peer presumed dead)")
+        self.method = method
+        self.attempts = 0
+        self.last_code = None
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    #: gRPC deadline applied to every individual attempt.
+    deadline_s: float = 20.0
+    #: Wall-clock budget across all attempts (including backoff sleeps).
+    total_budget_s: float = 60.0
+    max_attempts: int = 4
+    backoff_base_s: float = 0.25
+    backoff_multiplier: float = 2.0
+    backoff_max_s: float = 5.0
+
+    def backoff(self, attempt: int) -> float:
+        """Deterministic bounded exponential backoff before attempt N+1."""
+        return min(self.backoff_base_s * self.backoff_multiplier ** attempt,
+                   self.backoff_max_s)
+
+    def one_shot(self) -> "RetryPolicy":
+        """Same deadline, no retries — for liveness probes, where the
+        monitor loop owns the retry cadence."""
+        return replace(self, max_attempts=1, total_budget_s=self.deadline_s)
+
+
+def policy_from_env(default: RetryPolicy = RetryPolicy()) -> RetryPolicy:
+    """RetryPolicy with `SWTPU_RPC_*` environment overrides (the
+    dispatcher exports these into training processes, so the lease
+    iterator inherits the cluster's RPC budget without a config file)."""
+
+    def _f(name, fallback):
+        raw = os.environ.get(name)
+        if raw is None or raw == "":
+            return fallback
+        try:
+            return float(raw)
+        except ValueError:
+            logger.warning("ignoring non-numeric %s=%r", name, raw)
+            return fallback
+
+    deadline_s = _f("SWTPU_RPC_DEADLINE_S", default.deadline_s)
+    total_budget_s = _f("SWTPU_RPC_BUDGET_S", default.total_budget_s)
+    # Invariant: the budget covers at least one full-deadline attempt
+    # plus a retry window — otherwise a raised deadline (e.g. the
+    # dispatcher's round-scaled export) would silently disable retries.
+    total_budget_s = max(total_budget_s, 1.5 * deadline_s)
+    return replace(
+        default,
+        deadline_s=deadline_s,
+        total_budget_s=total_budget_s,
+        max_attempts=int(_f("SWTPU_RPC_RETRIES", default.max_attempts)),
+        backoff_base_s=_f("SWTPU_RPC_BACKOFF_S", default.backoff_base_s),
+    )
+
+
+class CircuitBreaker:
+    """Consecutive-transport-failure circuit for one peer channel.
+
+    closed -> (failure_threshold consecutive failures) -> open
+    open   -> (reset_timeout_s elapsed) -> half-open: one probe call
+    half-open -> success -> closed | failure -> open again
+    """
+
+    def __init__(self, failure_threshold: int = 3, reset_timeout_s: float = 10.0,
+                 clock=time.monotonic):
+        self.failure_threshold = max(int(failure_threshold), 1)
+        self.reset_timeout_s = reset_timeout_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._consecutive_failures = 0
+        self._opened_at: float | None = None
+        self._half_open_probe_inflight = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_at is None:
+                return "closed"
+            if self._clock() - self._opened_at >= self.reset_timeout_s:
+                return "half-open"
+            return "open"
+
+    def allow(self) -> bool:
+        """Whether a call may proceed; in half-open, admits one probe."""
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if self._clock() - self._opened_at < self.reset_timeout_s:
+                return False
+            if self._half_open_probe_inflight:
+                return False
+            self._half_open_probe_inflight = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._opened_at = None
+            self._half_open_probe_inflight = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            self._half_open_probe_inflight = False
+            if (self._consecutive_failures >= self.failure_threshold
+                    or self._opened_at is not None):
+                # A half-open probe failure re-opens immediately; restart
+                # the reset window from now.
+                self._opened_at = self._clock()
+
+
+def call_with_retry(callable_, request, *, method: str,
+                    policy: RetryPolicy,
+                    breaker: CircuitBreaker | None = None,
+                    retryable=RETRYABLE_CODES,
+                    clock=time.monotonic, sleep=time.sleep):
+    """Invoke a gRPC unary callable under deadline/retry/breaker policy.
+
+    Raises `CircuitOpenError` without touching the network when the
+    breaker is open, and `RpcUnavailableError` once the retry budget is
+    exhausted; non-retryable RpcErrors propagate unchanged (the peer is
+    alive — its answer is the caller's business).
+
+    `retryable` narrows which status codes are retried: non-idempotent
+    calls (e.g. Done, whose handler blocks on the round boundary) pass
+    {UNAVAILABLE} only, so a deadline expiry — where the server may
+    still be processing the first attempt — is never replayed.
+    """
+    start = clock()
+    last_code = None
+    attempt = 0
+    while True:
+        if breaker is not None and not breaker.allow():
+            raise CircuitOpenError(method)
+        remaining = policy.total_budget_s - (clock() - start)
+        if attempt > 0 and remaining <= 0:
+            raise RpcUnavailableError(method, attempt, last_code)
+        deadline = (min(policy.deadline_s, remaining) if attempt > 0
+                    else policy.deadline_s)
+        try:
+            response = callable_(request, timeout=max(deadline, 0.001))
+        except grpc.RpcError as e:
+            if not (isinstance(e, grpc.RpcError) and e.code() in retryable):
+                # The peer ANSWERED (application-level error): transport
+                # is healthy, so close the breaker — critically, this
+                # also releases a half-open probe slot, which would
+                # otherwise leak and wedge the circuit open forever.
+                if breaker is not None:
+                    breaker.record_success()
+                raise
+            last_code = e.code()
+            attempt += 1
+            if breaker is not None:
+                breaker.record_failure()
+            backoff = policy.backoff(attempt - 1)
+            out_of_budget = (clock() - start) + backoff >= policy.total_budget_s
+            if attempt >= policy.max_attempts or out_of_budget:
+                raise RpcUnavailableError(method, attempt, last_code) from e
+            logger.debug("%s attempt %d failed (%s); retrying in %.2fs",
+                         method, attempt, last_code, backoff)
+            sleep(backoff)
+            continue
+        if breaker is not None:
+            breaker.record_success()
+        return response

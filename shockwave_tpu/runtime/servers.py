@@ -124,6 +124,14 @@ def serve_worker(port: int, callbacks: Dict[str, Callable],
         callbacks["Shutdown"]()
         return pb.Empty()
 
+    def ping(request, context):
+        # Liveness probe: answering at all is the signal. An optional
+        # callback lets the daemon surface health state in the future.
+        cb = callbacks.get("Ping")
+        if cb is not None:
+            cb()
+        return pb.Empty()
+
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers((
         generic_handler("shockwave_tpu.SchedulerToWorker", {
@@ -131,6 +139,7 @@ def serve_worker(port: int, callbacks: Dict[str, Callable],
             "KillJob": kill_job,
             "Reset": reset,
             "Shutdown": shutdown,
+            "Ping": ping,
         }),
     ))
     server.add_insecure_port(f"[::]:{port}")

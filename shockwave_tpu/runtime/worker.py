@@ -18,6 +18,7 @@ import time
 
 import grpc
 
+from . import resilience
 from .clients import WorkerToSchedulerClient
 from .dispatcher import Dispatcher
 from .servers import get_host_ip, serve_worker
@@ -63,7 +64,10 @@ class WorkerDaemon:
                     port=worker_port, num_chips=num_chips)
                 break
             except grpc.RpcError as e:
-                if (e.code() != grpc.StatusCode.UNAVAILABLE
+                # Registration now carries a per-attempt deadline, so a
+                # stalled (not just absent) scheduler surfaces as
+                # DEADLINE_EXCEEDED — retry both transport codes.
+                if (not resilience.is_retryable(e)
                         or time.monotonic() >= deadline):
                     # Don't leave the control server listening on a
                     # half-constructed daemon (its handlers dereference
@@ -76,6 +80,9 @@ class WorkerDaemon:
         logger.info("registered %d chips as workers %s (round %.0fs)",
                     num_chips, worker_ids, round_duration)
         self._worker_ids = worker_ids
+        # Done may legitimately block at the scheduler until the round
+        # boundary (early finisher); its deadline must cover a round.
+        self._rpc_client.stretch_done_deadline(round_duration + 60.0)
 
         os.makedirs(checkpoint_dir, exist_ok=True)
         self._dispatcher = Dispatcher(

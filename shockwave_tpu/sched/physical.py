@@ -6,7 +6,12 @@
   round, extend leases when placements repeat, dispatch the next round
   early, and enforce round completion with watchdog events,
 - the lease protocol callbacks (init / renew / consensus for multi-chip
-  gangs) and failure handling (kill unresponsive jobs)
+  gangs) and failure handling (kill unresponsive jobs),
+- worker liveness: heartbeats piggybacked on Done/UpdateLease plus an
+  active Ping probe; a dead worker's chips leave the schedulable pool,
+  its in-round jobs are failed-in-round and requeued (so `_end_round`
+  never blocks on a crashed daemon), and a rejoining daemon revives its
+  old chip ids via an idempotent RegisterWorker
 (reference: scheduler/scheduler.py:2382-2777, 3880-4339).
 """
 from __future__ import annotations
@@ -20,10 +25,16 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+import grpc
+
 from ..core.job import JobIdPair
+from ..runtime.resilience import RpcUnavailableError
 from .scheduler import DEADLINE_SLACK, INFINITY, Scheduler, SchedulerConfig
 
 logger = logging.getLogger("shockwave_tpu.sched")
+
+#: Errors meaning "the worker daemon is unreachable" on a control RPC.
+WORKER_RPC_ERRORS = (RpcUnavailableError, grpc.RpcError)
 
 SCHEDULE_RECOMPUTE_FRACTION = 0.5
 JOB_COMPLETION_BUFFER_TIME = 60.0
@@ -57,9 +68,35 @@ class PhysicalScheduler(Scheduler):
         self._expected_num_workers = expected_num_workers
 
         self._worker_connections: Dict[int, object] = {}
+        # Host endpoint (ip, port) -> {worker_type, num_chips, worker_ids,
+        # client, probe_failures}: the unit of liveness (one daemon serves
+        # all its chips) and the key for idempotent re-registration.
+        self._worker_hosts: Dict[Tuple[str, int], dict] = {}
         self._available_workers: "queue.Queue[int]" = queue.Queue()
         self._lease_update_requests: Dict[JobIdPair, list] = {}
         self._last_heartbeat: Dict[JobIdPair, float] = {}
+        # Consecutive heartbeat-freshness kill deferrals per job, cleared
+        # on dispatch and on done — bounds the _kill_job re-arm loop.
+        self._kill_rearm_counts: Dict[JobIdPair, int] = {}
+        # Per-(job, worker) dispatch sequence numbers and the sequence a
+        # Done was last accepted for. Each dispatch gets a fresh number
+        # from a monotonic counter (NOT wall clock — an NTP step must
+        # not flip the comparison and wedge completions); a report is
+        # accepted only if its dispatch's number has not been consumed
+        # yet. Rejects at-least-once retry duplicates (gRPC can return
+        # UNAVAILABLE after the server processed the request, and a
+        # replay would double-count steps) and late real reports landing
+        # after a synthesized completion. Early dispatch to the SAME
+        # worker only happens once the round's Done was processed
+        # (extended-lease rule), so a legitimate report can never be
+        # rejected by this ordering.
+        self._dispatch_stamp: Dict[Tuple[JobIdPair, int], int] = {}
+        self._done_stamp: Dict[Tuple[JobIdPair, int], int] = {}
+        self._dispatch_seq = 0
+        # Jobs whose failure counter was pre-decremented for a synthesized
+        # failed-in-round completion this dispatch (see
+        # _fail_jobs_on_dead_workers); cleared on the next dispatch.
+        self._failure_compensated: set = set()
         # Jobs that have reached at least one RPC since their LATEST
         # dispatch — only these may be unresponsive-killed before the
         # first-init grace expires (see SchedulerConfig.first_init_grace_s).
@@ -87,6 +124,8 @@ class PhysicalScheduler(Scheduler):
 
         if policy.name != "shockwave":
             threading.Thread(target=self._allocation_thread, daemon=True).start()
+        if self._config.heartbeat_interval_s:
+            threading.Thread(target=self._liveness_loop, daemon=True).start()
 
     # ------------------------------------------------------------------
     # Time / threading
@@ -112,21 +151,285 @@ class PhysicalScheduler(Scheduler):
             self._ever_signaled.discard(m)
             self._lease_update_requests.pop(m, None)
             self._max_steps_consensus.pop(m, None)
+            self._kill_rearm_counts.pop(m, None)
+        self._failure_compensated.discard(job_id)
+        # job_id is always a singleton here (to_remove members); keep it
+        # as the receiver — overlaps_with requires a single-id receiver
+        # and k[0] may be a packed pair.
+        for key in [k for k in (set(self._dispatch_stamp)
+                                | set(self._done_stamp))
+                    if job_id.overlaps_with(k[0])]:
+            self._dispatch_stamp.pop(key, None)
+            self._done_stamp.pop(key, None)
 
     # ------------------------------------------------------------------
     # RPC callbacks
     # ------------------------------------------------------------------
 
     def _register_worker_rpc(self, worker_type, num_chips, ip_addr, port):
+        """Register a worker host — idempotently. A daemon re-registering
+        from an endpoint we already know (crash/restart, or a retry whose
+        first response was lost) gets its ORIGINAL chip ids back, revived
+        into capacity with a fresh channel, instead of ghost-duplicating
+        the host's chips."""
         from ..runtime.clients import SchedulerToWorkerClient
-        client = SchedulerToWorkerClient(ip_addr, port)
         with self._cv:
+            key = (ip_addr, port)
+            host = self._worker_hosts.get(key)
+            if host is not None:
+                if (host["worker_type"] == worker_type
+                        and host["num_chips"] == num_chips):
+                    return (self._revive_worker_host(key),
+                            self._time_per_iteration)
+                # Same endpoint, different shape: retire the old
+                # incarnation and register fresh below.
+                self.log.warning(
+                    "re-registration from %s:%d changed shape (%s x%d -> "
+                    "%s x%d); retiring old worker ids %s", ip_addr, port,
+                    host["worker_type"], host["num_chips"], worker_type,
+                    num_chips, host["worker_ids"])
+                self._retire_worker_host(key)
+                self._close_host_client(host)
+                del self._worker_hosts[key]
+            client = SchedulerToWorkerClient(ip_addr, port)
             worker_ids, round_duration = self.register_worker(
                 worker_type, num_chips)
+            now = self.get_current_timestamp()
             for worker_id in worker_ids:
                 self._worker_connections[worker_id] = client
+                self.workers.last_seen[worker_id] = now
+            self._worker_hosts[key] = dict(
+                worker_type=worker_type, num_chips=num_chips,
+                worker_ids=list(worker_ids), client=client,
+                probe_failures=0)
             self._cv.notify_all()
         return worker_ids, round_duration
+
+    def _revive_worker_host(self, key) -> List[int]:
+        """Re-admit a known host (rejoin after death, daemon restart, or a
+        duplicate register retry). Must hold the lock."""
+        host = self._worker_hosts[key]
+        ids = host["worker_ids"]
+        if any(i not in self.workers.dead for i in ids):
+            # Re-register from a host we still considered live: the
+            # daemon restarted (losing its dispatch state), so anything
+            # in flight there is gone — fail it in-round first.
+            self._retire_worker_host(key)
+        from ..runtime.clients import SchedulerToWorkerClient
+        self._close_host_client(host)
+        client = SchedulerToWorkerClient(*key)
+        self.revive_workers(ids, host["worker_type"])
+        now = self.get_current_timestamp()
+        for worker_id in ids:
+            self._worker_connections[worker_id] = client
+            self.workers.last_seen[worker_id] = now
+        host["client"] = client
+        host["probe_failures"] = 0
+        self._cv.notify_all()
+        return list(ids)
+
+    @staticmethod
+    def _close_host_client(host) -> None:
+        """Close a replaced client's channel — on preemptible capacity
+        worker churn is routine, and each unclosed channel leaks sockets
+        plus reconnect polling to a dead endpoint in the long-lived
+        scheduler process."""
+        old = host.get("client")
+        if old is not None and hasattr(old, "close"):
+            try:
+                old.close()
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                pass
+
+    # ------------------------------------------------------------------
+    # Worker liveness
+    # ------------------------------------------------------------------
+
+    def _liveness_loop(self):
+        """Monitor thread: piggybacked heartbeats cover the common case;
+        a host silent past worker_timeout_s gets an active Ping with a
+        short deadline, and worker_probe_failures consecutive misses
+        retire it."""
+        interval = self._config.heartbeat_interval_s
+        while not self._done_event.wait(interval):
+            try:
+                self._probe_workers()
+            except Exception:  # noqa: BLE001 - monitor must never die
+                self.log.exception("liveness monitor iteration failed")
+
+    def _probe_workers(self):
+        now = self.get_current_timestamp()
+        with self._lock:
+            stale, dead = [], []
+            for key, host in self._worker_hosts.items():
+                live = [i for i in host["worker_ids"]
+                        if i not in self.workers.dead]
+                if not live:
+                    # Fully-retired host: keep probing. A transient
+                    # network partition retires a healthy daemon that
+                    # will never re-register (it registers once, at
+                    # startup) — the heal must restore its capacity.
+                    dead.append((key, host))
+                    continue
+                last = max(self.workers.last_seen.get(i, 0.0) for i in live)
+                if now - last >= self._config.worker_timeout_s:
+                    stale.append((key, host))
+        for key, host in stale + dead:
+            retired = (key, host) in dead
+            try:
+                # Probe outside the lock: the deadline bounds it, but the
+                # round pipeline must not stall behind a probe. The
+                # client's circuit breaker rate-limits probes to a
+                # retired host to one half-open attempt per reset window.
+                host["client"].ping(
+                    deadline_s=self._config.worker_probe_deadline_s)
+            except WORKER_RPC_ERRORS:
+                if retired:
+                    continue  # still dead
+                with self._cv:
+                    if host is not self._worker_hosts.get(key):
+                        continue  # re-registered while we probed
+                    host["probe_failures"] += 1
+                    self.log.warning(
+                        "worker %s:%d missed probe %d/%d", key[0], key[1],
+                        host["probe_failures"],
+                        self._config.worker_probe_failures)
+                    if (host["probe_failures"]
+                            >= self._config.worker_probe_failures):
+                        self._retire_worker_host(key)
+            else:
+                with self._cv:
+                    if host is not self._worker_hosts.get(key):
+                        continue
+                    if retired:
+                        self.log.warning(
+                            "retired worker %s:%d answered a probe "
+                            "(partition healed); reviving", key[0], key[1])
+                        self._revive_worker_host(key)
+                        continue
+                    host["probe_failures"] = 0
+                    stamp = self.get_current_timestamp()
+                    for i in host["worker_ids"]:
+                        if i not in self.workers.dead:
+                            self.workers.last_seen[i] = stamp
+
+    def _retire_worker_host(self, key) -> None:
+        """Declare a host dead: pull its chips from capacity, fail its
+        in-round micro-tasks (requeue), and prune it from the next
+        round's plan. Must hold the lock; notifies round waiters."""
+        host = self._worker_hosts.get(key)
+        if host is None:
+            return
+        dead_ids = [i for i in host["worker_ids"]
+                    if i not in self.workers.dead]
+        if not dead_ids:
+            return
+        self.log.warning("worker %s:%d presumed dead; retiring chips %s",
+                         key[0], key[1], dead_ids)
+        self.deregister_workers(dead_ids)
+        for worker_id in dead_ids:
+            self._remove_available_worker(worker_id)
+        self._fail_jobs_on_dead_workers(set(dead_ids))
+        self._cv.notify_all()
+
+    def _retire_worker_by_id(self, worker_id: int) -> None:
+        """Retire the host that owns `worker_id` (dispatch-failure path).
+        Must hold the lock."""
+        for key, host in self._worker_hosts.items():
+            if worker_id in host["worker_ids"]:
+                self._retire_worker_host(key)
+                return
+        # No host record (unit tests wire connections directly): still
+        # pull the single chip and fail its jobs.
+        self.deregister_workers([worker_id])
+        self._remove_available_worker(worker_id)
+        self._fail_jobs_on_dead_workers({worker_id})
+        self._cv.notify_all()
+
+    def _fail_jobs_on_dead_workers(self, dead_ids: set) -> None:
+        """Mark every micro-task scheduled on a dead chip failed-in-round
+        (synthesized zero-step done, so `_end_round` completes and the
+        job is requeued by the next allocation), and drop dead chips
+        from the next round's plan. Must hold the lock."""
+        if self.rounds.next_assignments is not None:
+            for job_id in [j for j, w in self.rounds.next_assignments.items()
+                           if set(w) & dead_ids]:
+                planned_ids = self.rounds.next_assignments[job_id]
+                del self.rounds.next_assignments[job_id]
+                self._redispatch_assignments.pop(job_id, None)
+                self.rounds.extended_leases.discard(job_id)
+                # An early-dispatched gang may already be LAUNCHED on the
+                # surviving hosts; once pruned from the assignment maps
+                # no watchdog covers those ranks, and orphans blocked in
+                # gang rendezvous would hold their chips and wedge every
+                # later dispatch queued behind them. Kill them now.
+                for worker_id in planned_ids:
+                    if worker_id in dead_ids or worker_id in self.workers.dead:
+                        continue
+                    client = self._worker_connections.get(worker_id)
+                    if client is None:
+                        continue
+                    for m in job_id.singletons():
+                        try:
+                            # One short attempt: this best-effort kill
+                            # runs under the scheduler lock, and a full
+                            # retry budget here would stall the round
+                            # pipeline behind an unresponsive host.
+                            client.kill_job(
+                                m.integer_job_id(),
+                                deadline_s=self._config
+                                .worker_probe_deadline_s)
+                        except WORKER_RPC_ERRORS:
+                            break  # that host is failing too; probe reaps it
+        for job_id, worker_ids in list(self.rounds.current_assignments.items()):
+            dead_members = [w for w in worker_ids if w in dead_ids]
+            if not dead_members or job_id in self.rounds.completed_in_round:
+                continue
+            if not any(m in self.acct.jobs for m in job_id.singletons()):
+                continue
+            reported = {u[0] for u in self._in_progress_updates.get(job_id, [])}
+            missing = [w for w in dead_members if w not in reported]
+            if not missing:
+                continue
+            self.log.warning(
+                "[Worker failed] job %s lost chips %s mid-round; marking "
+                "failed-in-round and requeuing", job_id, missing)
+            # The crash is the WORKER's fault: pre-decrement the job's
+            # failure counter so the synthesized zero-step micro-task's
+            # +1 nets to zero and worker churn can never drop an
+            # innocent job via MAX_FAILED_ATTEMPTS. Pre-decrement (not
+            # post-restore): the increment may land NOW (sf=1 aggregate
+            # completes inside this synthesis) or LATER (a gang's
+            # surviving members report afterwards), and a post-restore
+            # would miss the late case — and could even miss the job
+            # entirely if the +1 pushed it over the threshold and
+            # removed it before any restore ran. The decrement may go
+            # transiently negative (count 0 -> -1): the pending +1
+            # brings it back to 0, and the only other readers are the
+            # >= MAX_FAILED_ATTEMPTS check and the success-path reset
+            # to 0, both safe against a negative. Compensated at most
+            # ONCE per job per failed round (_failure_compensated,
+            # cleared on dispatch): a gang spanning two hosts that die
+            # in separate retirement events still triggers only one +1
+            # when its aggregate finally completes. Pairs are skipped —
+            # the failure path never increments pair keys.
+            if (not job_id.is_pair()
+                    and job_id in self.acct.failures
+                    and job_id not in self._failure_compensated):
+                self._failure_compensated.add(job_id)
+                self.acct.failures[job_id] -= 1
+            zeros = [0 for _ in job_id.singletons()]
+            for worker_id in missing:
+                self.done_callback(job_id, worker_id, zeros, zeros)
+            # done_callback returns chips to the available pool; dead
+            # ones must not go back.
+            for worker_id in missing:
+                self._remove_available_worker(worker_id)
+            for m in job_id.singletons():
+                if m.integer_job_id() in self._job_timelines:
+                    self._job_timelines[m.integer_job_id()].append(
+                        f"t={self.get_current_timestamp():.1f} "
+                        f"WORKER_FAILED chips={missing} requeued")
 
     def _init_job_callback(self, job_id: JobIdPair):
         """Grant the initial lease (reference: scheduler.py:3880-4048)."""
@@ -187,6 +490,17 @@ class PhysicalScheduler(Scheduler):
         with self._lock:
             if job_id not in self.acct.jobs:
                 return (0, 0.0, 0.0, 0.0)
+            if worker_id in self.workers.dead:
+                # Orphaned trainer: its daemon's host was retired and the
+                # job requeued (possibly already re-running elsewhere),
+                # but the training process outlived the daemon (its own
+                # session) and cannot be killed through the dead daemon.
+                # Grant a zero lease so it checkpoints and exits instead
+                # of racing the redispatched copy — and keep it out of
+                # the gang consensus slots below.
+                self.log.warning("expiring lease of orphaned job %s on "
+                                 "dead worker %d", job_id, worker_id)
+                return (0, 0.0, 0.0, 0.0)
             job = self.acct.jobs[job_id]
             run_time_so_far = int(
                 sum(self.acct.run_time_per_worker[job_id].values())
@@ -198,6 +512,11 @@ class PhysicalScheduler(Scheduler):
                 (steps, duration, max_steps, max_duration))
             self._last_heartbeat[job_id] = self.get_current_timestamp()
             self._ever_signaled.add(job_id)
+            # Piggybacked worker heartbeat: the renewal proves the chip's
+            # host is alive (dead ids excluded above).
+            if worker_id in self.workers.id_to_type:
+                self.workers.last_seen[worker_id] = (
+                    self.get_current_timestamp())
 
             scale_factor = job.scale_factor
             remaining = int(math.ceil(
@@ -250,9 +569,26 @@ class PhysicalScheduler(Scheduler):
                 self._bs_flags[job_id]["small_bs"] = True
             self._cv.notify_all()
 
+    def _is_duplicate_done(self, job_id: JobIdPair, worker_id: int) -> bool:
+        """True when this (job, worker) already had a report accepted for
+        its latest dispatch (see _dispatch_stamp)."""
+        dispatched = self._dispatch_stamp.get((job_id, worker_id))
+        accepted = self._done_stamp.get((job_id, worker_id))
+        return (dispatched is not None and accepted is not None
+                and accepted == dispatched)
+
     def done_callback(self, job_id, worker_id, all_num_steps,
                       all_execution_times, iterator_logs=None):
         with self._cv:
+            # Duplicate guard, checked BEFORE the boundary wait (an
+            # at-least-once retry must be rejected now, not parked until
+            # the round rolls, where it would race the next dispatch's
+            # stamp) and re-checked after it (concurrent original +
+            # retry both entering pre-acceptance).
+            if self._is_duplicate_done(job_id, worker_id):
+                self.log.warning("discarding duplicate completion for job "
+                               "%s from worker %d", job_id, worker_id)
+                return
             # If the job was dispatched for round r+1 and finished before
             # round r closed, wait for the round boundary.
             while (job_id not in self.rounds.current_assignments
@@ -265,11 +601,29 @@ class PhysicalScheduler(Scheduler):
                     return
                 self._cv.wait()
 
+            if self._is_duplicate_done(job_id, worker_id):
+                self.log.warning("discarding duplicate completion for job "
+                               "%s from worker %d", job_id, worker_id)
+                return
+            # Consume this dispatch's sequence number (0 = accepted with
+            # no recorded dispatch: direct-call/unit paths stay open).
+            self._done_stamp[(job_id, worker_id)] = (
+                self._dispatch_stamp.get((job_id, worker_id), 0))
+
             for m in job_id.singletons():
                 if m in self.acct.jobs:
                     self.acct.latest_timestamps[m] = self.get_current_timestamp()
                     self._last_heartbeat[m] = self.get_current_timestamp()
                     self._ever_signaled.add(m)
+                self._kill_rearm_counts.pop(m, None)
+            # The deferral counter is keyed by the assignment combo (a
+            # pair for packed jobs) — clear that key too.
+            self._kill_rearm_counts.pop(job_id, None)
+            # Piggybacked worker heartbeat (synthesized dones for dead
+            # chips are not stamped — id is no longer in last_seen).
+            if worker_id in self.workers.last_seen:
+                self.workers.last_seen[worker_id] = (
+                    self.get_current_timestamp())
             self._available_workers.put(worker_id)
 
             timer = self._completion_events.pop(job_id, None)
@@ -371,6 +725,17 @@ class PhysicalScheduler(Scheduler):
             # imports + jit compile all happen before the first RPC.
             self._last_heartbeat[m] = self.get_current_timestamp()
             self._ever_signaled.discard(m)  # cold spawn: init grace re-arms
+            self._kill_rearm_counts.pop(m, None)  # fresh deferral budget
+        self._kill_rearm_counts.pop(job_id, None)  # combo key (packed pair)
+        self._failure_compensated.discard(job_id)
+        # Stamp EVERY rank before any RPC: if rank k's dispatch fails,
+        # the synthesized failed-in-round completions cover all ranks —
+        # including ranks > k that were never reached — and an unstamped
+        # rank's synthesis would be rejected as a duplicate of the
+        # previous dispatch's accepted report, wedging the round.
+        for worker_id in worker_ids:
+            self._dispatch_seq += 1
+            self._dispatch_stamp[(job_id, worker_id)] = self._dispatch_seq
         for rank, worker_id in enumerate(worker_ids):
             descriptions = []
             for m in job_id.singletons():
@@ -388,10 +753,72 @@ class PhysicalScheduler(Scheduler):
                     needs_data_dir=job.needs_data_dir,
                     num_steps_arg=job.num_steps_arg,
                     num_steps=job.total_steps, mode=job.mode))
-            self._worker_connections[worker_id].run_job(
-                descriptions, worker_id, round_id)
+            try:
+                self._worker_connections[worker_id].run_job(
+                    descriptions, worker_id, round_id)
+            except WORKER_RPC_ERRORS as e:
+                if isinstance(e, RpcUnavailableError):
+                    # Graceful degradation: the worker is unreachable
+                    # (retry budget exhausted or circuit open). Retire
+                    # its host — which fails this job in-round / prunes
+                    # it from the next plan so it requeues.
+                    self.log.warning("dispatch of job %s to worker %d "
+                                     "failed (%s); retiring its host",
+                                     job_id, worker_id, e)
+                    self._retire_worker_by_id(worker_id)
+                else:
+                    # Application-level rejection: the daemon ANSWERED
+                    # (e.g. its RunJob handler raised). The host is
+                    # healthy — retiring it would fail every other job
+                    # there and flap capacity — so fail only THIS job's
+                    # round and charge it the attempt (persistent bad
+                    # dispatches are dropped via MAX_FAILED_ATTEMPTS).
+                    self.log.error("worker %d rejected dispatch of job %s "
+                                   "(%s); failing it in-round", worker_id,
+                                   job_id, e)
+                    self._fail_dispatch_in_round(job_id, worker_ids,
+                                                 next_round)
+                # Either way, kill the ranks already dispatched to live
+                # workers: once the job leaves the assignment maps no
+                # watchdog covers them, and an orphan blocked in gang
+                # rendezvous would hold its chip and stall every later
+                # dispatch queued behind it.
+                for dispatched_id in worker_ids[:rank]:
+                    client = self._worker_connections.get(dispatched_id)
+                    if client is None or dispatched_id in self.workers.dead:
+                        continue
+                    for m in job_id.singletons():
+                        try:
+                            # One short attempt (lock held; see above).
+                            client.kill_job(
+                                m.integer_job_id(),
+                                deadline_s=self._config
+                                .worker_probe_deadline_s)
+                        except WORKER_RPC_ERRORS:
+                            break  # host unreachable too; probe reaps it
+                return
             if not next_round:
                 self._remove_available_worker(worker_id)
+
+    def _fail_dispatch_in_round(self, job_id: JobIdPair, worker_ids,
+                                next_round: bool) -> None:
+        """Fail one job's round after a rejected dispatch, leaving its
+        (healthy) host in service. Must hold the lock."""
+        if next_round:
+            if (self.rounds.next_assignments is not None
+                    and job_id in self.rounds.next_assignments):
+                del self.rounds.next_assignments[job_id]
+            self._redispatch_assignments.pop(job_id, None)
+            self.rounds.extended_leases.discard(job_id)
+            return
+        if (job_id not in self.rounds.current_assignments
+                or job_id in self.rounds.completed_in_round):
+            return
+        reported = {u[0] for u in self._in_progress_updates.get(job_id, [])}
+        zeros = [0 for _ in job_id.singletons()]
+        for worker_id in worker_ids:
+            if worker_id not in reported:
+                self.done_callback(job_id, worker_id, zeros, zeros)
 
     def _remove_available_worker(self, worker_id):
         try:
@@ -414,7 +841,9 @@ class PhysicalScheduler(Scheduler):
             for m in job_id.singletons():
                 self._lease_update_requests[m] = []
                 self._max_steps_consensus[m] = None
-        for job_id, worker_ids in self._redispatch_assignments.items():
+        # list(): a dispatch failure retires the worker's host, which may
+        # prune entries from this very dict.
+        for job_id, worker_ids in list(self._redispatch_assignments.items()):
             if any(m in self.acct.jobs for m in job_id.singletons()):
                 self.log.info("re-dispatching early-finished job %s", job_id)
                 self._try_dispatch_job(job_id, worker_ids)
@@ -450,7 +879,11 @@ class PhysicalScheduler(Scheduler):
             else:
                 self.rounds.extended_leases.discard(job_id)
 
-        for job_id, worker_ids in self.rounds.next_assignments.items():
+        # list(): a dispatch failure retires the worker's host, which
+        # prunes that host's entries from next_assignments.
+        for job_id, worker_ids in list(self.rounds.next_assignments.items()):
+            if job_id not in self.rounds.next_assignments:
+                continue  # pruned by a dead-worker retirement above
             if not any(m in self.acct.jobs for m in job_id.singletons()):
                 continue
             if (job_id not in self.rounds.extended_leases
@@ -487,12 +920,16 @@ class PhysicalScheduler(Scheduler):
             job_id for job_id in self.rounds.current_assignments
             if any(m in self.acct.jobs for m in job_id.singletons())}
         while not jobs_to_complete.issubset(self.rounds.completed_in_round):
-            self._cv.wait()
+            # Bounded wait: completion normally arrives with a notify
+            # (done callback, watchdog, or dead-worker retirement), but
+            # round liveness must not hinge on never missing one.
+            self._cv.wait(timeout=5.0)
 
         for job_id in list(self.rounds.extended_leases):
             if job_id in self.acct.jobs:
                 for worker_id in self.rounds.current_assignments[job_id]:
-                    self._available_workers.put(worker_id)
+                    if worker_id not in self.workers.dead:
+                        self._available_workers.put(worker_id)
             self.rounds.extended_leases.discard(job_id)
 
         if not self._is_final_round():
@@ -552,30 +989,56 @@ class PhysicalScheduler(Scheduler):
                     return
             # A job that signaled moments ago (e.g. its first InitJob landed
             # just before the re-armed grace timer fired) is alive and mid-
-            # checkpoint, not unresponsive: give it one short re-arm window
-            # instead of killing it seconds after its first RPC.
+            # checkpoint, not unresponsive: give it a short re-arm window
+            # instead of killing it seconds after its first RPC. The
+            # deferrals are CAPPED per dispatch (counter cleared on
+            # dispatch/done): a job that keeps renewing its lease but
+            # never honors expiry would otherwise re-arm forever and hold
+            # _end_round hostage.
             now = self.get_current_timestamp()
+            freshness = (self._config.kill_heartbeat_freshness_s
+                         if self._config.kill_heartbeat_freshness_s
+                         is not None else KILL_HEARTBEAT_FRESHNESS_S)
             youngest = max((self._last_heartbeat.get(m, 0.0)
                             for m in job_id.singletons()), default=0.0)
-            if now - youngest < KILL_HEARTBEAT_FRESHNESS_S:
-                timer = threading.Timer(KILL_HEARTBEAT_FRESHNESS_S,
-                                        self._kill_job, args=(job_id,))
+            rearms = self._kill_rearm_counts.get(job_id, 0)
+            if (now - youngest < freshness
+                    and rearms < self._config.max_kill_rearms):
+                self._kill_rearm_counts[job_id] = rearms + 1
+                timer = threading.Timer(freshness, self._kill_job,
+                                        args=(job_id,))
                 timer.daemon = True
                 timer.start()
                 self._completion_events[job_id] = timer
                 return
+            if rearms >= self._config.max_kill_rearms:
+                self.log.warning(
+                    "job %s exhausted %d freshness deferrals; killing "
+                    "despite recent heartbeat", job_id, rearms)
             self.log.warning("killing unresponsive job %s", job_id)
             worker_ids = self.rounds.current_assignments[job_id]
+            self._kill_rearm_counts.pop(job_id, None)
             servers = set()
             for worker_id in worker_ids:
-                client = self._worker_connections[worker_id]
+                client = self._worker_connections.get(worker_id)
+                if client is None or worker_id in self.workers.dead:
+                    continue
                 if (client.addr, client.port) not in servers:
                     for m in job_id.singletons():
-                        client.kill_job(m.integer_job_id())
+                        try:
+                            client.kill_job(m.integer_job_id())
+                        except WORKER_RPC_ERRORS as e:
+                            # Can't reach the worker to kill: proceed to
+                            # the synthesized completion below — round
+                            # liveness must not depend on a dead daemon.
+                            self.log.warning("kill of %s on worker %d "
+                                             "unreachable (%s)", m,
+                                             worker_id, e)
+                            break
                     servers.add((client.addr, client.port))
             self._completion_events.pop(job_id, None)
             prev_round = self.rounds.num_completed_rounds
-            self._cv.wait(timeout=30)
+            self._cv.wait(timeout=self._config.kill_wait_s)
             killed = (self.rounds.num_completed_rounds != prev_round
                       or job_id in self.rounds.completed_in_round)
             if killed:

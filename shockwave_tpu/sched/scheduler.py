@@ -103,6 +103,31 @@ class SchedulerConfig:
     # a physical run actually experienced, isolating rate-model error
     # from decision divergence. None = oracle rates (default).
     rate_override: Optional[Dict[int, float]] = None
+    # ---- fault tolerance (physical mode; see configs/fault_tolerance
+    # .json for the recorded defaults and README "Failure model") ----
+    # Worker-liveness monitor cadence. Heartbeats piggyback on every
+    # Done / UpdateLease RPC; a worker silent for worker_timeout_s is
+    # actively probed (Ping), and after worker_probe_failures
+    # consecutive failed probes its chips are marked dead, its in-round
+    # jobs are failed-in-round + requeued, and the allocation re-plans
+    # over the survivors. 0 disables the monitor.
+    heartbeat_interval_s: float = 10.0
+    worker_timeout_s: float = 30.0
+    worker_probe_deadline_s: float = 5.0
+    worker_probe_failures: int = 2
+    # How long _kill_job waits for the worker to confirm a kill before
+    # synthesizing a zero-step completion (liveness floor for the
+    # round; the reference hardcoded 30 s).
+    kill_wait_s: float = 30.0
+    # A job whose latest heartbeat is younger than this is not killed
+    # as unresponsive; the kill timer re-arms instead (it may be mid
+    # lease-expiry checkpoint). None = KILL_HEARTBEAT_FRESHNESS_S.
+    kill_heartbeat_freshness_s: Optional[float] = None
+    # Cap on consecutive freshness re-arms per dispatch: a job that
+    # keeps heartbeating but never honors lease expiry is killed after
+    # this many deferrals, so _end_round cannot be held hostage by a
+    # perpetually-"fresh" job (ADVICE round 5).
+    max_kill_rearms: int = 3
 
 
 class Scheduler:
@@ -233,13 +258,25 @@ class Scheduler:
                 # solver_budget_cap_rounds is simulation-only: a physical
                 # round loop must never stall on a hard MILP instance, so
                 # the per-solve bound is clamped to the half-round default
-                # regardless of what the config ships.
+                # regardless of what the config ships. A config shipping
+                # null means "use the default"; anything non-numeric is a
+                # config error, reported as such rather than a bare
+                # TypeError out of the comparison below.
                 cap = sw.get("solver_budget_cap_rounds", 0.5)
+                if cap is None:
+                    cap = 0.5
+                try:
+                    cap = float(cap)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        "config error: solver_budget_cap_rounds must be a "
+                        f"number (rounds) or null, got {cap!r}") from None
                 if cap > 0.5:
                     self.log.warning(
                         "clamping solver_budget_cap_rounds %.2f -> 0.5 "
                         "(physical mode)", cap)
-                    sw["solver_budget_cap_rounds"] = 0.5
+                    cap = 0.5
+                sw["solver_budget_cap_rounds"] = cap
             self._shockwave_planner = ShockwavePlanner.from_config(sw)
         self._scheduled_jobs_in_current_round: Optional[List[int]] = None
         self._scheduled_jobs_in_prev_round: Optional[List[int]] = None
@@ -394,9 +431,71 @@ class Scheduler:
             w.cumulative_time[worker_id] = 0.0
             w.start_times[worker_id] = self.get_current_timestamp()
             w.cluster_spec[worker_type] = w.cluster_spec.get(worker_type, 0) + 1
-        w.type_to_server_ids[worker_type].append(server_ids)
+        # Store a copy: deregister_workers prunes dead ids from these
+        # server lists in place, and the returned list must stay the
+        # caller's stable record of its chip ids.
+        w.type_to_server_ids[worker_type].append(list(server_ids))
         self._need_to_update_allocation = True
         return server_ids, self._time_per_iteration
+
+    def deregister_workers(self, worker_ids: Sequence[int]) -> None:
+        """Remove chips from schedulable capacity (worker presumed dead).
+
+        `id_to_type` and the cumulative-time books are retained so past
+        accounting stays resolvable, and the ids are remembered in
+        `workers.dead` so a rejoining daemon can revive them
+        (`revive_workers`). Allocation is flagged for re-planning over
+        the surviving capacity.
+        """
+        w = self.workers
+        ids = [i for i in worker_ids if i not in w.dead and i in w.id_to_type]
+        if not ids:
+            return
+        emptied_types = set()
+        for worker_id in ids:
+            w.dead.add(worker_id)
+            w.last_seen.pop(worker_id, None)
+            wt = w.id_to_type[worker_id]
+            emptied_types.add(wt)
+            w.cluster_spec[wt] = max(w.cluster_spec.get(wt, 0) - 1, 0)
+            if worker_id in w.worker_ids:
+                w.worker_ids.remove(worker_id)
+            for server in w.type_to_server_ids.get(wt, []):
+                if worker_id in server:
+                    server.remove(worker_id)
+        for wt in emptied_types:
+            # Prune emptied server groups: revive appends a fresh group,
+            # and under routine churn the empties would otherwise grow
+            # (and be deep-copied by every round's assignment pass)
+            # without bound.
+            w.type_to_server_ids[wt] = [
+                s for s in w.type_to_server_ids.get(wt, []) if s]
+        self._need_to_update_allocation = True
+        self.log.warning("[Workers lost] chips %s removed from capacity "
+                         "(%s left)", ids, dict(w.cluster_spec))
+
+    def revive_workers(self, worker_ids: Sequence[int],
+                       worker_type: str) -> None:
+        """Return previously-dead chips to capacity (worker rejoined).
+
+        The ids keep their identity — accounting history and any stale
+        references in old rounds stay valid — and come back as one
+        server list (they live on one host, like at registration).
+        """
+        w = self.workers
+        ids = [i for i in worker_ids if i in w.dead]
+        if not ids:
+            return
+        for worker_id in ids:
+            w.dead.discard(worker_id)
+            if worker_id not in w.worker_ids:
+                w.worker_ids.append(worker_id)
+            w.cluster_spec[worker_type] = (
+                w.cluster_spec.get(worker_type, 0) + 1)
+        w.type_to_server_ids.setdefault(worker_type, []).append(list(ids))
+        self._need_to_update_allocation = True
+        self.log.info("[Workers rejoined] chips %s restored to capacity "
+                      "(%s)", ids, dict(w.cluster_spec))
 
     # ------------------------------------------------------------------
     # Throughputs
@@ -716,11 +815,14 @@ class Scheduler:
             }
             scale_factors = sorted({sf for _, sf in scheduled[wt]}, reverse=True)
             for current_sf in scale_factors:
-                # Sticky pass: keep jobs on their previous workers.
+                # Sticky pass: keep jobs on their previous workers —
+                # unless any of those chips has since been marked dead.
                 for job_id, sf in scheduled[wt]:
                     if sf != current_sf or prev_types.get(job_id) != wt:
                         continue
                     prev_ids = self.rounds.current_assignments[job_id]
+                    if any(w in self.workers.dead for w in prev_ids):
+                        continue
                     if all(w not in state["assigned"] for w in prev_ids):
                         new_assignments[job_id] = prev_ids
                         state["assigned"].update(prev_ids)

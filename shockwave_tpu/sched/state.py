@@ -27,6 +27,15 @@ class WorkerState:
     start_times: Dict[int, float] = field(default_factory=dict)
     cumulative_time: Dict[int, float] = field(default_factory=dict)
     next_worker_id: int = 0
+    # -- liveness (physical mode; always empty in simulation) ----------
+    # Chips whose daemon is presumed dead: removed from capacity and
+    # from sticky placement, retained in id_to_type so historical
+    # accounting (run time, utilization) stays resolvable. A rejoining
+    # daemon revives its ids (idempotent RegisterWorker).
+    dead: Set[int] = field(default_factory=set)
+    # Last time each chip's daemon was heard from — stamped at
+    # registration and piggybacked on every Done / UpdateLease RPC.
+    last_seen: Dict[int, float] = field(default_factory=dict)
 
 
 @dataclass

@@ -22,9 +22,34 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import faulthandler
+
 import pytest
 
 REFERENCE_DIR = "/root/reference/scheduler"
+
+
+@pytest.fixture(autouse=True)
+def _hang_guard(request):
+    """Per-test wall-clock guard for tests marked @pytest.mark.timeout(N).
+
+    The loopback fault-injection tests exercise code whose historical
+    failure mode is an `_end_round` hang; a regression must fail the run
+    in seconds, not eat the tier-1 870 s budget. pytest-timeout is not
+    in the image, so this uses faulthandler: on expiry it dumps every
+    thread's traceback and hard-exits the process — a loud, attributable
+    fast failure (the dump names the hung test).
+    """
+    marker = request.node.get_closest_marker("timeout")
+    if marker is None:
+        yield
+        return
+    seconds = marker.args[0] if marker.args else 120
+    faulthandler.dump_traceback_later(seconds, exit=True)
+    try:
+        yield
+    finally:
+        faulthandler.cancel_dump_traceback_later()
 
 
 def _install_stub(name, **attrs):

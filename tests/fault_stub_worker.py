@@ -1,0 +1,77 @@
+"""Real-process stub worker for fault-injection tests.
+
+Registers with the scheduler and simulates job execution at a fixed
+throughput (like test_runtime.StubWorkerDaemon) but as a genuine OS
+process, so tests can SIGKILL it and exercise the scheduler's worker
+liveness machinery against a genuinely dead daemon. Deliberately jax-free: it
+imports only the runtime control plane.
+
+`--freeze_after_round N` makes every RunJob with round_id > N a silent
+no-op (accepted, never executed, never reported) — the deterministic
+"worker wedged mid-round" hook, so tests never depend on racing a
+SIGKILL against the stub's execution sleep.
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from shockwave_tpu.runtime.clients import (IteratorToSchedulerClient,  # noqa: E402
+                                           WorkerToSchedulerClient)
+from shockwave_tpu.runtime.servers import serve_worker  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sched_port", type=int, required=True)
+    p.add_argument("--worker_port", type=int, required=True)
+    p.add_argument("--num_chips", type=int, default=1)
+    p.add_argument("--throughput", type=float, default=100.0)
+    p.add_argument("--exec_time", type=float, default=0.3)
+    p.add_argument("--freeze_after_round", type=int, default=None)
+    p.add_argument("--state_file", required=True,
+                   help="JSON file the parent polls for worker ids/pid")
+    args = p.parse_args()
+
+    client = WorkerToSchedulerClient("localhost", args.sched_port)
+    shutdown = threading.Event()
+    box = {}
+
+    def run_job(jobs, worker_id, round_id):
+        if (args.freeze_after_round is not None
+                and round_id > args.freeze_after_round):
+            print(f"FROZEN worker={worker_id} round={round_id}", flush=True)
+            return
+
+        def execute():
+            max_steps = 10**9
+            for j in jobs:
+                it = IteratorToSchedulerClient(j["job_id"], worker_id,
+                                               "localhost", args.sched_port)
+                max_steps, _, _ = it.init()
+            time.sleep(args.exec_time)
+            steps = [min(int(args.throughput * box["round_duration"]),
+                         j["num_steps"], int(max_steps)) for j in jobs]
+            client.notify_done([j["job_id"] for j in jobs], worker_id, steps,
+                               [args.exec_time] * len(jobs))
+        threading.Thread(target=execute, daemon=True).start()
+
+    server = serve_worker(args.worker_port, {
+        "RunJob": run_job, "KillJob": lambda j: None,
+        "Reset": lambda: None, "Shutdown": shutdown.set,
+    })
+    worker_ids, round_duration = client.register_worker(
+        "v5e", "127.0.0.1", args.worker_port, args.num_chips)
+    box["round_duration"] = round_duration
+    with open(args.state_file, "w") as f:
+        json.dump({"worker_ids": worker_ids, "pid": os.getpid()}, f)
+    shutdown.wait()
+    server.stop(grace=0)
+
+
+if __name__ == "__main__":
+    main()

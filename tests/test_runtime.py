@@ -600,7 +600,10 @@ class TestFirstInitGrace:
             sched._server.stop(grace=0)
 
     def test_stale_signaled_job_is_killed(self):
-        sched = self._make_sched(first_init_grace_s=300.0)
+        # kill_wait_s keeps the kill path's real _cv.wait short; stubbing
+        # the condition's wait would make the allocation thread's waits
+        # into lock-holding spins.
+        sched = self._make_sched(first_init_grace_s=300.0, kill_wait_s=0.1)
         try:
             job_id = self._add_dispatched_job(sched)
             sched._ever_signaled.add(job_id)
@@ -614,7 +617,6 @@ class TestFirstInitGrace:
                     self.killed.append(int_id)
 
             sched._worker_connections[0] = _StubClient()
-            sched._cv.wait = lambda timeout=None: False
             done = []
             sched.done_callback = lambda *a: done.append(a)
             sched._kill_job(job_id)
@@ -1039,4 +1041,859 @@ class TestInflightTimeAccounting:
             # the deficits; only post-reset time counts.
             assert job_t[jid]["v100"] == pytest.approx(20.0, abs=1.0)
         finally:
+            sched._server.stop(grace=0)
+
+
+# ---------------------------------------------------------------------------
+# Fault tolerance: RPC resilience layer, fault injection, worker liveness
+# ---------------------------------------------------------------------------
+
+import collections
+import json
+import signal
+import subprocess
+import sys
+
+import grpc
+
+from shockwave_tpu.runtime import faults
+from shockwave_tpu.runtime.clients import SchedulerToWorkerClient as _S2W
+from shockwave_tpu.runtime.resilience import (CircuitBreaker,
+                                              CircuitOpenError, RetryPolicy,
+                                              RpcUnavailableError,
+                                              call_with_retry)
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture
+def fault_injector():
+    inj = faults.get_injector()
+    inj.clear()
+    yield inj
+    inj.clear()
+
+
+class _FakeRpcError(grpc.RpcError):
+    def __init__(self, code):
+        self._code = code
+
+    def code(self):
+        return self._code
+
+
+class TestResilienceLayer:
+    """Unit tests for the retry/deadline/circuit-breaker primitives."""
+
+    def test_retries_transport_errors_then_succeeds(self):
+        calls, sleeps = [], []
+
+        def flaky(request, timeout=None):
+            calls.append(timeout)
+            if len(calls) < 3:
+                raise _FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+            return "ok"
+
+        out = call_with_retry(
+            flaky, None, method="t",
+            policy=RetryPolicy(deadline_s=1.0, total_budget_s=100.0,
+                               max_attempts=5),
+            sleep=sleeps.append)
+        assert out == "ok"
+        assert len(calls) == 3
+        assert sleeps == [0.25, 0.5]  # deterministic exponential backoff
+        assert all(t is not None and t <= 1.0 for t in calls)  # deadlines
+
+    def test_budget_exhaustion_raises_unavailable(self):
+        def dead(request, timeout=None):
+            raise _FakeRpcError(grpc.StatusCode.DEADLINE_EXCEEDED)
+
+        with pytest.raises(RpcUnavailableError) as exc:
+            call_with_retry(
+                dead, None, method="t",
+                policy=RetryPolicy(deadline_s=0.5, total_budget_s=10.0,
+                                   max_attempts=3),
+                sleep=lambda s: None)
+        assert exc.value.attempts == 3
+        assert exc.value.last_code == grpc.StatusCode.DEADLINE_EXCEEDED
+
+    def test_non_retryable_code_propagates_unchanged(self):
+        calls = []
+
+        def wrong(request, timeout=None):
+            calls.append(1)
+            raise _FakeRpcError(grpc.StatusCode.INVALID_ARGUMENT)
+
+        with pytest.raises(grpc.RpcError):
+            call_with_retry(wrong, None, method="t", policy=RetryPolicy(),
+                            sleep=lambda s: None)
+        assert len(calls) == 1  # peer answered: no retry
+
+    def test_narrowed_retryable_codes(self):
+        """Done-style calls retry UNAVAILABLE only: a deadline expiry may
+        mean the server is still processing attempt 1."""
+        def slow(request, timeout=None):
+            raise _FakeRpcError(grpc.StatusCode.DEADLINE_EXCEEDED)
+
+        with pytest.raises(grpc.RpcError) as exc:
+            call_with_retry(
+                slow, None, method="t", policy=RetryPolicy(max_attempts=5),
+                retryable=frozenset({grpc.StatusCode.UNAVAILABLE}),
+                sleep=lambda s: None)
+        assert not isinstance(exc.value, RpcUnavailableError)
+
+    def test_circuit_opens_half_opens_and_recloses(self):
+        clock = [0.0]
+        br = CircuitBreaker(failure_threshold=2, reset_timeout_s=5.0,
+                            clock=lambda: clock[0])
+        assert br.state == "closed"
+        br.record_failure()
+        assert br.allow()
+        br.record_failure()
+        assert br.state == "open"
+        assert not br.allow()  # fails fast while open
+        clock[0] = 6.0
+        assert br.state == "half-open"
+        assert br.allow()       # one probe admitted
+        assert not br.allow()   # ...but only one
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_open_circuit_fails_fast_without_calling(self):
+        br = CircuitBreaker(failure_threshold=1, reset_timeout_s=100.0)
+        br.record_failure()
+        calls = []
+        with pytest.raises(CircuitOpenError):
+            call_with_retry(lambda r, timeout=None: calls.append(1), None,
+                            method="t", policy=RetryPolicy(), breaker=br)
+        assert calls == []
+
+    def test_half_open_probe_failure_reopens(self):
+        clock = [0.0]
+        br = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                            clock=lambda: clock[0])
+        br.record_failure()
+        clock[0] = 6.0
+        assert br.allow()
+        br.record_failure()  # probe failed: reopen from now
+        assert br.state == "open"
+        clock[0] = 10.0
+        assert not br.allow()
+        clock[0] = 12.0
+        assert br.allow()
+
+
+class TestFaultInjectorUnit:
+    def test_after_and_times_windows(self, fault_injector):
+        fault_injector.install([dict(method="Done", action="drop",
+                                     after=1, times=2)])
+        rule = fault_injector._rules[0]
+        fired = [rule.should_fire() for _ in range(5)]
+        assert fired == [False, True, True, False, False]
+
+    def test_method_matching(self):
+        rule = faults.FaultRule(method="Done")
+        assert rule.matches("shockwave_tpu.WorkerToScheduler/Done")
+        assert rule.matches("Done")
+        assert not rule.matches("RunJob")
+        assert faults.FaultRule(method="*").matches("anything")
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError):
+            faults.FaultRule(method="Done", action="explode")
+
+
+@pytest.mark.faults
+@pytest.mark.timeout(60)
+class TestRpcDeadlines:
+    """Acceptance: no scheduler-side RPC can block indefinitely — a
+    blackholed method returns within the configured budget."""
+
+    def test_blackholed_run_job_returns_within_budget(self, fault_injector):
+        port = free_port()
+        server = serve_worker(port, {
+            "RunJob": lambda jobs, wid, rid: None,
+            "KillJob": lambda j: None, "Reset": lambda: None,
+            "Shutdown": lambda: None,
+        })
+        # Hold RunJob for 2 s server-side; the client's deadline is 0.3 s.
+        fault_injector.install([dict(method="RunJob", action="blackhole",
+                                     delay_s=2.0)])
+        client = _S2W("localhost", port,
+                      policy=RetryPolicy(deadline_s=0.3, total_budget_s=1.2,
+                                         max_attempts=2))
+        try:
+            start = time.monotonic()
+            with pytest.raises(RpcUnavailableError):
+                client.run_job([dict(job_id=1, command="x",
+                                     working_directory="", needs_data_dir=False,
+                                     num_steps_arg="--steps", num_steps=1,
+                                     mode="static")], worker_id=0, round_id=0)
+            elapsed = time.monotonic() - start
+            # 2 attempts x 0.3 s deadline + 0.25 s backoff, plus slack —
+            # nowhere near the 2 s server-side hold per attempt.
+            assert elapsed < 1.9, elapsed
+        finally:
+            fault_injector.clear()
+            client.close()
+            server.stop(grace=0)
+            time.sleep(2.2)  # let blackholed handler threads drain
+
+    def test_dropped_rpc_is_retried_to_success(self, fault_injector):
+        port = free_port()
+        received = []
+        server = serve_worker(port, {
+            "RunJob": lambda jobs, wid, rid: received.append(wid),
+            "KillJob": lambda j: None, "Reset": lambda: None,
+            "Shutdown": lambda: None,
+        })
+        fault_injector.install([dict(method="RunJob", action="drop",
+                                     times=1)])
+        client = _S2W("localhost", port,
+                      policy=RetryPolicy(deadline_s=2.0, total_budget_s=10.0,
+                                         max_attempts=3,
+                                         backoff_base_s=0.05))
+        try:
+            client.run_job([dict(job_id=1, command="x", working_directory="",
+                                 needs_data_dir=False, num_steps_arg="--s",
+                                 num_steps=1, mode="static")],
+                           worker_id=7, round_id=0)
+            assert received == [7]
+            assert ("shockwave_tpu.SchedulerToWorker/RunJob", "drop") in \
+                fault_injector.fired
+        finally:
+            client.close()
+            server.stop(grace=0)
+
+    def test_ping_probe_round_trip(self):
+        port = free_port()
+        server = serve_worker(port, {
+            "RunJob": lambda jobs, wid, rid: None,
+            "KillJob": lambda j: None, "Reset": lambda: None,
+            "Shutdown": lambda: None,
+        })
+        client = _S2W("localhost", port)
+        try:
+            client.ping(deadline_s=2.0)  # no exception = alive
+        finally:
+            client.close()
+            server.stop(grace=0)
+
+    def test_ping_dead_endpoint_fails_within_deadline(self):
+        client = _S2W("localhost", free_port())
+        start = time.monotonic()
+        with pytest.raises(RpcUnavailableError):
+            client.ping(deadline_s=0.3)
+        assert time.monotonic() - start < 2.0
+        client.close()
+
+
+@pytest.mark.faults
+@pytest.mark.timeout(120)
+class TestWorkerDeathMidRound:
+    """Acceptance: SIGKILL one of two (real-process) workers mid-round —
+    the scheduler detects the loss via the heartbeat monitor, requeues
+    the job, completes the round, and the requeued job's completion
+    lands in makespan accounting. Deterministic: the victim worker is
+    frozen via its --freeze_after_round hook BEFORE the SIGKILL, so
+    nothing races the kill signal."""
+
+    def _spawn_stub(self, sched_port, tmp_path, name, freeze_after=None):
+        from conftest import REPO_ROOT
+        state = tmp_path / f"{name}.json"
+        log = open(tmp_path / f"{name}.log", "w")
+        cmd = [sys.executable, os.path.join(TESTS_DIR, "fault_stub_worker.py"),
+               "--sched_port", str(sched_port),
+               "--worker_port", str(free_port()),
+               "--num_chips", "1", "--state_file", str(state)]
+        if freeze_after is not None:
+            cmd += ["--freeze_after_round", str(freeze_after)]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                                env=env)
+        return proc, state, log
+
+    def test_sigkilled_worker_job_requeued_and_completes(self, tmp_path):
+        sched_port = free_port()
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(
+                time_per_iteration=2.0,
+                heartbeat_interval_s=0.2, worker_timeout_s=0.6,
+                worker_probe_deadline_s=0.3, worker_probe_failures=1,
+                kill_wait_s=0.5, kill_heartbeat_freshness_s=0.5,
+                job_completion_buffer_s=5.0),
+            expected_num_workers=2, port=sched_port)
+        survivor_p, _, log_a = self._spawn_stub(sched_port, tmp_path, "a")
+        victim_p, victim_state, log_b = self._spawn_stub(
+            sched_port, tmp_path, "b", freeze_after=0)
+        try:
+            deadline = time.time() + 20
+            while time.time() < deadline and not victim_state.exists():
+                time.sleep(0.05)
+            victim_ids = set(json.loads(victim_state.read_text())["worker_ids"])
+
+            # Two 300-step jobs: each needs two 200-step-capacity rounds,
+            # so both are live when round 1 starts and the victim freezes.
+            for _ in range(2):
+                sched.add_job(Job(
+                    None, "ResNet-18 (batch size 32)",
+                    "python3 main.py --batch_size 32",
+                    "image_classification/cifar10", "--num_steps",
+                    total_steps=300, duration=10000))
+            threading.Thread(target=sched.run, daemon=True).start()
+
+            # Wait until the victim has swallowed (frozen) a round-1
+            # dispatch, then SIGKILL it mid-round.
+            frozen_log = tmp_path / "b.log"
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if frozen_log.exists() and "FROZEN" in frozen_log.read_text():
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("victim never received its round-1 dispatch")
+            os.kill(victim_p.pid, signal.SIGKILL)
+            kill_time = time.time()
+
+            # Detection: chips retired within timeout + probe + slack.
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if victim_ids <= sched.workers.dead:
+                    break
+                time.sleep(0.05)
+            assert victim_ids <= sched.workers.dead, "worker loss undetected"
+            detect_latency = time.time() - kill_time
+            assert detect_latency < 3.0, detect_latency
+
+            # Both jobs complete on the survivor; the requeued one's
+            # completion is accounted.
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if len(sched._completed_jobs) == 2:
+                    break
+                time.sleep(0.1)
+            assert len(sched._completed_jobs) == 2, (
+                f"jobs stuck: completed={sched._completed_jobs}")
+            for int_id in (0, 1):
+                assert sched.acct.completion_times[JobIdPair(int_id)] is not None
+            assert sched.get_last_completion_time() > 0
+            # Surviving capacity only.
+            assert sum(sched.workers.cluster_spec.values()) == 1
+        finally:
+            sched._done_event.set()
+            for proc in (survivor_p, victim_p):
+                if proc.poll() is None:
+                    proc.kill()
+                proc.wait(timeout=10)
+            log_a.close()
+            log_b.close()
+            sched._server.stop(grace=0)
+
+
+@pytest.mark.faults
+@pytest.mark.timeout(120)
+class TestDoneBlackholeSynthesis:
+    """Satellite: the Done report is blackholed (dropped through the
+    worker's whole retry budget); the round watchdog synthesizes a
+    failed micro-task, the round completes, and the requeued job
+    finishes once the fault window closes."""
+
+    def test_done_dropped_then_job_requeued(self, fault_injector):
+        sched_port = free_port()
+        worker_port = free_port()
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(
+                time_per_iteration=2.0,
+                heartbeat_interval_s=0,  # worker is alive; isolate Done
+                kill_wait_s=0.3, kill_heartbeat_freshness_s=0.3,
+                job_completion_buffer_s=0.5),
+            expected_num_workers=1, port=sched_port)
+
+        class QuietStub(StubWorkerDaemon):
+            def _run_job(self, jobs, worker_id, round_id):
+                def execute():
+                    try:
+                        for j in jobs:
+                            it = IteratorToSchedulerClient(
+                                j["job_id"], worker_id, "localhost",
+                                self.sched_port)
+                            max_steps, _, _ = it.init()
+                        time.sleep(self.execution_time)
+                        steps = [min(int(self.throughput * self.round_duration),
+                                     j["num_steps"], int(max_steps))
+                                 for j in jobs]
+                        self._client.notify_done(
+                            [j["job_id"] for j in jobs], worker_id, steps,
+                            [self.execution_time] * len(jobs))
+                    except Exception:  # noqa: BLE001 - injected fault
+                        pass
+                threading.Thread(target=execute, daemon=True).start()
+
+        # The worker's Done policy retries 4 times; swallow exactly one
+        # full report (4 server-side hits), then heal.
+        fault_injector.install([dict(method="Done", action="drop", times=4)])
+        worker = QuietStub(sched_port, worker_port, num_chips=1,
+                           throughput=100.0)
+        try:
+            sched.add_job(Job(
+                None, "ResNet-18 (batch size 32)",
+                "python3 main.py --batch_size 32",
+                "image_classification/cifar10", "--num_steps",
+                total_steps=150, duration=10000))
+            threading.Thread(target=sched.run, daemon=True).start()
+            deadline = time.time() + 40
+            while time.time() < deadline:
+                if len(sched._completed_jobs) == 1:
+                    break
+                time.sleep(0.1)
+            assert len(sched._completed_jobs) == 1, "job never completed"
+            drops = [f for f in fault_injector.fired if f[1] == "drop"]
+            assert len(drops) >= 4, drops  # the whole retry budget was eaten
+            assert sched.acct.completion_times[JobIdPair(0)] is not None
+        finally:
+            sched._done_event.set()
+            worker.stop()
+            sched._server.stop(grace=0)
+
+
+class TestWorkerRejoinIdempotent:
+    """A daemon re-registering from a known endpoint gets its ORIGINAL
+    chip ids back (idempotent RegisterWorker), whether it was declared
+    dead first or re-registered while still considered live (slow
+    restart / duplicated register retry)."""
+
+    def _make_sched(self):
+        return PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=100.0,
+                                   heartbeat_interval_s=0),
+            expected_num_workers=2, port=free_port())
+
+    def test_rejoin_after_death_revives_same_ids(self):
+        sched = self._make_sched()
+        try:
+            ids, _ = sched._register_worker_rpc("v5e", 2, "127.0.0.1", 7001)
+            assert sched.workers.cluster_spec["v5e"] == 2
+            with sched._cv:
+                sched._retire_worker_host(("127.0.0.1", 7001))
+            assert sched.workers.cluster_spec["v5e"] == 0
+            assert set(ids) <= sched.workers.dead
+            ids2, _ = sched._register_worker_rpc("v5e", 2, "127.0.0.1", 7001)
+            assert ids2 == ids
+            assert sched.workers.cluster_spec["v5e"] == 2
+            assert not (set(ids) & sched.workers.dead)
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+    def test_reregister_while_live_is_idempotent(self):
+        sched = self._make_sched()
+        try:
+            ids, _ = sched._register_worker_rpc("v5e", 2, "127.0.0.1", 7002)
+            ids2, _ = sched._register_worker_rpc("v5e", 2, "127.0.0.1", 7002)
+            assert ids2 == ids
+            assert sched.workers.cluster_spec["v5e"] == 2  # no ghost chips
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+    def test_dead_worker_requeues_in_round_job(self):
+        """Retiring a host whose chip runs a job marks the job failed-in-
+        round (zero-step synthesized done) without charging the job a
+        failure, and prunes dead chips from the next round's plan."""
+        sched = self._make_sched()
+        try:
+            ids, _ = sched._register_worker_rpc("v5e", 1, "127.0.0.1", 7003)
+            job_id = sched.add_job(Job(
+                None, "ResNet-18 (batch size 32)",
+                "python3 main.py --batch_size 32",
+                "image_classification/cifar10", "--num_steps",
+                total_steps=100, duration=1000))
+            with sched._cv:
+                sched.rounds.current_assignments[job_id] = tuple(ids)
+                sched.rounds.next_assignments = collections.OrderedDict(
+                    {job_id: tuple(ids)})
+                sched._retire_worker_host(("127.0.0.1", 7003))
+            assert job_id in sched.rounds.completed_in_round  # round rolls
+            assert job_id in sched.acct.jobs                  # requeued
+            assert sched.acct.failures[job_id] == 0           # not job's fault
+            assert job_id not in sched.rounds.next_assignments
+            tl = sched._job_timelines[job_id.integer_job_id()]
+            assert any("WORKER_FAILED" in line for line in tl), tl
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+
+class TestKillRearmCap:
+    """Satellite: the heartbeat-freshness kill deferral is capped per
+    dispatch, so a job that keeps renewing its lease but never honors
+    expiry is killed after max_kill_rearms re-arms and the round
+    regains liveness."""
+
+    def _make_sched(self, max_rearms):
+        return PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=100.0,
+                                   heartbeat_interval_s=0,
+                                   kill_heartbeat_freshness_s=30.0,
+                                   max_kill_rearms=max_rearms,
+                                   kill_wait_s=0.1),
+            expected_num_workers=1, port=free_port())
+
+    def test_perpetually_fresh_job_killed_after_cap(self):
+        sched = self._make_sched(2)
+        try:
+            job_id = sched.add_job(Job(
+                None, "ResNet-18 (batch size 32)",
+                "python3 main.py --batch_size 32",
+                "image_classification/cifar10", "--num_steps",
+                total_steps=100, duration=1000))
+            sched.rounds.current_assignments[job_id] = (0,)
+            sched._ever_signaled.add(job_id)
+
+            class _StubClient:
+                addr, port = "127.0.0.1", 0
+                killed = []
+
+                def kill_job(self, int_id):
+                    self.killed.append(int_id)
+
+            sched._worker_connections[0] = _StubClient()
+            done = []
+            sched.done_callback = lambda *a: done.append(a)
+
+            # kill_wait_s=0.1 in the config keeps the real _cv.wait in
+            # the kill path short — no wait stubbing (which would turn
+            # the allocation thread's waits into a lock-holding spin).
+            for attempt in range(3):
+                # The job heartbeats right before every kill check —
+                # the pathological always-fresh renewer.
+                sched._last_heartbeat[job_id] = sched.get_current_timestamp()
+                sched._kill_job(job_id)
+                timer = sched._completion_events.pop(job_id, None)
+                if timer is not None:
+                    timer.cancel()
+                if _StubClient.killed:
+                    break
+            # Two deferrals allowed, third check kills.
+            assert attempt == 2, attempt
+            assert _StubClient.killed == [job_id.integer_job_id()]
+            assert done, "zero-step done must be synthesized"
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+    def test_rearm_counter_cleared_on_dispatch(self):
+        sched = self._make_sched(2)
+        try:
+            job_id = sched.add_job(Job(
+                None, "ResNet-18 (batch size 32)",
+                "python3 main.py --batch_size 32",
+                "image_classification/cifar10", "--num_steps",
+                total_steps=100, duration=1000))
+            sched._kill_rearm_counts[job_id] = 2
+
+            class _NullClient:
+                addr, port = "127.0.0.1", 1
+
+                def run_job(self, *a):
+                    pass
+
+            sched._worker_connections[0] = _NullClient()
+            with sched._cv:
+                sched._try_dispatch_job(job_id, (0,))
+            assert job_id not in sched._kill_rearm_counts
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+
+@pytest.mark.timeout(60)
+class TestDispatcherEscalation:
+    """Satellite: after the group leader exits on SIGTERM, surviving
+    group members (forked helpers that ignore SIGTERM) are probed and
+    SIGKILLed so the chip cannot stay wedged."""
+
+    def test_sigterm_ignoring_helper_is_killed(self, tmp_path):
+        from shockwave_tpu.runtime.dispatcher import Dispatcher
+        pid_file = tmp_path / "grandchild.pid"
+        leader_code = (
+            "import os, subprocess, sys, time\n"
+            "child = subprocess.Popen([sys.executable, '-c', "
+            "'import signal, time; "
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+            "time.sleep(120)'])\n"
+            f"open({str(pid_file)!r}, 'w').write(str(child.pid))\n"
+            "time.sleep(120)\n")
+        proc = subprocess.Popen([sys.executable, "-c", leader_code],
+                                start_new_session=True)
+        d = Dispatcher(round_duration=1.0, chip_ids=[0],
+                       worker_rpc_client=None, sched_addr="127.0.0.1",
+                       sched_port=1234, run_dirs={}, data_dir=None,
+                       checkpoint_dir=str(tmp_path))
+        try:
+            deadline = time.time() + 15
+            while time.time() < deadline and not pid_file.exists():
+                time.sleep(0.05)
+            grandchild = int(pid_file.read_text())
+            d._processes[7] = proc
+            d.kill_job(7, grace_s=0.5)
+            # Leader dies on SIGTERM; the escalation thread must then
+            # probe the group and SIGKILL the TERM-ignoring grandchild.
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                try:
+                    os.kill(grandchild, 0)
+                except ProcessLookupError:
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail("grandchild survived: chip would stay wedged")
+        finally:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            if pid_file.exists():
+                try:
+                    os.kill(int(pid_file.read_text()), signal.SIGKILL)
+                except (ProcessLookupError, ValueError):
+                    pass
+
+
+class TestSolverBudgetCapCoercion:
+    """Satellite: solver_budget_cap_rounds must be coerced with a clear
+    config error — not a bare TypeError out of the clamp comparison."""
+
+    def _config(self, cap):
+        sw = {"num_gpus": 2, "solver_budget_cap_rounds": cap}
+        return SchedulerConfig(time_per_iteration=10.0, shockwave=sw)
+
+    def test_null_means_default(self):
+        from shockwave_tpu.sched.scheduler import Scheduler
+        sched = Scheduler(get_policy("shockwave"), simulate=False,
+                          config=self._config(None))
+        assert sched._shockwave_planner.opts.budget_cap_rounds == 0.5
+
+    def test_numeric_string_is_coerced(self):
+        from shockwave_tpu.sched.scheduler import Scheduler
+        sched = Scheduler(get_policy("shockwave"), simulate=False,
+                          config=self._config("0.25"))
+        assert sched._shockwave_planner.opts.budget_cap_rounds == 0.25
+
+    def test_garbage_raises_descriptive_error(self):
+        from shockwave_tpu.sched.scheduler import Scheduler
+        with pytest.raises(ValueError, match="solver_budget_cap_rounds"):
+            Scheduler(get_policy("shockwave"), simulate=False,
+                      config=self._config("half a round"))
+
+    def test_overlarge_cap_still_clamped(self):
+        from shockwave_tpu.sched.scheduler import Scheduler
+        sched = Scheduler(get_policy("shockwave"), simulate=False,
+                          config=self._config(2.0))
+        assert sched._shockwave_planner.opts.budget_cap_rounds == 0.5
+
+
+class TestCheckpointAheadReconcile:
+    """A job whose restored checkpoint already satisfies its full budget
+    (previous worker died post-checkpoint, pre-report) must report the
+    scheduler's granted remainder — closing the accounting gap — rather
+    than (0, 0), the micro-task-failure signal."""
+
+    def test_reports_granted_remainder(self, tmp_path, monkeypatch):
+        port = free_port()
+        server = serve_scheduler(port, {
+            "RegisterWorker": lambda **kw: ([0], 60.0),
+            "Done": lambda *a: None,
+            "InitJob": lambda job_id: (50, 1e6, 0.0),  # scheduler's remaining
+            "UpdateLease": lambda *a: (50, 1e6, 0.0, 1e9),
+            "UpdateResourceRequirement": lambda *a: None,
+        })
+        monkeypatch.setenv("SWTPU_JOB_ID", "2")
+        monkeypatch.setenv("SWTPU_WORKER_ID", "0")
+        monkeypatch.setenv("SWTPU_ROUND_ID", "5")
+        monkeypatch.setenv("SWTPU_SCHED_ADDR", "localhost")
+        monkeypatch.setenv("SWTPU_SCHED_PORT", str(port))
+        try:
+            from shockwave_tpu.runtime.iterator import LeaseIterator
+            it = LeaseIterator(
+                data_loader=list(range(10)), checkpoint_dir=str(tmp_path),
+                load_checkpoint_func=lambda p: None,
+                save_checkpoint_func=lambda p, s: None,
+                synthetic_data=True, write_on_close=False)
+            it.report_checkpoint_ahead()
+            assert it.done
+            it.complete()  # flushes PROGRESS lines (write_on_close=False)
+            log = (tmp_path / ".swtpu" / "round=5" /
+                   "worker=0.log").read_text()
+            assert "[STEPS] 50" in log, log
+            # The dispatcher scrapes the LAST progress values; the final
+            # duration must be strictly positive ((0 steps, 0 s) is the
+            # failure signal).
+            last_duration = [line for line in log.splitlines()
+                             if "[DURATION]" in line][-1]
+            assert float(last_duration.rsplit(" ", 1)[-1]) > 0, log
+        finally:
+            server.stop(grace=0)
+
+
+class TestDoneDuplicateGuard:
+    """gRPC can return UNAVAILABLE after the server processed the call,
+    so an at-least-once Done retry may double-deliver; one report per
+    (job, worker) per dispatch is accepted."""
+
+    def _make_sched(self):
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=100.0,
+                                   heartbeat_interval_s=0),
+            expected_num_workers=1, port=free_port())
+        sched.register_worker("v5e", num_chips=1)
+        return sched
+
+    def _add_job(self, sched, total_steps=1000):
+        return sched.add_job(Job(
+            None, "ResNet-18 (batch size 32)",
+            "python3 main.py --batch_size 32",
+            "image_classification/cifar10", "--num_steps",
+            total_steps=total_steps, duration=100000))
+
+    def test_duplicate_report_counted_once(self):
+        sched = self._make_sched()
+        try:
+            job_id = self._add_job(sched)
+            with sched._cv:
+                sched.rounds.current_assignments[job_id] = (0,)
+                sched._running_jobs.add(job_id)  # normally set by InitJob
+                sched._dispatch_stamp[(job_id, 0)] = (
+                    sched.get_current_timestamp())
+            sched.done_callback(job_id, 0, [50], [1.0])
+            assert sched.acct.total_steps_run[job_id] == 50
+            # The retry of the same report must be rejected at entry —
+            # not parked at the boundary wait (which would hang here).
+            with sched._cv:
+                sched._running_jobs.add(job_id)
+            sched.done_callback(job_id, 0, [50], [1.0])
+            assert sched.acct.total_steps_run[job_id] == 50
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+    def test_fresh_dispatch_reaccepts(self):
+        sched = self._make_sched()
+        try:
+            job_id = self._add_job(sched)
+            with sched._cv:
+                sched.rounds.current_assignments[job_id] = (0,)
+                sched._running_jobs.add(job_id)
+                sched._dispatch_stamp[(job_id, 0)] = (
+                    sched.get_current_timestamp())
+            sched.done_callback(job_id, 0, [50], [1.0])
+            # Round rolls and the job is re-dispatched to the same chip.
+            with sched._cv:
+                sched.rounds.completed_in_round.clear()
+                sched._running_jobs.add(job_id)
+                sched._dispatch_stamp[(job_id, 0)] = (
+                    sched.get_current_timestamp() + 0.001)
+            sched.done_callback(job_id, 0, [60], [1.0])
+            assert sched.acct.total_steps_run[job_id] == 110
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+    def test_worker_death_never_drops_job_at_failure_threshold(self):
+        """A job sitting one genuine failure below MAX_FAILED_ATTEMPTS
+        must survive a worker crash: the synthesized zero-step done's
+        +1 is pre-compensated, not restored after the fact (a post-hoc
+        restore would miss a job the +1 already removed)."""
+        from shockwave_tpu.sched.scheduler import MAX_FAILED_ATTEMPTS
+        sched = self._make_sched()
+        try:
+            ids, _ = sched._register_worker_rpc("v5e", 1, "127.0.0.1", 7009)
+            job_id = self._add_job(sched)
+            with sched._cv:
+                sched.acct.failures[job_id] = MAX_FAILED_ATTEMPTS - 1
+                sched.rounds.current_assignments[job_id] = tuple(ids)
+                sched._dispatch_stamp[(job_id, ids[0])] = (
+                    sched.get_current_timestamp())
+                sched._retire_worker_host(("127.0.0.1", 7009))
+            assert job_id in sched.acct.jobs, "worker crash dropped the job"
+            assert sched.acct.failures[job_id] == MAX_FAILED_ATTEMPTS - 1
+            assert job_id in sched.rounds.completed_in_round
+        finally:
+            sched._done_event.set()
+            sched._server.stop(grace=0)
+
+
+class TestFaultChokepointFiltering:
+    def test_freeze_hook_does_not_consume_rpc_rules(self, fault_injector):
+        fault_injector.install([dict(method="*", action="drop", times=1)])
+        # The dispatch hook can only freeze: it must not burn the one
+        # firing slot of a drop rule (or log a phantom fired entry).
+        assert not fault_injector.should_freeze("dispatch")
+        assert fault_injector.fired == []
+        rule = fault_injector._rules[0]
+        assert rule.should_fire()  # slot still live for an RPC hook
+
+    def test_rpc_hook_does_not_consume_freeze_rules(self, fault_injector):
+        fault_injector.install([dict(method="*", action="freeze", times=1)])
+        fault_injector.fire("shockwave_tpu.WorkerToScheduler/Done")
+        assert fault_injector.fired == []
+        assert fault_injector.should_freeze("dispatch")
+
+
+@pytest.mark.faults
+@pytest.mark.timeout(60)
+class TestPartitionHealRevive:
+    """A transient partition retires a healthy daemon that will never
+    re-register (it registers once, at startup); the monitor must keep
+    probing retired hosts and revive them when the partition heals."""
+
+    def test_retired_host_revived_on_successful_probe(self):
+        sched_port = free_port()
+        worker_port = free_port()
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(
+                time_per_iteration=100.0,
+                heartbeat_interval_s=0.2, worker_timeout_s=0.4,
+                worker_probe_deadline_s=0.3, worker_probe_failures=1),
+            expected_num_workers=1, port=sched_port)
+        worker = None
+        try:
+            # Register a worker endpoint with NO server behind it yet:
+            # the monitor's probes fail and retire it (the "partition").
+            ids, _ = sched._register_worker_rpc(
+                "v5e", 1, "localhost", worker_port)
+            deadline = time.time() + 10
+            while time.time() < deadline and not (
+                    set(ids) <= sched.workers.dead):
+                time.sleep(0.05)
+            assert set(ids) <= sched.workers.dead, "host never retired"
+
+            # Partition heals: a server appears at the SAME endpoint.
+            worker = serve_worker(worker_port, {
+                "RunJob": lambda jobs, wid, rid: None,
+                "KillJob": lambda j: None, "Reset": lambda: None,
+                "Shutdown": lambda: None,
+            })
+            deadline = time.time() + 15
+            while time.time() < deadline and (set(ids) & sched.workers.dead):
+                time.sleep(0.05)
+            assert not (set(ids) & sched.workers.dead), "host never revived"
+            assert sched.workers.cluster_spec["v5e"] == 1
+        finally:
+            sched._done_event.set()
+            if worker is not None:
+                worker.stop(grace=0)
             sched._server.stop(grace=0)
