@@ -36,9 +36,15 @@ from shockwave_tpu.sched.physical import PhysicalScheduler
 from shockwave_tpu.solver import get_policy
 
 
-def submit_jobs(sched, jobs, arrival_times, start_time):
-    """Feed the trace to the scheduler in real time."""
-    for job, arrival in zip(jobs, arrival_times):
+def submit_jobs(sched, jobs, arrival_times, start_time, skip=0):
+    """Feed the trace to the scheduler in real time.
+
+    `skip` jobs at the head are already inside the scheduler (crash
+    recovery: their journaled submissions were replayed); arrivals the
+    outage overran are submitted immediately, later ones keep their
+    original wall-clock offsets relative to the ORIGINAL run start.
+    """
+    for job, arrival in list(zip(jobs, arrival_times))[skip:]:
         delay = start_time + arrival - time.time()
         if delay > 0:
             time.sleep(delay)
@@ -85,8 +91,27 @@ def main():
     p.add_argument("--kill_wait", type=float, default=30.0,
                    help="seconds _kill_job waits for the worker to confirm "
                         "before synthesizing a zero-step completion")
+    # Durability knobs (defaults recorded in configs/durability.json;
+    # see README "Scheduler crash recovery").
+    p.add_argument("--state_dir", "--state-dir", dest="state_dir",
+                   default=None,
+                   help="directory for the write-ahead journal + "
+                        "snapshots; enables crash recovery")
+    p.add_argument("--resume", action="store_true",
+                   help="rebuild scheduler state from --state_dir "
+                        "(snapshot + journal replay) instead of starting "
+                        "fresh")
+    p.add_argument("--snapshot_interval", "--snapshot-interval",
+                   dest="snapshot_interval", type=int, default=10,
+                   help="rounds between compacting snapshots (bounds "
+                        "journal size; 0 disables snapshots)")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args()
+    if args.resume and not args.state_dir:
+        # Silently starting fresh would resubmit the whole trace and
+        # abandon the crashed run — the exact loss --resume prevents.
+        p.error("--resume requires --state_dir (the directory of the "
+                "crashed run's journal)")
 
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,
@@ -120,11 +145,44 @@ def main():
             heartbeat_interval_s=args.heartbeat_interval,
             worker_timeout_s=args.worker_timeout,
             worker_probe_failures=args.probe_failures,
-            kill_wait_s=args.kill_wait))
+            kill_wait_s=args.kill_wait,
+            state_dir=args.state_dir, resume=args.resume,
+            snapshot_interval_rounds=args.snapshot_interval))
 
-    start_time = time.time()
+    # Crash recovery: rebase on the ORIGINAL run's start time (journaled
+    # as run_meta) so arrival offsets and makespan stay on one clock,
+    # and skip trace jobs whose submission was already replayed.
+    already_submitted = sched.num_jobs_submitted
+    start_time = sched.run_meta.get("start_time") if args.resume else None
+    if start_time is None:
+        start_time = time.time()
+        # abspath at RECORD time: the resume-side mismatch guard must
+        # compare paths independent of each process's cwd.
+        sched.record_run_meta(start_time=start_time,
+                              trace=os.path.abspath(args.trace),
+                              policy=args.policy)
+    else:
+        # The submission cursor is positional: resuming against a
+        # DIFFERENT trace (or policy) would silently skip the wrong
+        # head of the new trace and blend two workloads' accounting.
+        meta = sched.run_meta
+        for field, given in (("trace", os.path.abspath(args.trace)),
+                             ("policy", args.policy)):
+            recorded = meta.get(field)
+            if field == "trace" and recorded is not None:
+                recorded = os.path.abspath(recorded)
+            if recorded is not None and recorded != given:
+                raise SystemExit(
+                    f"--resume {field} mismatch: this state dir was "
+                    f"recorded with {field}={recorded!r}, but "
+                    f"{given!r} was passed; resume with the original "
+                    f"{field} (or use a fresh state dir)")
+        if already_submitted:
+            logging.warning("resumed with %d/%d trace jobs already "
+                            "submitted", already_submitted, len(jobs))
     submitter = threading.Thread(
-        target=submit_jobs, args=(sched, jobs, arrival_times, start_time),
+        target=submit_jobs,
+        args=(sched, jobs, arrival_times, start_time, already_submitted),
         daemon=True)
     submitter.start()
 
@@ -136,7 +194,13 @@ def main():
             os._exit(3)
         threading.Thread(target=_deadline, daemon=True).start()
 
-    sched.run()
+    if args.resume and sched.get_num_completed_jobs() >= len(jobs):
+        # The crash happened after the last completion; run() would wait
+        # forever for jobs that will never arrive.
+        logging.warning("all %d jobs had completed before the restart; "
+                        "reporting recovered metrics", len(jobs))
+    else:
+        sched.run()
     # Last completion, not teardown: run() returning includes the final
     # round's drain + shutdown, which the reference's makespan (stamped
     # as soon as is_done polls true) does not contain. The physical

@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""Offline validator for a scheduler durability state dir.
+
+Checks, without touching the live scheduler:
+- snapshot integrity (CRC footer + unpickle), including the .prev
+  fallback,
+- every journal segment's framing and CRCs, reporting a torn tail
+  (recoverable: recovery discards it) separately from deeper corruption,
+- sequence-number sanity: strictly increasing, and the post-snapshot
+  event stream starts at snapshot.last_seq + 1 or earlier (gaps below
+  the snapshot horizon are expected — compaction deletes covered
+  segments).
+
+Exit codes: 0 = clean, 1 = recoverable damage (torn tail / snapshot
+fell back to .prev), 2 = state unusable or not found.
+
+Usage:
+    python scripts/utils/fsck_journal.py <state_dir> [--verbose]
+"""
+import argparse
+import collections
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.sched.journal import (SNAPSHOT_NAME, TAIL_CLEAN,  # noqa: E402
+                                         JournalError, _read_snapshot_file,
+                                         list_segments, read_journal)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("state_dir")
+    p.add_argument("--verbose", action="store_true",
+                   help="print every record type histogram per segment")
+    args = p.parse_args()
+
+    rc = 0
+    if not os.path.isdir(args.state_dir):
+        print(f"ERROR: {args.state_dir} is not a directory")
+        return 2
+
+    # -- snapshot ------------------------------------------------------
+    snap_path = os.path.join(args.state_dir, SNAPSHOT_NAME)
+    last_seq = 0
+    snapshot = None
+    if os.path.exists(snap_path) or os.path.exists(snap_path + ".prev"):
+        snapshot = _read_snapshot_file(snap_path)
+        if snapshot is not None:
+            last_seq = int(snapshot.get("last_seq", 0))
+            print(f"snapshot: OK (covers seq <= {last_seq})")
+        else:
+            snapshot = _read_snapshot_file(snap_path + ".prev")
+            if snapshot is not None:
+                last_seq = int(snapshot.get("last_seq", 0))
+                print(f"snapshot: current CORRUPT, .prev OK "
+                      f"(covers seq <= {last_seq})")
+                rc = max(rc, 1)
+            else:
+                print("snapshot: CORRUPT (current and .prev both "
+                      "unreadable)")
+                rc = 2
+    else:
+        print("snapshot: none (journal-only state)")
+
+    # -- segments ------------------------------------------------------
+    segments = list_segments(args.state_dir)
+    if not segments and snapshot is None:
+        print("no journal segments found")
+        return 2 if rc == 0 else rc
+
+    total = 0
+    replayable = 0
+    prev_seq = None
+    prev_replayable_seq = None
+    types: collections.Counter = collections.Counter()
+    for path in segments:
+        try:
+            records, tail = read_journal(path)
+        except JournalError as e:
+            print(f"{os.path.basename(path)}: UNREADABLE ({e})")
+            rc = 2
+            continue
+        seg_types = collections.Counter(r.get("type", "?") for r in records)
+        types.update(seg_types)
+        total += len(records)
+        for r in records:
+            seq = int(r.get("seq", 0))
+            if prev_seq is not None and seq <= prev_seq:
+                print(f"{os.path.basename(path)}: seq {seq} not "
+                      f"increasing (prev {prev_seq})")
+                rc = 2
+            prev_seq = seq
+            if seq > last_seq:
+                # The replayable stream must be gapless: sequences are
+                # allocated one at a time, so a jump means a lost
+                # segment (or manual deletion) — recovery would
+                # silently skip the missing events.
+                expected = (last_seq if prev_replayable_seq is None
+                            else prev_replayable_seq) + 1
+                if seq != expected:
+                    print(f"{os.path.basename(path)}: GAP in replayable "
+                          f"stream — expected seq {expected}, found "
+                          f"{seq} (events lost?)")
+                    rc = 2
+                prev_replayable_seq = seq
+                replayable += 1
+        status = "OK" if tail == TAIL_CLEAN else "TORN TAIL (recoverable)"
+        if tail != TAIL_CLEAN:
+            rc = max(rc, 1)
+        print(f"{os.path.basename(path)}: {len(records)} records, {status}")
+        if args.verbose and seg_types:
+            for etype, count in sorted(seg_types.items()):
+                print(f"    {etype}: {count}")
+
+    print(f"total: {total} journal records, {replayable} replayable past "
+          f"the snapshot horizon")
+    if types and not args.verbose:
+        top = ", ".join(f"{t}={c}" for t, c in types.most_common(6))
+        print(f"event mix: {top}")
+    print({0: "CLEAN", 1: "RECOVERABLE DAMAGE", 2: "UNUSABLE"}[rc])
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Shared crash-safe file-write plumbing.
+
+One implementation of the durable-write recipe (CRC32+magic footer,
+file fsync, previous-generation retention, atomic rename, directory
+fsync) used by both the scheduler's snapshot store
+(`sched/journal.py`) and the trainers' checkpoint writer
+(`models/train_common.py`). Crash-safety logic must not fork: a fix on
+one side (e.g. a filesystem quirk around fsync) must reach the other.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Optional, Tuple
+
+FOOTER_OK = "ok"            # footer present, CRC verified
+FOOTER_MISSING = "missing"  # no footer (legacy / foreign / torn file)
+FOOTER_CORRUPT = "corrupt"  # footer present but CRC mismatch
+
+
+def fsync_dir(path: str) -> None:
+    """Make a rename/create in `path` durable (POSIX requires fsyncing
+    the directory, not just the file)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds; best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_durable(path: str, payload: bytes, magic: bytes,
+                  keep_prev: bool = True) -> str:
+    """Write `payload` + CRC footer to `path` crash-safely: tmp file,
+    fsync, retain the existing generation as `<path>.prev`, atomic
+    rename, directory fsync. A crash at any step leaves either the old
+    file, the old file as .prev, or both generations intact."""
+    tmp = path + ".tmp"
+    footer = struct.pack("<I", zlib.crc32(payload)) + magic
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.write(footer)
+        f.flush()
+        os.fsync(f.fileno())
+    if keep_prev and os.path.exists(path):
+        os.replace(path, path + ".prev")
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".")
+    return path
+
+
+def verify_footer(blob: bytes, magic: bytes) -> Tuple[str, Optional[bytes]]:
+    """Check `blob`'s integrity footer. Returns (status, payload):
+    (FOOTER_OK, payload) with the footer stripped, (FOOTER_MISSING,
+    None) when no footer is present (callers decide whether legacy
+    footer-less content is acceptable), or (FOOTER_CORRUPT, None)."""
+    trailer = 4 + len(magic)
+    if len(blob) < trailer or not blob.endswith(magic):
+        return (FOOTER_MISSING, None)
+    payload = blob[:-trailer]
+    (crc,) = struct.unpack("<I", blob[-trailer:-len(magic)])
+    if zlib.crc32(payload) != crc:
+        return (FOOTER_CORRUPT, None)
+    return (FOOTER_OK, payload)

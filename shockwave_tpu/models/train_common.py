@@ -128,13 +128,23 @@ def checkpoint_path(checkpoint_dir: str) -> str:
     return os.path.join(checkpoint_dir, "model.ckpt")
 
 
+# Integrity footer appended to every checkpoint: crc32(payload) + magic.
+# A footer-less file is a pre-footer (legacy) checkpoint and is loaded
+# unverified rather than rejected.
+_CKPT_MAGIC = b"SWCKPT1\n"
+
+
 def save_checkpoint(path: str, state: dict) -> None:
+    """Durable checkpoint write (core/durable_io): CRC-footered payload,
+    fsync'd file and directory, previous checkpoint retained as
+    `<path>.prev` so a save interrupted by preemption (or a corrupted
+    current file) never costs the job ALL of its progress —
+    load_checkpoint falls back."""
+    from ..core.durable_io import write_durable
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = path + ".tmp"
     state_dict = flax.serialization.to_state_dict(jax.device_get(state))
-    with open(tmp, "wb") as f:
-        f.write(flax.serialization.msgpack_serialize(state_dict))
-    os.replace(tmp, path)  # atomic so a preemption can't corrupt it
+    payload = flax.serialization.msgpack_serialize(state_dict)
+    write_durable(path, payload, _CKPT_MAGIC)
 
 
 def save_checkpoint_rank0(path: str, state: dict) -> None:
@@ -145,12 +155,50 @@ def save_checkpoint_rank0(path: str, state: dict) -> None:
         save_checkpoint(path, state)
 
 
-def load_checkpoint(path: str, template: dict) -> Optional[dict]:
-    if not os.path.exists(path):
+def _read_verified_payload(path: str) -> Optional[bytes]:
+    """Checkpoint bytes with the integrity footer verified and stripped;
+    None if missing or corrupt. Legacy footer-less files pass through
+    unverified (msgpack decode is their only check)."""
+    from ..core.durable_io import FOOTER_CORRUPT, FOOTER_OK, verify_footer
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
         return None
-    with open(path, "rb") as f:
-        restored = flax.serialization.msgpack_restore(f.read())
-    return flax.serialization.from_state_dict(template, restored)
+    status, payload = verify_footer(blob, _CKPT_MAGIC)
+    if status == FOOTER_OK:
+        return payload
+    if status == FOOTER_CORRUPT:
+        logging.getLogger(__name__).warning(
+            "checkpoint %s fails CRC; ignoring it", path)
+        return None
+    return blob or None  # legacy footer-less checkpoint
+
+
+def load_checkpoint(path: str, template: dict) -> Optional[dict]:
+    """Load `path`, falling back to `<path>.prev` — and to a fresh start
+    (None) — on corruption instead of crashing the trainer: on
+    preemptible capacity a torn checkpoint is a when, not an if."""
+    log = logging.getLogger(__name__)
+    for candidate in (path, path + ".prev"):
+        if not os.path.exists(candidate):
+            continue
+        payload = _read_verified_payload(candidate)
+        if payload is None:
+            continue
+        try:
+            restored = flax.serialization.msgpack_restore(payload)
+            result = flax.serialization.from_state_dict(template, restored)
+        except Exception as e:  # noqa: BLE001 - any decode failure means
+            # the file is unusable; the fallback chain continues.
+            log.warning("checkpoint %s unreadable (%s: %s); trying "
+                        "fallback", candidate, type(e).__name__, e)
+            continue
+        if candidate != path:
+            log.warning("restored from previous checkpoint %s (current "
+                        "was missing or corrupt)", candidate)
+        return result
+    return None
 
 
 class AccordionMonitor:

@@ -1,3 +1,4 @@
+from . import journal
 from .scheduler import Scheduler, SchedulerConfig
 
-__all__ = ["Scheduler", "SchedulerConfig"]
+__all__ = ["Scheduler", "SchedulerConfig", "journal"]

@@ -1,0 +1,445 @@
+"""Durable scheduler state: write-ahead journal + compacting snapshots.
+
+The physical scheduler is a single long-lived process driving round-based
+leases; on preemptible capacity its own death is routine, not exceptional.
+This module gives it the standard durability recipe:
+
+- **Write-ahead journal**: an append-only file of CRC-framed JSON records
+  (job lifecycle, worker membership, round bookkeeping, micro-task
+  progress, planner sync, solve outcomes). Every append is flushed and
+  fsync'd before the mutation is considered durable. A torn tail — the
+  partial record a crash mid-append leaves behind — is detected by the
+  length/CRC frame and discarded on the next open, never fatal.
+
+- **Compacting snapshots**: a pickle of the scheduler's durable state,
+  written atomically (tmp + fsync + rename + directory fsync) with the
+  previous snapshot retained as a fallback. Each snapshot records the
+  journal sequence it covers; segments the PREVIOUS snapshot no longer
+  needs are deleted — the `.prev` fallback must keep its replay tail —
+  so the journal's size is bounded by two snapshot intervals of events.
+
+- **Recovery**: `load_state` returns the newest loadable snapshot plus
+  every journal event after it, in order. The scheduler rebuilds itself
+  by restoring the snapshot and replaying the events
+  (`Scheduler.restore_from_durable_state`).
+
+State-dir layout:
+
+    <state_dir>/
+      snapshot.pkl           # latest snapshot (atomic replace)
+      snapshot.pkl.prev      # previous snapshot (corruption fallback)
+      journal.<seq12>.log    # CRC-framed segments; <seq12> = first seq
+
+Record frame: ``<u32 payload_len> <u32 crc32(payload)> <payload>`` where
+payload is UTF-8 JSON ``{"seq": n, "type": str, "t": wall, "data": {...}}``.
+Files start with an 8-byte magic so an unrelated file is rejected loudly
+rather than replayed.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import re
+import struct
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional, Tuple
+
+from ..core.durable_io import (FOOTER_OK, fsync_dir as _fsync_dir,
+                               verify_footer, write_durable)
+
+logger = logging.getLogger("shockwave_tpu.sched.journal")
+
+JOURNAL_MAGIC = b"SWTPUJ1\n"
+SNAPSHOT_MAGIC = b"SWTPUS1\n"
+_FRAME = struct.Struct("<II")
+_SEGMENT_RE = re.compile(r"^journal\.(\d{12})\.log$")
+
+SNAPSHOT_NAME = "snapshot.pkl"
+
+#: Tail status of a journal read.
+TAIL_CLEAN = "clean"      # file ends exactly at a record boundary
+TAIL_TORN = "torn"        # trailing partial/corrupt record discarded
+
+
+class JournalError(Exception):
+    """Unrecoverable journal problem (bad magic, unreadable file)."""
+
+
+def _scan_records(data: bytes) -> Tuple[List[dict], int, str]:
+    """Parse framed records out of `data` (magic already stripped).
+
+    Returns (records, valid_byte_length, tail_status). Parsing stops at
+    the first bad frame — a crash mid-append leaves exactly one torn
+    record at the tail, and anything after a bad frame is unframed
+    garbage by construction.
+    """
+    records: List[dict] = []
+    off = 0
+    n = len(data)
+    while off < n:
+        if n - off < _FRAME.size:
+            return records, off, TAIL_TORN
+        length, crc = _FRAME.unpack_from(data, off)
+        start = off + _FRAME.size
+        end = start + length
+        if length == 0 or end > n:
+            return records, off, TAIL_TORN
+        payload = data[start:end]
+        if zlib.crc32(payload) != crc:
+            return records, off, TAIL_TORN
+        try:
+            rec = json.loads(payload.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            return records, off, TAIL_TORN
+        records.append(rec)
+        off = end
+    return records, off, TAIL_CLEAN
+
+
+def read_journal(path: str, strict: bool = False) -> Tuple[List[dict], str]:
+    """Read one journal segment. Returns (records, tail_status).
+
+    A torn tail (partial last record from a crash mid-append) is
+    discarded; with `strict`, it raises instead (fsck uses strict to
+    report, recovery never does).
+    """
+    with open(path, "rb") as f:
+        blob = f.read()
+    if not blob.startswith(JOURNAL_MAGIC):
+        raise JournalError(f"{path}: bad journal magic")
+    records, _, status = _scan_records(blob[len(JOURNAL_MAGIC):])
+    if status != TAIL_CLEAN:
+        if strict:
+            raise JournalError(f"{path}: torn tail after {len(records)} "
+                               "records")
+        logger.warning("journal %s has a torn tail; %d valid records kept",
+                       path, len(records))
+    return records, status
+
+
+class JournalWriter:
+    """Append-only CRC-framed record writer with per-append fsync.
+
+    Opening an existing segment first truncates any torn tail so new
+    appends land at a record boundary (otherwise everything after the
+    crash leftover would be unreadable).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                blob = f.read()
+            if not blob.startswith(JOURNAL_MAGIC):
+                raise JournalError(f"{path}: bad journal magic")
+            _, valid, status = _scan_records(blob[len(JOURNAL_MAGIC):])
+            self._f = open(path, "r+b")
+            if status != TAIL_CLEAN:
+                logger.warning("truncating torn tail of %s at byte %d",
+                               path, len(JOURNAL_MAGIC) + valid)
+                self._f.truncate(len(JOURNAL_MAGIC) + valid)
+            self._f.seek(len(JOURNAL_MAGIC) + valid)
+        else:
+            self._f = open(path, "w+b")
+            self._f.write(JOURNAL_MAGIC)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            _fsync_dir(os.path.dirname(path) or ".")
+
+    def append(self, record: dict, sync: bool = True) -> None:
+        """Append one framed record. With `sync` (the default) the
+        record is fsync'd before return — required for write-ahead
+        semantics. Audit-only records may pass sync=False: they ride to
+        disk with the next durable append, and losing the tail of them
+        in a crash costs nothing (their replay handlers are no-ops)."""
+        payload = json.dumps(record, separators=(",", ":"),
+                             default=str).encode("utf-8")
+        self._f.write(_FRAME.pack(len(payload), zlib.crc32(payload)))
+        self._f.write(payload)
+        self._f.flush()
+        if sync:
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:  # already closed / fs went away
+            pass
+
+
+# ----------------------------------------------------------------------
+# Snapshots
+# ----------------------------------------------------------------------
+
+def write_snapshot(state_dir: str, payload: dict) -> str:
+    """Atomically persist `payload`: tmp + fsync + rename + dir fsync,
+    retaining the previous snapshot as `.prev` for corruption fallback."""
+    return write_durable(
+        os.path.join(state_dir, SNAPSHOT_NAME),
+        pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL),
+        SNAPSHOT_MAGIC)
+
+
+def _read_snapshot_file(path: str) -> Optional[dict]:
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return None
+    status, body = verify_footer(blob, SNAPSHOT_MAGIC)
+    if status != FOOTER_OK:
+        # Unlike trainer checkpoints there is no legacy footer-less
+        # snapshot format, so "missing" is corruption too.
+        logger.warning("snapshot %s integrity check failed (%s); "
+                       "rejecting", path, status)
+        return None
+    try:
+        payload = pickle.loads(body)
+    except Exception:  # noqa: BLE001 - any unpickle failure means corrupt
+        logger.exception("snapshot %s unreadable despite valid CRC", path)
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def load_snapshot(state_dir: str) -> Optional[dict]:
+    """Newest loadable snapshot: current first, `.prev` fallback."""
+    path = os.path.join(state_dir, SNAPSHOT_NAME)
+    payload = _read_snapshot_file(path)
+    if payload is not None:
+        return payload
+    prev = _read_snapshot_file(path + ".prev")
+    if prev is not None:
+        logger.warning("snapshot %s unusable; recovered from previous "
+                       "snapshot", path)
+    return prev
+
+
+# ----------------------------------------------------------------------
+# Segments / recovery
+# ----------------------------------------------------------------------
+
+def list_segments(state_dir: str) -> List[str]:
+    """Journal segment paths in sequence order."""
+    try:
+        names = os.listdir(state_dir)
+    except OSError:
+        return []
+    segs = [(int(m.group(1)), os.path.join(state_dir, name))
+            for name in names
+            for m in (_SEGMENT_RE.match(name),) if m]
+    return [path for _, path in sorted(segs)]
+
+
+def _segment_path(state_dir: str, start_seq: int) -> str:
+    return os.path.join(state_dir, f"journal.{start_seq:012d}.log")
+
+
+def has_state(state_dir: str) -> bool:
+    """Whether `state_dir` holds any prior scheduler state — judged by
+    what recovery would actually use (load_snapshot consults the .prev
+    fallback, so a dir whose current snapshot is corrupt but whose
+    previous one loads still counts as stateful)."""
+    if load_snapshot(state_dir):
+        return True
+    for path in list_segments(state_dir):
+        try:
+            records, _ = read_journal(path)
+        except JournalError:
+            return True  # unreadable state still counts as "present"
+        if records:
+            return True
+    return False
+
+
+@dataclass
+class RecoveredState:
+    """Everything recovery needs: newest snapshot (or None) plus every
+    journal event after it, in sequence order."""
+    snapshot: Optional[dict] = None
+    events: List[dict] = field(default_factory=list)
+    tail_status: str = TAIL_CLEAN
+    segments: List[str] = field(default_factory=list)
+
+    @property
+    def last_seq(self) -> int:
+        if self.events:
+            return int(self.events[-1].get("seq", 0))
+        if self.snapshot is not None:
+            return int(self.snapshot.get("last_seq", 0))
+        return 0
+
+
+def load_state(state_dir: str) -> RecoveredState:
+    """Load snapshot + post-snapshot journal events from `state_dir`.
+
+    Raises JournalError when no snapshot loads but the surviving
+    journal provably does not start at the beginning (seq 1): the
+    missing head was compacted away on the strength of snapshots that
+    are now unreadable, and replaying the truncated tail onto an empty
+    scheduler would misnumber every job and silently drop accounting.
+    Refusing loudly beats resuming with garbage."""
+    snapshot = load_snapshot(state_dir)
+    min_seq = int(snapshot.get("last_seq", 0)) if snapshot else 0
+    events: List[dict] = []
+    tail = TAIL_CLEAN
+    segments = list_segments(state_dir)
+    for path in segments:
+        records, status = read_journal(path)
+        if status != TAIL_CLEAN:
+            tail = status
+        events.extend(r for r in records if int(r.get("seq", 0)) > min_seq)
+    events.sort(key=lambda r: int(r.get("seq", 0)))
+    if snapshot is None and events and int(events[0].get("seq", 0)) > 1:
+        raise JournalError(
+            f"{state_dir}: no readable snapshot, and the journal starts "
+            f"at seq {events[0].get('seq')} (events 1.."
+            f"{int(events[0].get('seq', 1)) - 1} were compacted into the "
+            "now-unreadable snapshots) — state is unrecoverable; run "
+            "scripts/utils/fsck_journal.py for details")
+    return RecoveredState(snapshot=snapshot, events=events,
+                          tail_status=tail, segments=segments)
+
+
+class DurabilityLayer:
+    """The scheduler's durable-state sink: sequenced journal appends plus
+    compacting snapshots. Thread-safe (RPC callbacks, watchdog timers and
+    the round loop all emit)."""
+
+    def __init__(self, state_dir: str,
+                 snapshot_interval_rounds: int = 10):
+        os.makedirs(state_dir, exist_ok=True)
+        self.state_dir = state_dir
+        self.snapshot_interval_rounds = snapshot_interval_rounds
+        self._lock = threading.Lock()
+
+        last_seq = 0
+        snapshot = load_snapshot(state_dir)
+        if snapshot is not None:
+            last_seq = int(snapshot.get("last_seq", 0))
+        # The horizon of the CURRENT on-disk snapshot (what the next
+        # compaction may delete up to: segments older than this are only
+        # needed by a snapshot generation that no longer exists).
+        self._snap_seq = last_seq
+        segments = list_segments(state_dir)
+        for path in reversed(segments):
+            records, _ = read_journal(path)
+            if records:
+                last_seq = max(last_seq, int(records[-1].get("seq", 0)))
+                break
+        self._seq = last_seq
+        # Continue the newest segment (its torn tail, if any, is truncated
+        # by JournalWriter) or start the first one.
+        path = segments[-1] if segments else _segment_path(state_dir,
+                                                           last_seq + 1)
+        self._writer: Optional[JournalWriter] = JournalWriter(path)
+
+    @property
+    def last_seq(self) -> int:
+        return self._seq
+
+    def record(self, etype: str, data: dict, sync: bool = True) -> int:
+        """Append one event; returns its sequence number. sync=False is
+        for audit-only events (see JournalWriter.append)."""
+        with self._lock:
+            if self._writer is None:
+                raise JournalError("durability layer is closed")
+            # Claim the sequence number only once the append succeeded:
+            # a failed append (ENOSPC, ...) is swallowed by the emitter,
+            # and burning the number would leave a permanent gap that
+            # fsck_journal flags as lost events.
+            seq = self._seq + 1
+            self._writer.append({"seq": seq, "type": etype,
+                                 "t": time.time(), "data": data},
+                                sync=sync)
+            self._seq = seq
+            return seq
+
+    def snapshot(self, payload: dict) -> None:
+        """Write a compacting snapshot covering every event so far, then
+        rotate. Only segments the OUTGOING snapshot (now `.prev`) no
+        longer needs are deleted: if the new snapshot.pkl is later
+        unreadable and recovery falls back to `.prev`, the events
+        between the two snapshot horizons must still exist to replay.
+        Journal size is therefore bounded by TWO snapshot intervals.
+        Crash-safe at every step — recovery filters replay by
+        `last_seq`, so a crash between the snapshot rename and the
+        segment deletion only leaves already-covered (skipped) events
+        behind."""
+        with self._lock:
+            if self._writer is None:
+                raise JournalError("durability layer is closed")
+            payload = dict(payload)
+            payload["last_seq"] = self._seq
+            payload.setdefault("time", time.time())
+            write_snapshot(self.state_dir, payload)
+            prev_horizon = self._snap_seq  # the snapshot now at .prev
+            self._snap_seq = self._seq
+            old_segment = self._writer.path
+            self._writer.close()
+            for path in list_segments(self.state_dir):
+                # Deletable iff every record is at or below the .prev
+                # horizon. Judged by the segment's actual LAST record —
+                # not its filename start seq — because a crash between
+                # write_snapshot and rotation leaves a segment SPANNING
+                # a snapshot horizon, and a name-based rule would delete
+                # events the .prev fallback still needs. Segments are
+                # bounded (~2 intervals), so the read is cheap.
+                try:
+                    records, _ = read_journal(path)
+                except JournalError:
+                    logger.warning("unreadable segment %s left in place",
+                                   path)
+                    continue
+                if records and int(records[-1].get("seq", 0)) > prev_horizon:
+                    continue
+                try:
+                    os.remove(path)
+                except OSError:
+                    logger.warning("could not remove compacted segment %s",
+                                   path)
+            _fsync_dir(self.state_dir)
+            try:
+                self._writer = JournalWriter(
+                    _segment_path(self.state_dir, self._seq + 1))
+            except Exception:  # noqa: BLE001 - rotation failed (ENOSPC,
+                # EACCES, ...): the layer must NOT be left holding the
+                # closed writer, where every later append would fail
+                # silently per-event and a crash would lose a whole
+                # interval. Fall back to the previous segment; if even
+                # that fails, go loudly closed.
+                logger.exception("journal rotation failed; reopening "
+                                 "previous segment %s", old_segment)
+                try:
+                    self._writer = JournalWriter(old_segment)
+                except Exception:
+                    self._writer = None
+                    raise
+
+    def close(self) -> None:
+        with self._lock:
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
+
+
+# ----------------------------------------------------------------------
+# Job-key codec (JobIdPair <-> JSON-safe key)
+# ----------------------------------------------------------------------
+
+def encode_job_key(job_id) -> object:
+    """JobIdPair -> JSON-safe key: bare int for singles, [lo, hi] pairs."""
+    if job_id.is_pair():
+        return [job_id[0], job_id[1]]
+    return job_id.integer_job_id()
+
+
+def decode_job_key(key):
+    from ..core.job import JobIdPair
+    if isinstance(key, (list, tuple)):
+        return JobIdPair(int(key[0]), int(key[1]))
+    return JobIdPair(int(key))

@@ -29,6 +29,7 @@ import grpc
 
 from ..core.job import JobIdPair
 from ..runtime.resilience import RpcUnavailableError
+from .journal import encode_job_key
 from .scheduler import DEADLINE_SLACK, INFINITY, Scheduler, SchedulerConfig
 
 logger = logging.getLogger("shockwave_tpu.sched")
@@ -108,6 +109,37 @@ class PhysicalScheduler(Scheduler):
         self._port_offset = 0
         self._done_event = threading.Event()
 
+        # Durability: recover BEFORE the gRPC server starts (RPCs land
+        # the moment the port is bound, and they must see the rebuilt
+        # state), then attach the journal so every subsequent mutation
+        # is written ahead.
+        self._durability = None
+        self._recovered = False
+        self._recovered_at = 0.0
+        if self._config.resume and not self._config.state_dir:
+            raise ValueError("config error: resume=True requires "
+                             "state_dir (there is no journal to recover "
+                             "from)")
+        if self._config.state_dir:
+            from .journal import DurabilityLayer, has_state, load_state
+            if self._config.resume:
+                recovered = load_state(self._config.state_dir)
+                self.restore_from_durable_state(recovered)
+                self._recovered = True
+                self._recovered_at = self.get_current_timestamp()
+            elif has_state(self._config.state_dir):
+                raise ValueError(
+                    f"state dir {self._config.state_dir!r} contains "
+                    "existing scheduler state; pass resume=True "
+                    "(--resume) to recover it, or point state_dir at a "
+                    "fresh directory")
+            self._durability = DurabilityLayer(
+                self._config.state_dir,
+                self._config.snapshot_interval_rounds)
+            self.attach_durability(self._durability)
+            if self._recovered:
+                self._requeue_inflight_after_recovery()
+
         from ..runtime.servers import serve_scheduler
         self._server = serve_scheduler(port, {
             "RegisterWorker": self._register_worker_rpc,
@@ -163,6 +195,112 @@ class PhysicalScheduler(Scheduler):
             self._done_stamp.pop(key, None)
 
     # ------------------------------------------------------------------
+    # Durability (physical extensions)
+    # ------------------------------------------------------------------
+
+    def snapshot_state(self) -> dict:
+        state = super().snapshot_state()
+        # Host endpoints (not clients — those are rebuilt on restore) so
+        # a restarted scheduler can re-adopt its workers without waiting
+        # for daemons to re-register.
+        state["worker_hosts"] = {
+            key: dict(worker_type=host["worker_type"],
+                      num_chips=host["num_chips"],
+                      worker_ids=list(host["worker_ids"]))
+            for key, host in self._worker_hosts.items()}
+        return state
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        for key, host in state.get("worker_hosts", {}).items():
+            self._adopt_worker_host(key[0], int(key[1]),
+                                    host["worker_type"],
+                                    host["num_chips"],
+                                    [int(i) for i in host["worker_ids"]])
+
+    def _adopt_worker_host(self, addr: str, port: int, worker_type: str,
+                           num_chips: int, worker_ids) -> None:
+        """Rebuild the connection plumbing for a journaled worker host.
+        The daemon may be long dead — last_seen is stamped `now`, so the
+        liveness monitor gives it one timeout window to answer a probe
+        before its chips are retired (and a later heal revives them)."""
+        from ..runtime.clients import SchedulerToWorkerClient
+        key = (addr, port)
+        old = self._worker_hosts.get(key)
+        if old is not None:
+            self._close_host_client(old)
+        client = SchedulerToWorkerClient(addr, port)
+        now = self.get_current_timestamp()
+        for worker_id in worker_ids:
+            self._worker_connections[worker_id] = client
+            if worker_id not in self.workers.dead:
+                self.workers.last_seen[worker_id] = now
+        self._worker_hosts[key] = dict(
+            worker_type=worker_type, num_chips=num_chips,
+            worker_ids=list(worker_ids), client=client, probe_failures=0)
+
+    def _replay_worker_host(self, data: dict) -> None:
+        self._adopt_worker_host(data["addr"], int(data["port"]),
+                                data["worker_type"],
+                                int(data.get("num_chips", 1)),
+                                [int(i) for i in data["worker_ids"]])
+
+    def _requeue_inflight_after_recovery(self) -> None:
+        """Conservative re-adoption of whatever was in flight at the
+        crash: every assignment is dropped and its job requeued by the
+        next allocation — WITHOUT charging the job a failure (the crash
+        was the scheduler's fault, not the job's). Orphan trainers still
+        holding pre-crash leases drain via the post-recovery gates in
+        done_callback / _update_lease_callback."""
+        requeued = [job_id for job_id in self.rounds.current_assignments
+                    if any(m in self.acct.jobs
+                           for m in job_id.singletons())]
+        now = self.get_current_timestamp()
+        for job_id in requeued:
+            for m in job_id.singletons():
+                int_id = m.integer_job_id()
+                if int_id in self._job_timelines:
+                    self._job_timelines[int_id].append(
+                        f"t={now:.1f} RECOVERY_REQUEUE scheduler "
+                        "restarted mid-round; lease abandoned")
+        self.rounds.abandon_in_flight()
+        self._redispatch_assignments = collections.OrderedDict()
+        self._running_jobs.clear()
+        self._in_progress_updates.clear()
+        self._iterator_log_buffers.clear()
+        self._dispatch_stamp.clear()
+        self._done_stamp.clear()
+        self._failure_compensated.clear()
+        self._last_heartbeat.clear()
+        self._ever_signaled.clear()
+        self._kill_rearm_counts.clear()
+        for job_id in list(self._steps_run_in_current_lease):
+            self._steps_run_in_current_lease[job_id] = 0
+        for job_id in self.acct.jobs:
+            self._lease_update_requests[job_id] = []
+            self._max_steps_consensus[job_id] = None
+        self._need_to_update_allocation = True
+        if requeued:
+            self.log.warning(
+                "[Recovery] %d in-flight jobs requeued conservatively "
+                "(no failure charged): %s", len(requeued), requeued)
+
+    def _maybe_snapshot(self) -> None:
+        """End-of-round compacting snapshot every
+        snapshot_interval_rounds rounds. Must hold the lock."""
+        interval = self._config.snapshot_interval_rounds
+        if (self._durability is None or not interval
+                or self.rounds.num_completed_rounds % interval != 0):
+            return
+        try:
+            self._durability.snapshot({"state": self.snapshot_state()})
+            self.log.info("snapshot written at round %d (journal "
+                          "compacted)", self.rounds.num_completed_rounds)
+        except Exception:  # noqa: BLE001 - durability must not kill rounds
+            self.log.exception("snapshot failed at round %d",
+                               self.rounds.num_completed_rounds)
+
+    # ------------------------------------------------------------------
     # RPC callbacks
     # ------------------------------------------------------------------
 
@@ -179,8 +317,11 @@ class PhysicalScheduler(Scheduler):
             if host is not None:
                 if (host["worker_type"] == worker_type
                         and host["num_chips"] == num_chips):
-                    return (self._revive_worker_host(key),
-                            self._time_per_iteration)
+                    ids = self._revive_worker_host(key)
+                    self._emit("worker_host", addr=ip_addr, port=port,
+                               worker_type=worker_type,
+                               num_chips=num_chips, worker_ids=list(ids))
+                    return (ids, self._time_per_iteration)
                 # Same endpoint, different shape: retire the old
                 # incarnation and register fresh below.
                 self.log.warning(
@@ -202,6 +343,9 @@ class PhysicalScheduler(Scheduler):
                 worker_type=worker_type, num_chips=num_chips,
                 worker_ids=list(worker_ids), client=client,
                 probe_failures=0)
+            self._emit("worker_host", addr=ip_addr, port=port,
+                       worker_type=worker_type, num_chips=num_chips,
+                       worker_ids=list(worker_ids))
             self._cv.notify_all()
         return worker_ids, round_duration
 
@@ -418,6 +562,12 @@ class PhysicalScheduler(Scheduler):
                     and job_id not in self._failure_compensated):
                 self._failure_compensated.add(job_id)
                 self.acct.failures[job_id] -= 1
+                # The synthesized zero-step done below journals as a
+                # failed micro-task (+1 on replay); journal the
+                # compensation too or a recovered scheduler would charge
+                # the job for its worker's crash.
+                self._emit("failure_comp",
+                           int_id=job_id.integer_job_id())
             zeros = [0 for _ in job_id.singletons()]
             for worker_id in missing:
                 self.done_callback(job_id, worker_id, zeros, zeros)
@@ -435,6 +585,13 @@ class PhysicalScheduler(Scheduler):
         """Grant the initial lease (reference: scheduler.py:3880-4048)."""
         with self._cv:
             if job_id not in self.acct.jobs:
+                return (0, 0.0, 0.0)
+            if self._is_recovery_orphan(job_id):
+                # Trainer spawned by the pre-crash incarnation coming up
+                # after the restart: zero lease — its round was requeued
+                # at recovery and a fresh dispatch will respawn it.
+                self.log.warning("zero lease for pre-restart init of job "
+                                 "%s (round requeued at recovery)", job_id)
                 return (0, 0.0, 0.0)
             # If the job was dispatched early for the *next* round, wait for
             # its current-round run (or a colocated partner) to finish.
@@ -470,18 +627,29 @@ class PhysicalScheduler(Scheduler):
             round_end = self._current_round_start_time + self._time_per_iteration
             time_left = max(round_end - now, 0.0)
 
+            def grant(steps, duration, extra):
+                # Audit record (replay is a no-op; lease terms are
+                # re-derived on redispatch after a restart), so it rides
+                # the non-fsync path — an Init RPC must not pay a disk
+                # barrier under the scheduler lock for telemetry.
+                self._emit_audit("lease_granted",
+                                 key=encode_job_key(job_id),
+                                 steps=steps, duration=duration, ts=now)
+                return (steps, duration, extra)
+
             if self.rounds.next_assignments is not None and next_combo is not None:
                 # Early dispatch for the next round: full round + leftover.
-                return (remaining, self._time_per_iteration, time_left)
+                return grant(remaining, self._time_per_iteration, time_left)
             if time_left > 0:
                 # Floor clamped to the round duration: with short rounds
                 # (< INIT_LEASE_FLOOR_S) an unclamped floor would overrun
                 # every round and delay the next dispatch on this chip.
                 floor = min(INIT_LEASE_FLOOR_S, self._time_per_iteration)
-                return (remaining, max(time_left, floor), 0.0)
+                return grant(remaining, max(time_left, floor), 0.0)
             # Init in the gap between rounds.
-            return (remaining, self._time_per_iteration - EARLY_INIT_THRESHOLD,
-                    time_left)
+            return grant(remaining,
+                         self._time_per_iteration - EARLY_INIT_THRESHOLD,
+                         time_left)
 
     def _update_lease_callback(self, job_id: JobIdPair, worker_id: int,
                                steps: int, duration: float, max_steps: int,
@@ -500,6 +668,15 @@ class PhysicalScheduler(Scheduler):
                 # the gang consensus slots below.
                 self.log.warning("expiring lease of orphaned job %s on "
                                  "dead worker %d", job_id, worker_id)
+                return (0, 0.0, 0.0, 0.0)
+            if self._is_recovery_orphan(job_id, worker_id):
+                # Pre-crash trainer still holding a lease this restarted
+                # scheduler never granted: expire it so the process
+                # checkpoints and exits instead of racing the requeued
+                # copy for the checkpoint file.
+                self.log.warning("expiring pre-restart lease of job %s "
+                                 "(worker %d); its round was requeued at "
+                                 "recovery", job_id, worker_id)
                 return (0, 0.0, 0.0, 0.0)
             job = self.acct.jobs[job_id]
             run_time_so_far = int(
@@ -567,6 +744,8 @@ class PhysicalScheduler(Scheduler):
                 self._bs_flags[job_id]["big_bs"] = True
             else:
                 self._bs_flags[job_id]["small_bs"] = True
+            self._emit("bs_flag", int_id=job_id.integer_job_id(),
+                       big=bool(big_bs), small=not big_bs)
             self._cv.notify_all()
 
     def _is_duplicate_done(self, job_id: JobIdPair, worker_id: int) -> bool:
@@ -577,9 +756,61 @@ class PhysicalScheduler(Scheduler):
         return (dispatched is not None and accepted is not None
                 and accepted == dispatched)
 
+    def _job_assigned(self, job_id: JobIdPair,
+                      worker_id: Optional[int] = None) -> bool:
+        """Whether a current/next/redispatch assignment covers job_id —
+        on worker_id's chip specifically when given, on any worker
+        otherwise. Must hold the lock."""
+        maps = [self.rounds.current_assignments,
+                self._redispatch_assignments]
+        if self.rounds.next_assignments is not None:
+            maps.append(self.rounds.next_assignments)
+        return any(job_id.overlaps_with(combo)
+                   and (worker_id is None or worker_id in ids)
+                   for m in maps for combo, ids in m.items())
+
+    def _is_recovery_orphan(self, job_id: JobIdPair,
+                            worker_id: Optional[int] = None) -> bool:
+        """Whether an Init/UpdateLease should be treated as coming from
+        a pre-crash orphan trainer and given a zero lease.
+
+        With `worker_id` (lease renewals), the job must be assigned to
+        THAT worker: after the requeued job is redispatched elsewhere,
+        the pre-crash copy on its old (live) worker must still be
+        expired, or two copies train concurrently racing the checkpoint
+        file. Init has no worker identity, so it falls back to the
+        job-level check.
+
+        Time-bounded: pre-crash trainers identify themselves within one
+        startup window (Init) or one lease renewal (UpdateLease) of the
+        restart. Past that window the gate stands down and the normal
+        (pre-durability) semantics resume — a permanently armed gate
+        would also zero-lease THIS incarnation's own slow-initializing
+        trainers whose round rolled during a long compile, livelocking
+        them on kill/requeue forever. Must hold the lock."""
+        if not self._recovered or self._job_assigned(job_id, worker_id):
+            return False
+        window = max(self._config.first_init_grace_s or 0.0,
+                     2.0 * self._time_per_iteration
+                     + (self._config.job_completion_buffer_s
+                        if self._config.job_completion_buffer_s is not None
+                        else JOB_COMPLETION_BUFFER_TIME))
+        return self.get_current_timestamp() - self._recovered_at < window
+
     def done_callback(self, job_id, worker_id, all_num_steps,
                       all_execution_times, iterator_logs=None):
         with self._cv:
+            # Post-restart gate: a report whose dispatch this scheduler
+            # incarnation never made is a pre-crash orphan (its round
+            # was conservatively requeued at recovery; accepting it
+            # would double-credit the redispatched copy's work).
+            if (self._recovered
+                    and (job_id, worker_id) not in self._dispatch_stamp):
+                self.log.warning(
+                    "discarding pre-restart completion for job %s from "
+                    "worker %d (no dispatch this incarnation)",
+                    job_id, worker_id)
+                return
             # Duplicate guard, checked BEFORE the boundary wait (an
             # at-least-once retry must be rejected now, not parked until
             # the round rolls, where it would race the next dispatch's
@@ -692,7 +923,16 @@ class PhysicalScheduler(Scheduler):
                     if self._done_event.is_set():
                         return
                 state = self._allocation_state()
-            allocation = self._compute_allocation(state)
+            try:
+                allocation = self._compute_allocation(state)
+            except Exception:  # noqa: BLE001 - the allocation thread is
+                # a singleton: if a pathological solve kills it, the
+                # scheduler wedges forever (run() waits on the update
+                # flag). Keep the previous allocation and retry on the
+                # next trigger instead.
+                self.log.exception("allocation solve failed; keeping "
+                                   "previous allocation")
+                allocation = self._allocation
             with self._cv:
                 self._allocation = allocation
                 self._need_to_update_allocation = False
@@ -955,6 +1195,8 @@ class PhysicalScheduler(Scheduler):
         self.rounds.current_assignments = self.rounds.next_assignments or (
             collections.OrderedDict())
         self.rounds.next_assignments = None
+        self._emit("round_ended", round=self.rounds.num_completed_rounds)
+        self._maybe_snapshot()
         self._cv.notify_all()
         self.log.info("*** END ROUND %d ***", self.rounds.num_completed_rounds - 1)
 
@@ -1169,3 +1411,5 @@ class PhysicalScheduler(Scheduler):
         for client in set(self._worker_connections.values()):
             client.shutdown()
         self._server.stop(grace=1)
+        if self._durability is not None:
+            self._durability.close()

@@ -28,6 +28,7 @@ import numpy as np
 from ..core import constants
 from ..core.job import Job, JobIdPair
 from ..core.oracle import read_oracle
+from .journal import decode_job_key, encode_job_key
 from .state import JobAccounting, RoundState, WorkerState
 
 logger = logging.getLogger("shockwave_tpu.sched")
@@ -128,6 +129,20 @@ class SchedulerConfig:
     # this many deferrals, so _end_round cannot be held hostage by a
     # perpetually-"fresh" job (ADVICE round 5).
     max_kill_rearms: int = 3
+    # ---- durability (physical mode; see configs/durability.json and
+    # README "Scheduler crash recovery") ----
+    # Directory for the write-ahead journal + compacting snapshots. None
+    # disables durability entirely (state dies with the process).
+    state_dir: Optional[str] = None
+    # Rebuild the scheduler from state_dir (snapshot + journal replay)
+    # instead of starting empty. A non-empty state_dir with resume=False
+    # is an error — never silently clobber a crashed run's state.
+    resume: bool = False
+    # Rounds between compacting snapshots; each snapshot compacts the
+    # journal, bounding its size to two intervals of events (the
+    # retained interval is the previous snapshot's replay tail). 0
+    # disables snapshots (journal grows without bound).
+    snapshot_interval_rounds: int = 10
 
 
 class Scheduler:
@@ -248,6 +263,17 @@ class Scheduler:
         import random as _random
         self._worker_type_shuffler = _random.Random(self._config.seed + 5)
 
+        # Durability: a journal.DurabilityLayer once attached (physical
+        # mode with state_dir; tests attach directly). While _replaying,
+        # emission is suppressed so recovery never re-journals the
+        # events it is consuming.
+        self._journal = None
+        self._replaying = False
+        # Driver-recorded run metadata (trace path, wall start time);
+        # survives restarts via the journal/snapshot so a resumed driver
+        # can rebase arrival offsets and makespan onto the original run.
+        self._run_meta: dict = {}
+
         # Shockwave planner.
         self._shockwave_planner = None
         if policy.name == "shockwave":
@@ -278,6 +304,11 @@ class Scheduler:
                     cap = 0.5
                 sw["solver_budget_cap_rounds"] = cap
             self._shockwave_planner = ShockwavePlanner.from_config(sw)
+            # Planner-side durability hook: mark_progress /
+            # add_waiting_delay / increment_round / solve outcomes are
+            # journaled at their source so replay reproduces the
+            # planner's estimate state exactly.
+            self._shockwave_planner.journal = self._emit_event
         self._scheduled_jobs_in_current_round: Optional[List[int]] = None
         self._scheduled_jobs_in_prev_round: Optional[List[int]] = None
         self._shockwave_job_completed = False
@@ -289,6 +320,261 @@ class Scheduler:
 
     def get_current_timestamp(self) -> float:
         return self._current_timestamp
+
+    # ------------------------------------------------------------------
+    # Durability (write-ahead journal + snapshot/restore)
+    # ------------------------------------------------------------------
+
+    #: Fields a compacting snapshot captures. Everything here must be
+    #: picklable; in-flight round plumbing (threads, RPC clients,
+    #: per-dispatch protocol state) is deliberately excluded — recovery
+    #: re-adopts in-flight rounds conservatively instead.
+    _SNAPSHOT_FIELDS = (
+        "_current_timestamp", "_job_id_counter", "acct", "rounds",
+        "workers", "_allocation", "_priorities", "_deficits",
+        "_need_to_update_allocation", "_last_reset_time", "_throughputs",
+        "_throughput_timeline", "_job_cost_so_far", "_slo_deadlines",
+        "_job_timelines", "_completed_jobs", "_last_completion_time",
+        "_num_jobs_in_trace", "_bs_flags", "_steps_run_in_current_lease",
+        "_scheduled_jobs_in_current_round", "_scheduled_jobs_in_prev_round",
+        "_shockwave_job_completed", "_rounds_since_reopt", "_rng",
+        "_worker_type_shuffler", "_run_meta",
+    )
+    _PLANNER_SNAPSHOT_FIELDS = (
+        "metadata", "completed", "schedules", "round_ptr", "share_series",
+        "solve_stats", "_resolve", "_reestimate_share",
+    )
+
+    def attach_durability(self, layer) -> None:
+        """Start journaling state mutations into a DurabilityLayer."""
+        self._journal = layer
+
+    def _emit(self, etype: str, **data) -> None:
+        self._emit_event(etype, data)
+
+    def _emit_audit(self, etype: str, **data) -> None:
+        """Journal an audit-only event (replay no-op) WITHOUT paying a
+        per-record fsync — it persists with the next durable append."""
+        self._emit_event(etype, data, sync=False)
+
+    def _emit_event(self, etype: str, data: dict, sync: bool = True) -> None:
+        if self._journal is None or self._replaying:
+            return
+        try:
+            self._journal.record(etype, data, sync=sync)
+        except Exception:  # noqa: BLE001 - never let the WAL kill a round
+            self.log.exception("journal append failed for %s", etype)
+
+    def record_run_meta(self, **meta) -> None:
+        """Driver-level run metadata, journaled so a resumed run can
+        rebase its clock and job submission cursor."""
+        self._run_meta = dict(meta)
+        self._emit("run_meta", **meta)
+
+    @property
+    def run_meta(self) -> dict:
+        return dict(self._run_meta)
+
+    @property
+    def num_jobs_submitted(self) -> int:
+        """Jobs ever admitted (the resume cursor into a trace)."""
+        return self._job_id_counter
+
+    def snapshot_state(self) -> dict:
+        """Picklable durable-state dict (one object, so structure shared
+        between the scheduler and planner — e.g. the per-job throughput
+        timelines the planner calibrates against — stays shared on
+        restore)."""
+        state = {f: getattr(self, f) for f in self._SNAPSHOT_FIELDS}
+        if self._shockwave_planner is not None:
+            state["planner"] = {
+                f: getattr(self._shockwave_planner, f)
+                for f in self._PLANNER_SNAPSHOT_FIELDS}
+        return state
+
+    def restore_state(self, state: dict) -> None:
+        for f in self._SNAPSHOT_FIELDS:
+            if f in state:
+                setattr(self, f, state[f])
+        planner_state = state.get("planner")
+        if planner_state is not None:
+            if self._shockwave_planner is None:
+                self.log.warning("snapshot carries planner state but this "
+                                 "scheduler has no shockwave planner; "
+                                 "dropping it")
+            else:
+                for f in self._PLANNER_SNAPSHOT_FIELDS:
+                    if f in planner_state:
+                        setattr(self._shockwave_planner, f,
+                                planner_state[f])
+
+    def restore_from_durable_state(self, recovered) -> None:
+        """Rebuild from a journal.RecoveredState: restore the snapshot,
+        then replay every event after it. Emission is suspended for the
+        duration so recovery never re-journals its own input."""
+        self._replaying = True
+        try:
+            if recovered.snapshot is not None:
+                self.restore_state(recovered.snapshot.get("state", {}))
+            for event in recovered.events:
+                self._apply_journal_event(event.get("type", "?"),
+                                          event.get("data", {}))
+        finally:
+            self._replaying = False
+        self.log.info(
+            "recovered scheduler state: snapshot=%s, %d journal events "
+            "replayed, %d active jobs, %d completed, round %d",
+            "yes" if recovered.snapshot is not None else "no",
+            len(recovered.events), len(self.acct.jobs),
+            len(self._completed_jobs), self.rounds.num_completed_rounds)
+
+    def _apply_journal_event(self, etype: str, data: dict) -> None:
+        """Replay one journaled event. A single malformed event is
+        logged and skipped — recovery of everything else must not hinge
+        on it."""
+        try:
+            handler = getattr(self, f"_replay_{etype}", None)
+            if handler is None:
+                self.log.warning("unknown journal event %r; skipping",
+                                 etype)
+                return
+            handler(data)
+        except Exception:  # noqa: BLE001 - degrade, don't abort recovery
+            self.log.exception("replay of journal event %r failed; "
+                               "skipping", etype)
+
+    # -- replay handlers (one per journaled event type) -----------------
+
+    def _replay_run_meta(self, data: dict) -> None:
+        self._run_meta = dict(data)
+
+    def _replay_job_added(self, data: dict) -> None:
+        spec = dict(data["job"])
+        slo = spec.get("SLO")
+        job = Job(
+            job_id=None, job_type=spec["job_type"], command=spec["command"],
+            working_directory=spec.get("working_directory", ""),
+            num_steps_arg=spec.get("num_steps_arg", "--num_steps"),
+            total_steps=spec.get("total_steps", 0),
+            duration=spec.get("duration", 0),
+            scale_factor=spec.get("scale_factor", 1),
+            mode=spec.get("mode", "static"),
+            priority_weight=spec.get("priority_weight", 1.0),
+            SLO=None if slo is None else float(slo),
+            needs_data_dir=spec.get("needs_data_dir", False))
+        job_id = self.add_job(job, timestamp=data.get("ts"))
+        if job_id.integer_job_id() != data["int_id"]:
+            self.log.warning("replayed job id %s != journaled %s (journal "
+                             "out of order?)", job_id, data["int_id"])
+
+    def _replay_job_removed(self, data: dict) -> None:
+        job_id = JobIdPair(int(data["int_id"]))
+        if job_id not in self.acct.jobs:
+            return  # already removed via a replayed micro-task completion
+        if data.get("ts") is not None:
+            self.acct.latest_timestamps[job_id] = data["ts"]
+        self._remove_job(job_id)
+
+    def _replay_worker_registered(self, data: dict) -> None:
+        ids, _ = self.register_worker(data["worker_type"],
+                                      data.get("num_chips", 1))
+        if list(ids) != list(data.get("worker_ids", ids)):
+            self.log.warning("replayed worker ids %s != journaled %s",
+                             ids, data.get("worker_ids"))
+
+    def _replay_workers_retired(self, data: dict) -> None:
+        self.deregister_workers([int(i) for i in data["worker_ids"]])
+
+    def _replay_workers_revived(self, data: dict) -> None:
+        self.revive_workers([int(i) for i in data["worker_ids"]],
+                            data["worker_type"])
+
+    def _replay_round_recorded(self, data: dict) -> None:
+        assignments = {}
+        staged: "collections.OrderedDict" = collections.OrderedDict()
+        for key, ids in data["assignments"]:
+            chip_ids = tuple(int(i) for i in ids)
+            if isinstance(key, (list, tuple)):
+                key = tuple(int(k) for k in key)
+                staged[JobIdPair(*key)] = chip_ids
+            else:
+                key = int(key)
+                staged[JobIdPair(key)] = chip_ids
+            assignments[key] = chip_ids
+        self._record_round(assignments)
+        # Track the latest planned round as the current assignments:
+        # recovery's conservative requeue reads these to attribute
+        # abandoned leases to the jobs actually dispatched at the
+        # crash, not to whichever job last completed a micro-task.
+        self.rounds.current_assignments = staged
+
+    def _replay_round_ended(self, data: dict) -> None:
+        self.rounds.num_completed_rounds = int(data["round"])
+        self.rounds.completed_in_round = set()
+
+    def _replay_microtask_done(self, data: dict) -> None:
+        job_id = decode_job_key(data["key"])
+        if not any(m in self.acct.jobs for m in job_id.singletons()):
+            return
+        updates = data["updates"]
+        worker_ids = tuple(int(u[0]) for u in updates)
+        # Stage the round context the done path aggregates against, then
+        # drive the REAL completion code (core class explicitly — the
+        # physical subclass's wrapper adds live-RPC plumbing that must
+        # not run during replay).
+        self.rounds.current_assignments[job_id] = worker_ids
+        self.rounds.completed_in_round.discard(job_id)
+        self._in_progress_updates[job_id] = []
+        latest = data.get("latest", {})
+        for m in job_id.singletons():
+            if m in self.acct.jobs:
+                self._running_jobs.add(m)
+                stamp = latest.get(str(m.integer_job_id()),
+                                   latest.get(m.integer_job_id(),
+                                              data.get("ts")))
+                if stamp is not None:
+                    self.acct.latest_timestamps[m] = stamp
+        for worker_id, num_steps, times in updates:
+            Scheduler.done_callback(self, job_id, int(worker_id),
+                                    [int(s) for s in num_steps],
+                                    [float(t) for t in times])
+
+    def _replay_failure_comp(self, data: dict) -> None:
+        job_id = JobIdPair(int(data["int_id"]))
+        if job_id in self.acct.failures:
+            self.acct.failures[job_id] -= 1
+
+    def _replay_bs_flag(self, data: dict) -> None:
+        flags = self._bs_flags.get(JobIdPair(int(data["int_id"])))
+        if flags is not None:
+            if data.get("big"):
+                flags["big_bs"] = True
+            if data.get("small"):
+                flags["small_bs"] = True
+
+    def _replay_lease_granted(self, data: dict) -> None:
+        pass  # audit record: lease terms are re-derived on redispatch
+
+    def _replay_planner_progress(self, data: dict) -> None:
+        if self._shockwave_planner is not None:
+            self._shockwave_planner.mark_progress(int(data["int_id"]),
+                                                  int(data["epoch"]))
+
+    def _replay_planner_waiting(self, data: dict) -> None:
+        if self._shockwave_planner is not None:
+            self._shockwave_planner.add_waiting_delay(int(data["int_id"]),
+                                                      float(data["delay"]))
+
+    def _replay_planner_round(self, data: dict) -> None:
+        if self._shockwave_planner is not None:
+            self._shockwave_planner.increment_round()
+
+    def _replay_solve_outcome(self, data: dict) -> None:
+        if self._shockwave_planner is not None:
+            from ..shockwave.milp import SolveStats
+            known = {f for f in SolveStats.__dataclass_fields__}
+            self._shockwave_planner.solve_stats.append(
+                SolveStats(**{k: v for k, v in data.items() if k in known}))
 
     # ------------------------------------------------------------------
     # Job lifecycle
@@ -359,6 +645,13 @@ class Scheduler:
         else:
             self._throughput_timeline[job_id.integer_job_id()] = collections.OrderedDict()
 
+        self._emit("job_added", int_id=int_id, ts=ts, job=dict(
+            job_type=job.job_type, command=job.command,
+            working_directory=job.working_directory,
+            num_steps_arg=job.num_steps_arg, total_steps=job.total_steps,
+            duration=float(job._duration), scale_factor=job.scale_factor,
+            mode=job.mode, priority_weight=job.priority_weight,
+            SLO=job.SLO, needs_data_dir=job.needs_data_dir))
         self.log.info("[Job dispatched] job %s (%s, sf=%d, mode=%s)",
                     job_id, job.job_type, job.scale_factor, job.mode)
         return job_id
@@ -397,6 +690,8 @@ class Scheduler:
             self._shockwave_job_completed = True
         self._remove_from_priorities(job_id)
         self._need_to_update_allocation = True
+        self._emit("job_removed", int_id=int_id,
+                   ts=a.latest_timestamps[job_id])
         self.log.info("[Job completed] job %s after %.1fs (%d active)",
                     job_id, duration, len(a.jobs))
 
@@ -436,6 +731,8 @@ class Scheduler:
         # caller's stable record of its chip ids.
         w.type_to_server_ids[worker_type].append(list(server_ids))
         self._need_to_update_allocation = True
+        self._emit("worker_registered", worker_type=worker_type,
+                   num_chips=num_chips, worker_ids=list(server_ids))
         return server_ids, self._time_per_iteration
 
     def deregister_workers(self, worker_ids: Sequence[int]) -> None:
@@ -471,6 +768,7 @@ class Scheduler:
             w.type_to_server_ids[wt] = [
                 s for s in w.type_to_server_ids.get(wt, []) if s]
         self._need_to_update_allocation = True
+        self._emit("workers_retired", worker_ids=list(ids))
         self.log.warning("[Workers lost] chips %s removed from capacity "
                          "(%s left)", ids, dict(w.cluster_spec))
 
@@ -494,6 +792,8 @@ class Scheduler:
                 w.cluster_spec.get(worker_type, 0) + 1)
         w.type_to_server_ids.setdefault(worker_type, []).append(list(ids))
         self._need_to_update_allocation = True
+        self._emit("workers_revived", worker_ids=list(ids),
+                   worker_type=worker_type)
         self.log.info("[Workers rejoined] chips %s restored to capacity "
                       "(%s)", ids, dict(w.cluster_spec))
 
@@ -696,6 +996,12 @@ class Scheduler:
         if state is None:
             state = self._allocation_state()
         name = self._policy.name
+        # No schedulable capacity (every worker retired — routine on
+        # preemptible fleets): there is nothing to allocate, and the LP
+        # policies divide by cluster size (nan coefficients crash
+        # linprog). Jobs re-plan when a worker registers or revives.
+        if sum(state["cluster_spec"].values()) <= 0:
+            return {}
         throughputs = state["throughputs"]
         sf = state["scale_factors"]
         cluster = state["cluster_spec"]
@@ -914,6 +1220,9 @@ class Scheduler:
                 self.rounds.num_scheduled_rounds[int_id] += 1
             else:
                 self.rounds.num_queued_rounds[int_id] += 1
+        self._emit("round_recorded", assignments=[
+            [list(k) if isinstance(k, tuple) else k, list(ids)]
+            for k, ids in int_assignments.items()])
 
     def _replay_assignments(
             self, recorded: Dict[int, Sequence[int]]
@@ -1137,6 +1446,17 @@ class Scheduler:
         updates = sorted(self._in_progress_updates[job_id], key=lambda u: u[0])
         self._in_progress_updates[job_id] = []
         self.rounds.completed_in_round.add(job_id)
+        if self._journal is not None and not self._replaying:
+            self._emit("microtask_done", key=encode_job_key(job_id),
+                       worker_type=worker_type,
+                       ts=self.get_current_timestamp(),
+                       # Exact dispatch stamps, so a replayed completion
+                       # lands on the same JCT the live run recorded.
+                       latest={m.integer_job_id():
+                               self.acct.latest_timestamps.get(m)
+                               for m in members if is_active[m]},
+                       updates=[[w, list(s), [float(t) for t in times]]
+                                for w, s, times in updates])
 
         # Fold the round's iterator logs into each live member's timeline.
         # Each worker's logs are index-aligned with the members (like

@@ -81,3 +81,16 @@ class RoundState:
     job_end_round: Dict[int, int] = field(default_factory=dict)
     num_scheduled_rounds: Dict[int, int] = field(default_factory=dict)
     num_queued_rounds: Dict[int, int] = field(default_factory=dict)
+
+    def abandon_in_flight(self) -> None:
+        """Drop every in-flight round structure, keeping history.
+
+        Crash recovery re-plans the round from scratch: assignments and
+        leases referenced workers/processes the restarted scheduler no
+        longer controls, while the per-round history (schedules, counts,
+        start/end rounds) stays valid and is preserved.
+        """
+        self.current_assignments = collections.OrderedDict()
+        self.next_assignments = None
+        self.completed_in_round = set()
+        self.extended_leases = set()

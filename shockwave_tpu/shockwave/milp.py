@@ -79,6 +79,10 @@ class SolveStats:
     status: Optional[int] = None       # scipy milp status of final solve
     mip_gap: Optional[float] = None    # achieved relative gap, if exposed
     ftf_infeasible: bool = False       # FTF caps provably infeasible
+    # Solver EXCEPTION (not mere infeasibility) swallowed by the guard
+    # around _solve: the round loop degraded to the next fallback arm
+    # instead of dying. "<ExcType>: <msg>" of the last raise, else None.
+    error: Optional[str] = None
 
 
 def finish_time_momentumed_average(series, round_index, momentum=0.9) -> float:
@@ -115,6 +119,20 @@ class _Layout:
     def t(self): return self.n - 1
 
 
+class _FailedSolve:
+    """Result shim for a solver that RAISED (scipy/HiGHS internal error,
+    numerical blow-up, ...): looks like a failed `milp` result so the
+    existing fallback chain (relax -> greedy) handles it, and carries
+    the exception text into SolveStats.error."""
+
+    x = None
+    status = None
+    mip_gap = None
+
+    def __init__(self, error: str):
+        self.error = error
+
+
 def _solve(c, A_ub, b_ub, A_eq, b_eq, integrality, ub, opts: MilpOptions,
            timeout_scale: float = 1.0):
     constraints = []
@@ -122,12 +140,18 @@ def _solve(c, A_ub, b_ub, A_eq, b_eq, integrality, ub, opts: MilpOptions,
         constraints.append(LinearConstraint(A_ub, -np.inf, b_ub))
     if len(b_eq):
         constraints.append(LinearConstraint(A_eq, b_eq, b_eq))
-    res = milp(
-        c, constraints=constraints, integrality=integrality,
-        bounds=Bounds(np.zeros_like(ub), ub),
-        options={"time_limit": opts.timeout * timeout_scale,
-                 "mip_rel_gap": opts.rel_gap, "presolve": True},
-    )
+    try:
+        res = milp(
+            c, constraints=constraints, integrality=integrality,
+            bounds=Bounds(np.zeros_like(ub), ub),
+            options={"time_limit": opts.timeout * timeout_scale,
+                     "mip_rel_gap": opts.rel_gap, "presolve": True},
+        )
+    except Exception as e:  # noqa: BLE001 - a solver crash must not kill
+        # the round loop: degrade through the fallback chain instead.
+        logger.warning("MILP solver raised %s: %s; treating as failed "
+                       "solve", type(e).__name__, e)
+        return _FailedSolve(f"{type(e).__name__}: {e}")
     return res
 
 
@@ -152,7 +176,9 @@ def plan_schedule(jobs, round_index: int, future_nrounds: int,
                 status=getattr(res, "status", None) if res is not None
                 else None,
                 mip_gap=None if gap is None else float(gap),
-                ftf_infeasible=ftf_infeasible))
+                ftf_infeasible=ftf_infeasible,
+                error=getattr(res, "error", None) if res is not None
+                else None))
     njobs = len(jobs)
     bases = list(opts.logapx_bases)
     assert bases[0] == 0.0
@@ -266,7 +292,10 @@ def plan_schedule(jobs, round_index: int, future_nrounds: int,
         return x
 
     # -- fallback: relax FTF, boost violating jobs' utilities -------------
-    if res is not None and res.x is None and res.status == 1:
+    if res is not None and getattr(res, "error", None):
+        logger.info("FTF solve raised (%s) at round %d; relaxing",
+                    res.error, round_index)
+    elif res is not None and res.x is None and res.status == 1:
         logger.info("FTF solve timed out with no incumbent at round %d; "
                     "relaxing", round_index)
     else:
@@ -382,21 +411,27 @@ def _rank_in_schedule(x: np.ndarray, priorities, nworkers, ngpus,
             for r in range(nrounds):
                 c[j * nrounds + r] = priorities[j] * r / counts[j]
 
-    res = milp(
-        c,
-        constraints=[
-            LinearConstraint(
-                sparse.coo_matrix((vals_ub, (rows_ub, cols_ub)), shape=(len(b_ub), n)).tocsr(),
-                -np.inf, np.array(b_ub)),
-            LinearConstraint(
-                sparse.coo_matrix((vals_eq, (rows_eq, cols_eq)), shape=(len(b_eq), n)).tocsr(),
-                np.array(b_eq), np.array(b_eq)),
-        ],
-        integrality=np.ones(n),
-        bounds=Bounds(np.zeros(n), np.ones(n)),
-        options={"time_limit": time_limit or opts.timeout,
-                 "mip_rel_gap": opts.rel_gap, "presolve": True},
-    )
+    try:
+        res = milp(
+            c,
+            constraints=[
+                LinearConstraint(
+                    sparse.coo_matrix((vals_ub, (rows_ub, cols_ub)), shape=(len(b_ub), n)).tocsr(),
+                    -np.inf, np.array(b_ub)),
+                LinearConstraint(
+                    sparse.coo_matrix((vals_eq, (rows_eq, cols_eq)), shape=(len(b_eq), n)).tocsr(),
+                    np.array(b_eq), np.array(b_eq)),
+            ],
+            integrality=np.ones(n),
+            bounds=Bounds(np.zeros(n), np.ones(n)),
+            options={"time_limit": time_limit or opts.timeout,
+                     "mip_rel_gap": opts.rel_gap, "presolve": True},
+        )
+    except Exception as e:  # noqa: BLE001 - ranking is an optimization;
+        # the unranked schedule is valid, so never die for it.
+        logger.warning("rank-in-schedule MILP raised %s: %s; keeping "
+                       "unranked schedule", type(e).__name__, e)
+        return x
     if res.x is None:
         logger.warning("rank-in-schedule MILP failed (%s); "
                        "keeping unranked schedule", res.status)

@@ -36,6 +36,16 @@ class ShockwavePlanner:
         # every plan_schedule call; drivers persist it so scale runs
         # can prove the fallback chain stays cold.
         self.solve_stats: list = []
+        # Durability hook: callable(event_type, data_dict) wired by the
+        # scheduler when a write-ahead journal is attached, so progress
+        # marks, waiting delays, round advances and solve outcomes are
+        # journaled at their source and replay rebuilds the planner's
+        # estimate state exactly. None = no journaling.
+        self.journal = None
+
+    def _journal_event(self, etype: str, data: dict) -> None:
+        if self.journal is not None:
+            self.journal(etype, data)
 
     @classmethod
     def from_config(cls, config: dict) -> "ShockwavePlanner":
@@ -76,13 +86,18 @@ class ShockwavePlanner:
             return
         meta.set_epoch_progress(min(epoch_progress, meta.epochs))
         meta.reset_waiting_delay()
+        self._journal_event("planner_progress",
+                            {"int_id": job_id, "epoch": epoch_progress})
 
     def add_waiting_delay(self, job_id: int, delay: float) -> None:
         if job_id in self.metadata:
             self.metadata[job_id].add_waiting_delay(delay)
+            self._journal_event("planner_waiting",
+                                {"int_id": job_id, "delay": delay})
 
     def increment_round(self) -> None:
         self.round_ptr += 1
+        self._journal_event("planner_round", {})
 
     def request_resolve(self) -> None:
         self._resolve = True
@@ -125,6 +140,9 @@ class ShockwavePlanner:
         x = plan_schedule(jobs, self.round_ptr, self.future_nrounds,
                           self.round_duration, self.ngpus, share_series,
                           self.opts, stats_out=self.solve_stats)
+        if self.solve_stats:
+            from dataclasses import asdict
+            self._journal_event("solve_outcome", asdict(self.solve_stats[-1]))
         self.schedules = self._construct_schedules(x, job_ids, jobs)
         self._resolve = False
         return self.schedules[self.round_ptr]

@@ -1,0 +1,467 @@
+"""Durability-layer semantics: journal framing, torn-tail truncation,
+snapshot atomicity/fallback, compaction bounds, and replay determinism
+(same journal -> identical scheduler state)."""
+import os
+import pickle
+import subprocess
+import sys
+
+import pytest
+
+from shockwave_tpu.core.job import Job, JobIdPair
+from shockwave_tpu.sched.journal import (JOURNAL_MAGIC, TAIL_CLEAN,
+                                         TAIL_TORN, DurabilityLayer,
+                                         JournalWriter, list_segments,
+                                         load_snapshot, load_state,
+                                         read_journal, write_snapshot)
+from shockwave_tpu.sched.scheduler import Scheduler
+from shockwave_tpu.solver import get_policy
+
+TESTS_DIR = os.path.dirname(__file__)
+DATA = os.path.join(TESTS_DIR, "..", "data")
+FSCK = os.path.join(TESTS_DIR, "..", "scripts", "utils", "fsck_journal.py")
+
+
+def _write_events(layer, n, etype="ev"):
+    return [layer.record(etype, {"i": i}) for i in range(n)]
+
+
+class TestFraming:
+    def test_roundtrip(self, tmp_path):
+        layer = DurabilityLayer(str(tmp_path))
+        _write_events(layer, 5)
+        layer.close()
+        (seg,) = list_segments(str(tmp_path))
+        records, status = read_journal(seg)
+        assert status == TAIL_CLEAN
+        assert [r["data"]["i"] for r in records] == list(range(5))
+        assert [r["seq"] for r in records] == [1, 2, 3, 4, 5]
+
+    def test_torn_tail_discarded_not_fatal(self, tmp_path):
+        layer = DurabilityLayer(str(tmp_path))
+        _write_events(layer, 3)
+        layer.close()
+        (seg,) = list_segments(str(tmp_path))
+        # Chop the last record in half: a crash mid-append.
+        size = os.path.getsize(seg)
+        with open(seg, "r+b") as f:
+            f.truncate(size - 7)
+        records, status = read_journal(seg)
+        assert status == TAIL_TORN
+        assert [r["data"]["i"] for r in records] == [0, 1]
+        # Recovery consumes it without complaint.
+        rec = load_state(str(tmp_path))
+        assert len(rec.events) == 2
+        assert rec.tail_status == TAIL_TORN
+
+    def test_corrupt_record_stops_read(self, tmp_path):
+        layer = DurabilityLayer(str(tmp_path))
+        _write_events(layer, 3)
+        layer.close()
+        (seg,) = list_segments(str(tmp_path))
+        with open(seg, "r+b") as f:
+            blob = f.read()
+            # Flip a byte in the middle of the SECOND record's payload.
+            f.seek(len(blob) // 2)
+            orig = blob[len(blob) // 2]
+            f.write(bytes([orig ^ 0xFF]))
+        records, status = read_journal(seg)
+        assert status == TAIL_TORN
+        assert len(records) < 3
+
+    def test_reopen_truncates_torn_tail_and_appends(self, tmp_path):
+        layer = DurabilityLayer(str(tmp_path))
+        _write_events(layer, 3)
+        layer.close()
+        (seg,) = list_segments(str(tmp_path))
+        with open(seg, "ab") as f:
+            f.write(b"\x99\x00\x00\x00partial-crash-garbage")
+        # Reopen: the torn tail must be truncated so new appends land at
+        # a record boundary and stay readable.
+        layer2 = DurabilityLayer(str(tmp_path))
+        layer2.record("after", {"ok": True})
+        layer2.close()
+        records, status = read_journal(seg)
+        assert status == TAIL_CLEAN
+        assert [r["type"] for r in records] == ["ev", "ev", "ev", "after"]
+        assert records[-1]["seq"] == 4  # seq continued, not restarted
+
+    def test_bad_magic_rejected(self, tmp_path):
+        path = tmp_path / "journal.000000000001.log"
+        path.write_bytes(b"not a journal at all")
+        from shockwave_tpu.sched.journal import JournalError
+        with pytest.raises(JournalError):
+            read_journal(str(path))
+        assert JOURNAL_MAGIC not in path.read_bytes()
+
+
+class TestSnapshots:
+    def test_roundtrip_and_prev_fallback(self, tmp_path):
+        d = str(tmp_path)
+        write_snapshot(d, {"state": {"v": 1}, "last_seq": 10})
+        write_snapshot(d, {"state": {"v": 2}, "last_seq": 20})
+        assert load_snapshot(d)["state"]["v"] == 2
+        # Corrupt the current snapshot: loader falls back to previous.
+        with open(os.path.join(d, "snapshot.pkl"), "r+b") as f:
+            f.seek(3)
+            f.write(b"\xde\xad\xbe\xef")
+        snap = load_snapshot(d)
+        assert snap is not None and snap["state"]["v"] == 1
+        # Both corrupt: None, not a crash.
+        with open(os.path.join(d, "snapshot.pkl.prev"), "r+b") as f:
+            f.seek(3)
+            f.write(b"\xde\xad\xbe\xef")
+        assert load_snapshot(d) is None
+
+    def test_tmp_leftover_ignored(self, tmp_path):
+        d = str(tmp_path)
+        # A crash mid-write leaves only the tmp file: no snapshot.
+        with open(os.path.join(d, "snapshot.pkl.tmp"), "wb") as f:
+            f.write(b"half-written")
+        assert load_snapshot(d) is None
+
+
+class TestCompaction:
+    def test_snapshot_bounds_journal_size(self, tmp_path):
+        d = str(tmp_path)
+        layer = DurabilityLayer(d)
+        for batch in range(4):
+            _write_events(layer, 50)
+            layer.snapshot({"state": {"batch": batch}})
+            segs = list_segments(d)
+            # One retained previous-interval segment (the .prev
+            # snapshot's replay tail) + one fresh magic-only segment:
+            # journal size is bounded by TWO intervals, not growing.
+            assert len(segs) <= 2
+            retained = sum(len(read_journal(p)[0]) for p in segs)
+            assert retained <= 50  # at most one interval of records kept
+        _write_events(layer, 5)
+        layer.close()
+        rec = load_state(d)
+        # Only post-snapshot events replay; the snapshot covers the rest.
+        assert len(rec.events) == 5
+        assert rec.snapshot["state"]["batch"] == 3
+        assert rec.snapshot["last_seq"] == 200
+        assert [e["seq"] for e in rec.events] == [201, 202, 203, 204, 205]
+
+    def test_prev_snapshot_fallback_can_still_replay(self, tmp_path):
+        """If the current snapshot corrupts, recovery through .prev must
+        find every event after the PREVIOUS horizon still on disk —
+        compaction may only delete what .prev no longer needs."""
+        d = str(tmp_path)
+        layer = DurabilityLayer(d)
+        _write_events(layer, 50)
+        layer.snapshot({"state": {"gen": 1}})   # .prev-to-be, covers 50
+        _write_events(layer, 50)
+        layer.snapshot({"state": {"gen": 2}})   # current, covers 100
+        _write_events(layer, 5)
+        layer.close()
+        with open(os.path.join(d, "snapshot.pkl"), "r+b") as f:
+            f.seek(10)
+            f.write(b"\xde\xad\xbe\xef")
+        rec = load_state(d)
+        assert rec.snapshot["state"]["gen"] == 1
+        # Everything after gen-1's horizon replays: 51..105.
+        assert [e["seq"] for e in rec.events] == list(range(51, 106))
+
+    def test_interrupted_snapshot_rotation_keeps_needed_events(
+            self, tmp_path):
+        """Crash AFTER write_snapshot but BEFORE segment rotation leaves
+        one segment spanning the snapshot horizon; the next compaction
+        must keep it (it holds events past the .prev horizon)."""
+        d = str(tmp_path)
+        layer = DurabilityLayer(d)
+        _write_events(layer, 13)
+        layer.close()
+        # Simulate the interrupted snapshot: written, never rotated.
+        write_snapshot(d, {"state": {"gen": 1}, "last_seq": 13})
+        layer = DurabilityLayer(d)  # continues the spanning segment
+        _write_events(layer, 3)     # seqs 14..16
+        layer.snapshot({"state": {"gen": 2}})  # rotates gen 1 to .prev
+        layer.close()
+        # Corrupt gen 2: recovery via gen 1 must still see 14..16.
+        with open(os.path.join(d, "snapshot.pkl"), "r+b") as f:
+            f.seek(10)
+            f.write(b"\xde\xad\xbe\xef")
+        rec = load_state(d)
+        assert rec.snapshot["state"]["gen"] == 1
+        assert [e["seq"] for e in rec.events] == [14, 15, 16]
+
+    def test_both_snapshots_unreadable_refuses_truncated_replay(
+            self, tmp_path):
+        """With the journal head compacted away and BOTH snapshot
+        generations corrupt, recovery must refuse loudly — replaying
+        the surviving tail onto an empty scheduler would renumber every
+        job and silently drop accounting."""
+        from shockwave_tpu.sched.journal import JournalError
+        d = str(tmp_path)
+        layer = DurabilityLayer(d)
+        _write_events(layer, 50)
+        layer.snapshot({"state": {"gen": 1}})
+        _write_events(layer, 50)
+        layer.snapshot({"state": {"gen": 2}})  # seq 1..50 now deleted
+        _write_events(layer, 5)
+        layer.close()
+        for name in ("snapshot.pkl", "snapshot.pkl.prev"):
+            with open(os.path.join(d, name), "r+b") as f:
+                f.seek(10)
+                f.write(b"\xde\xad\xbe\xef")
+        with pytest.raises(JournalError, match="unrecoverable"):
+            load_state(d)
+
+    def test_has_state_sees_prev_only_state(self, tmp_path):
+        """A dir whose current snapshot is corrupt but whose .prev loads
+        is STILL stateful — a fresh non-resume run must refuse it."""
+        from shockwave_tpu.sched.journal import has_state
+        d = str(tmp_path)
+        layer = DurabilityLayer(d)
+        _write_events(layer, 10)
+        layer.snapshot({"state": {"gen": 1}})
+        layer.snapshot({"state": {"gen": 2}})   # rotates gen 1 to .prev
+        layer.close()
+        with open(os.path.join(d, "snapshot.pkl"), "r+b") as f:
+            f.seek(10)
+            f.write(b"\xde\xad\xbe\xef")
+        assert has_state(d)
+
+    def test_rotation_failure_keeps_wal_alive(self, tmp_path, monkeypatch):
+        """If opening the fresh post-snapshot segment fails (ENOSPC,
+        EACCES, ...), the layer must fall back to the previous segment
+        — a silently closed writer would drop every later event."""
+        import shockwave_tpu.sched.journal as jmod
+        d = str(tmp_path)
+        layer = DurabilityLayer(d)
+        _write_events(layer, 5)
+        real = jmod._segment_path
+
+        def broken(state_dir, start_seq):
+            return os.path.join(state_dir, "no-such-dir",
+                                f"journal.{start_seq:012d}.log")
+
+        monkeypatch.setattr(jmod, "_segment_path", broken)
+        layer.snapshot({"state": {}})
+        monkeypatch.setattr(jmod, "_segment_path", real)
+        # The WAL still accepts (and persists) events.
+        layer.record("after_failure", {"ok": True})
+        layer.close()
+        rec = load_state(d)
+        assert [e["type"] for e in rec.events] == ["after_failure"]
+
+    def test_crash_between_snapshot_and_compaction(self, tmp_path):
+        """Events covered by the snapshot but not yet deleted must be
+        skipped on recovery, not replayed twice."""
+        d = str(tmp_path)
+        layer = DurabilityLayer(d)
+        _write_events(layer, 10)
+        layer.close()
+        # Snapshot written, crash before segment deletion: simulate by
+        # writing the snapshot directly.
+        write_snapshot(d, {"state": {}, "last_seq": 10})
+        rec = load_state(d)
+        assert rec.events == []
+        layer2 = DurabilityLayer(d)
+        assert layer2.record("next", {}) == 11
+        layer2.close()
+
+
+def _make_scheduler():
+    return Scheduler(get_policy("max_min_fairness"),
+                     throughputs_file=os.path.join(
+                         DATA, "tacc_throughputs.json"))
+
+
+def _job(total_steps):
+    return Job(None, "ResNet-18 (batch size 32)",
+               "python3 main.py --batch_size 32",
+               "image_classification/cifar10", "--num_steps",
+               total_steps=total_steps, duration=10000)
+
+
+def _comparable_state(s):
+    """Plain-data projection of the replay-relevant scheduler state."""
+    return {
+        "job_id_counter": s._job_id_counter,
+        "total_steps_run": dict(s.acct.total_steps_run),
+        "steps_run": {k: dict(v) for k, v in s.acct.steps_run.items()},
+        "failures": dict(s.acct.failures),
+        "completion_times": dict(s.acct.completion_times),
+        "start_timestamps": dict(s.acct.start_timestamps),
+        "completed": sorted(repr(j) for j in s._completed_jobs),
+        "cluster_spec": dict(s.workers.cluster_spec),
+        "worker_ids": list(s.workers.worker_ids),
+        "dead": sorted(s.workers.dead),
+        "per_round_schedule": list(s.rounds.per_round_schedule),
+        "num_scheduled_rounds": dict(s.rounds.num_scheduled_rounds),
+        "num_queued_rounds": dict(s.rounds.num_queued_rounds),
+        "num_completed_rounds": s.rounds.num_completed_rounds,
+        "throughputs": {repr(k): dict(v)
+                        for k, v in s._throughputs.items()},
+        "cost": dict(s._job_cost_so_far),
+        "run_meta": dict(s._run_meta),
+    }
+
+
+def _drive_workload(sched):
+    """A deterministic little history: workers, jobs, progress, a
+    completion, a failure, a worker retirement."""
+    sched.record_run_meta(start_time=100.0, trace="t.trace")
+    sched.register_worker("v100", 2)
+    j0 = sched.add_job(_job(300), timestamp=1.0)
+    j1 = sched.add_job(_job(100), timestamp=2.0)
+    sched._record_round({0: (0,), 1: (1,)})
+
+    def complete(jid, worker, steps, ts):
+        sched.rounds.current_assignments[jid] = (worker,)
+        sched._running_jobs.add(jid)
+        sched.acct.latest_timestamps[jid] = ts
+        sched.done_callback(jid, worker, [steps], [4.0])
+        sched.rounds.completed_in_round.discard(jid)
+
+    complete(j0, 0, 200, 5.0)     # partial progress
+    complete(j1, 1, 0, 6.0)       # failed micro-task (zero steps)
+    complete(j1, 1, 100, 8.0)     # second attempt completes job 1
+    sched.deregister_workers([1])  # lose a chip
+    return j0, j1
+
+
+@pytest.mark.recovery
+class TestReplayDeterminism:
+    def test_same_journal_identical_state(self, tmp_path):
+        d = str(tmp_path)
+        live = _make_scheduler()
+        layer = DurabilityLayer(d)
+        live.attach_durability(layer)
+        _drive_workload(live)
+        layer.close()
+
+        recovered = load_state(d)
+        assert recovered.events, "journal captured nothing"
+        replicas = []
+        for _ in range(2):
+            s = _make_scheduler()
+            s.restore_from_durable_state(recovered)
+            replicas.append(s)
+        assert _comparable_state(replicas[0]) == _comparable_state(
+            replicas[1])
+        # And the replay reproduces the LIVE accounting, not just a
+        # self-consistent one.
+        assert _comparable_state(replicas[0]) == _comparable_state(live)
+        assert replicas[0].acct.total_steps_run[JobIdPair(0)] == 200
+        assert JobIdPair(1) in replicas[0]._completed_jobs
+        # The failed attempt is visible, the success reset it to 0 —
+        # and the job completed so the counter entry is gone.
+        assert JobIdPair(1) not in replicas[0].acct.failures
+
+    def test_snapshot_plus_tail_equals_full_replay(self, tmp_path):
+        """Recovery through a mid-history snapshot must land on the same
+        state as a journal-only replay of the full history."""
+        d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+        # Run A: snapshot mid-way, journal the rest.
+        a = _make_scheduler()
+        layer_a = DurabilityLayer(d1)
+        a.attach_durability(layer_a)
+        a.record_run_meta(start_time=100.0, trace="t.trace")
+        a.register_worker("v100", 2)
+        a.add_job(_job(300), timestamp=1.0)
+        layer_a.snapshot({"state": a.snapshot_state()})
+        j1 = a.add_job(_job(100), timestamp=2.0)
+        a.rounds.current_assignments[j1] = (1,)
+        a._running_jobs.add(j1)
+        a.acct.latest_timestamps[j1] = 8.0
+        a.done_callback(j1, 1, [100], [4.0])
+        layer_a.close()
+        # Run B: identical history, no snapshot.
+        b = _make_scheduler()
+        layer_b = DurabilityLayer(d2)
+        b.attach_durability(layer_b)
+        b.record_run_meta(start_time=100.0, trace="t.trace")
+        b.register_worker("v100", 2)
+        b.add_job(_job(300), timestamp=1.0)
+        j1b = b.add_job(_job(100), timestamp=2.0)
+        b.rounds.current_assignments[j1b] = (1,)
+        b._running_jobs.add(j1b)
+        b.acct.latest_timestamps[j1b] = 8.0
+        b.done_callback(j1b, 1, [100], [4.0])
+        layer_b.close()
+
+        ra, rb = _make_scheduler(), _make_scheduler()
+        ra.restore_from_durable_state(load_state(d1))
+        rb.restore_from_durable_state(load_state(d2))
+        assert _comparable_state(ra) == _comparable_state(rb)
+        assert ra.run_meta["start_time"] == 100.0
+
+    def test_unknown_event_skipped(self, tmp_path):
+        d = str(tmp_path)
+        layer = DurabilityLayer(d)
+        layer.record("event_from_the_future", {"x": 1})
+        layer.record("run_meta", {"start_time": 7.0})
+        layer.close()
+        s = _make_scheduler()
+        s.restore_from_durable_state(load_state(d))
+        assert s.run_meta == {"start_time": 7.0}
+
+
+@pytest.mark.recovery
+class TestFsckValidator:
+    def _run(self, state_dir):
+        env = dict(os.environ)
+        return subprocess.run(
+            [sys.executable, FSCK, state_dir, "--verbose"],
+            capture_output=True, text=True, env=env, timeout=60)
+
+    def test_clean_state_passes(self, tmp_path):
+        d = str(tmp_path)
+        layer = DurabilityLayer(d)
+        _write_events(layer, 20)
+        layer.snapshot({"state": {}})
+        _write_events(layer, 3)
+        layer.close()
+        proc = self._run(d)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "CLEAN" in proc.stdout
+
+    def test_torn_tail_reports_recoverable(self, tmp_path):
+        d = str(tmp_path)
+        layer = DurabilityLayer(d)
+        _write_events(layer, 3)
+        layer.close()
+        (seg,) = list_segments(d)
+        with open(seg, "ab") as f:
+            f.write(b"\x42\x00\x00half a record")
+        proc = self._run(d)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "TORN" in proc.stdout
+
+    def test_unusable_state(self, tmp_path):
+        proc = self._run(str(tmp_path))  # empty dir: nothing to recover
+        assert proc.returncode == 2
+
+    def test_gap_in_replayable_stream_flagged(self, tmp_path):
+        """A lost segment leaves a seq gap past the snapshot horizon:
+        recovery would silently skip those events, so fsck must flag
+        the dir as unusable rather than CLEAN."""
+        d = str(tmp_path)
+        write_snapshot(d, {"state": {}, "last_seq": 10})
+        w = JournalWriter(os.path.join(d, "journal.000000000011.log"))
+        for seq in (11, 12, 17, 18):  # 13..16 lost with their segment
+            w.append({"seq": seq, "type": "ev", "data": {}})
+        w.close()
+        proc = self._run(d)
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "GAP" in proc.stdout
+
+
+class TestSnapshotPayloadIsSelfContained:
+    def test_snapshot_pickles_and_restores_shared_structure(self, tmp_path):
+        """Planner metadata and the scheduler's throughput timelines
+        share OrderedDicts; a snapshot must preserve the sharing."""
+        s = _make_scheduler()
+        s.register_worker("v100", 1)
+        s.add_job(_job(300), timestamp=1.0)
+        blob = pickle.dumps({"state": s.snapshot_state()})
+        state = pickle.loads(blob)["state"]
+        s2 = _make_scheduler()
+        s2.restore_state(state)
+        assert s2._job_id_counter == 1
+        assert JobIdPair(0) in s2.acct.jobs
+        assert s2.workers.cluster_spec == {"v100": 1}

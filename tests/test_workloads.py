@@ -97,6 +97,63 @@ class TestCheckpoint:
     def test_missing_returns_none(self, tmp_path):
         assert load_checkpoint(str(tmp_path / "nope.ckpt"), {}) is None
 
+    def _save_two(self, tmp_path):
+        """Two generations: current says step 9, previous says step 7."""
+        path = str(tmp_path / "ckpt" / "model.ckpt")
+        template = {"params": {"w": jnp.arange(4.0)}, "step": jnp.int32(0)}
+        save_checkpoint(path, {"params": {"w": jnp.arange(4.0)},
+                               "step": jnp.int32(7)})
+        save_checkpoint(path, {"params": {"w": jnp.arange(4.0)},
+                               "step": jnp.int32(9)})
+        return path, jax.device_get(template)
+
+    @pytest.mark.recovery
+    def test_previous_checkpoint_retained(self, tmp_path):
+        path, template = self._save_two(tmp_path)
+        assert os.path.exists(path + ".prev")
+        assert int(load_checkpoint(path, template)["step"]) == 9
+
+    @pytest.mark.recovery
+    def test_corrupt_current_falls_back_to_previous(self, tmp_path):
+        path, template = self._save_two(tmp_path)
+        with open(path, "r+b") as f:
+            f.seek(5)
+            f.write(b"\xde\xad\xbe\xef")  # CRC now fails
+        restored = load_checkpoint(path, template)
+        assert restored is not None and int(restored["step"]) == 7
+
+    @pytest.mark.recovery
+    def test_truncated_current_falls_back(self, tmp_path):
+        """A preemption mid-write tears the file: footer missing."""
+        path, template = self._save_two(tmp_path)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+        restored = load_checkpoint(path, template)
+        # Either the torn payload fails msgpack decode or the footer is
+        # gone; both roads lead to the previous checkpoint.
+        assert restored is not None and int(restored["step"]) == 7
+
+    @pytest.mark.recovery
+    def test_both_corrupt_fresh_start_not_crash(self, tmp_path):
+        path, template = self._save_two(tmp_path)
+        for p in (path, path + ".prev"):
+            with open(p, "r+b") as f:
+                f.seek(5)
+                f.write(b"\xde\xad\xbe\xef")
+        assert load_checkpoint(path, template) is None
+
+    def test_legacy_footerless_checkpoint_still_loads(self, tmp_path):
+        import flax.serialization
+        path = str(tmp_path / "legacy.ckpt")
+        state = {"params": {"w": jnp.arange(3.0)}, "step": jnp.int32(5)}
+        payload = flax.serialization.msgpack_serialize(
+            flax.serialization.to_state_dict(jax.device_get(state)))
+        with open(path, "wb") as f:
+            f.write(payload)  # pre-footer format
+        restored = load_checkpoint(path, jax.device_get(state))
+        assert int(restored["step"]) == 5
+
 
 class _RecordingIterator:
     def __init__(self):
