@@ -44,7 +44,6 @@ import glob
 import json
 import os
 import re
-import shlex
 import shutil
 import statistics
 import subprocess

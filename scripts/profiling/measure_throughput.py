@@ -29,7 +29,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from shockwave_tpu.core.constants import DEFAULT_BS, oracle_job_type

@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""One-shot local/CI check driver: ruff + swtpu-check + fsck smoke.
+
+    python scripts/utils/check.py
+
+Runs, in order:
+
+1. ``ruff check .`` — generic Python hygiene (config in pyproject.toml).
+   Skipped with a warning when ruff is not installed (the runtime image
+   does not ship it; CI installs it).
+2. ``python -m shockwave_tpu.analysis`` — the repo-aware invariant
+   analyzer (lock discipline, journal coverage, durability,
+   determinism, exception hygiene).
+3. ``scripts/utils/fsck_journal.py --help`` — smoke-check that the
+   offline journal validator stays importable and argparse-clean.
+
+Exit status is non-zero iff any check that RAN failed; a skipped check
+never masks a failure.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _run(label: str, argv: list) -> bool:
+    print(f"=== {label}: {' '.join(argv)}")
+    proc = subprocess.run(argv, cwd=REPO)
+    status = "OK" if proc.returncode == 0 else f"FAILED (exit {proc.returncode})"
+    print(f"=== {label}: {status}")
+    return proc.returncode == 0
+
+
+def main() -> int:
+    results = {}
+
+    if shutil.which("ruff"):
+        results["ruff"] = _run("ruff", ["ruff", "check", "."])
+    else:
+        print("=== ruff: SKIPPED (not installed; `pip install ruff` or "
+              "rely on CI)")
+
+    results["swtpu-check"] = _run(
+        "swtpu-check", [sys.executable, "-m", "shockwave_tpu.analysis"])
+
+    results["fsck-smoke"] = _run(
+        "fsck-smoke", [sys.executable,
+                       os.path.join("scripts", "utils", "fsck_journal.py"),
+                       "--help"])
+
+    failed = [name for name, ok in results.items() if not ok]
+    if failed:
+        print(f"check.py: FAILED ({', '.join(failed)})")
+        return 1
+    print(f"check.py: all {len(results)} check(s) passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

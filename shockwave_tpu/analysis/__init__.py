@@ -1,0 +1,16 @@
+"""swtpu-check: invariant-enforcing static analysis + runtime sanitizer.
+
+``python -m shockwave_tpu.analysis`` runs five AST-based, repo-aware
+passes over the tree (exit 0 clean / 1 findings, ``file:line`` format);
+``analysis/sanitizer.py`` is the runtime half — instrumented locks that
+detect lock-order cycles and unowned protected-state access under
+``SWTPU_SANITIZE=1``. See README "Static analysis & invariants".
+
+Kept import-light on purpose: ``core/locking.requires_lock`` imports
+``analysis.sanitizer`` on every annotated call, so this package must
+not pull in the AST machinery (or anything heavy) at import time.
+"""
+from . import sanitizer
+from .sanitizer import enabled, maybe_wrap, monitor
+
+__all__ = ["sanitizer", "enabled", "maybe_wrap", "monitor"]

@@ -1,0 +1,84 @@
+"""CLI driver: ``python -m shockwave_tpu.analysis [--root R] [--select a,b]``.
+
+Runs every pass (or the ``--select``ed subset) over the repo tree and
+prints findings as ``path:line: [pass-id] message``. Exit status: 0 on
+a clean tree, 1 when any finding survives, 2 on usage errors.
+
+The tier-1 gate (tests/test_analysis.py) runs exactly this entry
+point, so CI and a local ``scripts/utils/check.py`` see the same
+verdict.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .core import Finding, RepoIndex
+from .passes import ALL_PASSES
+
+#: Repo-relative directories scanned by default.
+DEFAULT_INCLUDE_DIRS = ("shockwave_tpu", "scripts")
+#: Generated code is not ours to lint.
+DEFAULT_EXCLUDE_GLOBS = ("shockwave_tpu/runtime/proto/*",)
+
+
+def default_root() -> str:
+    """The repo root: the directory holding the shockwave_tpu package."""
+    package_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(package_dir)
+
+
+def run(root: Optional[str] = None,
+        select: Optional[List[str]] = None) -> List[Finding]:
+    """Run the selected passes with repo-default scopes; returns the
+    combined findings sorted by location."""
+    index = RepoIndex.from_root(root or default_root(),
+                                include_dirs=DEFAULT_INCLUDE_DIRS,
+                                exclude_globs=DEFAULT_EXCLUDE_GLOBS)
+    findings: List[Finding] = []
+    for name in (select or sorted(ALL_PASSES)):
+        findings.extend(ALL_PASSES[name](index))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.pass_id))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m shockwave_tpu.analysis",
+        description=__doc__.splitlines()[0])
+    parser.add_argument("--root", default=None,
+                        help="repo root to scan (default: autodetect "
+                             "from the installed package location)")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated pass ids "
+                             f"(default: all of {', '.join(sorted(ALL_PASSES))})")
+    parser.add_argument("--list", action="store_true",
+                        help="list pass ids and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name, fn in sorted(ALL_PASSES.items()):
+            first_line = (fn.__doc__ or "").strip().splitlines()[0]
+            print(f"{name}: {first_line}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [p.strip() for p in args.select.split(",") if p.strip()]
+        unknown = [p for p in select if p not in ALL_PASSES]
+        if unknown:
+            print(f"unknown pass id(s): {', '.join(unknown)} "
+                  f"(try --list)", file=sys.stderr)
+            return 2
+
+    findings = run(root=args.root, select=select)
+    for f in findings:
+        print(f)
+    print(f"swtpu-check: {len(findings)} finding(s)"
+          + ("" if findings else " — tree is clean"))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,169 @@
+"""Shared plumbing for the swtpu-check passes: parsed-file index,
+findings, and inline suppressions.
+
+A finding is ``path:line: [pass-id] message`` — stable, greppable, and
+what the tier-1 gate (tests/test_analysis.py) asserts against.
+
+Inline suppression: a line (or the ``def`` line of a function, which
+covers the whole function) may carry
+
+    # swtpu-check: ignore[pass-id]           (one id)
+    # swtpu-check: ignore[pass-a,pass-b]     (several)
+
+Every suppression is an auditable exception to an invariant; the
+comment should say why (e.g. "telemetry, not durable state").
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set
+
+SUPPRESS_RE = re.compile(r"#\s*swtpu-check:\s*ignore\[([a-zA-Z0-9_,\- ]+)\]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str       # repo-relative, forward slashes
+    line: int
+    pass_id: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+class SourceFile:
+    """One parsed module: AST plus per-line suppression sets."""
+
+    def __init__(self, abs_path: str, rel_path: str, text: str):
+        self.abs_path = abs_path
+        self.rel = rel_path.replace(os.sep, "/")
+        self.text = text
+        self.tree = ast.parse(text, filename=rel_path)
+        self.suppressions: Dict[int, Set[str]] = {}
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            m = SUPPRESS_RE.search(line)
+            if m:
+                ids = {p.strip() for p in m.group(1).split(",") if p.strip()}
+                self.suppressions[lineno] = ids
+
+    def suppressed(self, line: int, pass_id: str) -> bool:
+        ids = self.suppressions.get(line)
+        return ids is not None and pass_id in ids
+
+    def matches(self, globs: Iterable[str]) -> bool:
+        return any(fnmatch.fnmatch(self.rel, g) for g in globs)
+
+
+class RepoIndex:
+    """The set of files one analyzer run looks at."""
+
+    def __init__(self, files: List[SourceFile], root: str):
+        self.files = files
+        self.root = root
+
+    @classmethod
+    def from_root(cls, root: str,
+                  include_dirs: Optional[Iterable[str]] = None,
+                  exclude_globs: Iterable[str] = ()) -> "RepoIndex":
+        """Index every .py file under `root` (restricted to
+        `include_dirs`, repo-relative, when given). A file that does
+        not parse becomes a hard error — the analyzer must never
+        silently skip code."""
+        root = os.path.abspath(root)
+        files: List[SourceFile] = []
+        roots = ([os.path.join(root, d) for d in include_dirs]
+                 if include_dirs else [root])
+        for base in roots:
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for name in sorted(filenames):
+                    if not name.endswith(".py"):
+                        continue
+                    abs_path = os.path.join(dirpath, name)
+                    rel = os.path.relpath(abs_path, root).replace(os.sep, "/")
+                    if any(fnmatch.fnmatch(rel, g) for g in exclude_globs):
+                        continue
+                    with open(abs_path, encoding="utf-8") as f:
+                        text = f.read()
+                    files.append(SourceFile(abs_path, rel, text))
+        return cls(files, root)
+
+
+def finding(src: SourceFile, node_or_line, pass_id: str,
+            message: str) -> Optional[Finding]:
+    """Build a Finding unless the line (or the enclosing suppression
+    line passed by the caller) suppresses this pass."""
+    line = (node_or_line if isinstance(node_or_line, int)
+            else node_or_line.lineno)
+    if src.suppressed(line, pass_id):
+        return None
+    return Finding(src.rel, line, pass_id, message)
+
+
+# ----------------------------------------------------------------------
+# Small AST helpers shared by the passes
+# ----------------------------------------------------------------------
+
+def is_self_attr(node: ast.AST, attr: Optional[str] = None) -> bool:
+    """`self.<attr>` (any attribute when attr is None)."""
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and (attr is None or node.attr == attr))
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target ("os.replace", "open", "self._emit");
+    empty string for anything fancier (subscripts, calls of calls)."""
+    parts: List[str] = []
+    cur = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif not parts:
+        return ""
+    else:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def literal_str_set(node: ast.AST) -> Optional[Set[str]]:
+    """Evaluate `frozenset({...})` / set / tuple / list of string
+    literals; None when the node is anything else."""
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in ("frozenset", "set") and node.args):
+        return literal_str_set(node.args[0])
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for elt in node.elts:
+            value = const_str(elt)
+            if value is None:
+                return None
+            out.add(value)
+        return out
+    return None
+
+
+def decorated_requires_lock(fn: ast.AST) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Name) and dec.id == "requires_lock":
+            return True
+        if isinstance(dec, ast.Attribute) and dec.attr == "requires_lock":
+            return True
+    return False

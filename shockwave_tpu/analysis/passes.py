@@ -1,0 +1,411 @@
+"""The five swtpu-check passes.
+
+Each pass is a function ``check_<name>(index, ...) -> List[Finding]``
+taking a ``core.RepoIndex``; scope/allowlist arguments default to the
+repo's real configuration (``__main__`` runs them with defaults) and
+are injectable so the fixture-based negative tests can point a pass at
+a deliberately-broken module.
+
+| pass id            | invariant                                             |
+|--------------------|-------------------------------------------------------|
+| lock-discipline    | ``_LOCK_PROTECTED`` fields only touched under the     |
+|                    | lock / in ``@requires_lock`` methods                  |
+| journal-coverage   | emitted journal event types <-> ``_replay_*`` handlers|
+|                    | is a bijection                                        |
+| durability         | no raw write-mode ``open`` in state-owning modules,   |
+|                    | no ``os.rename/replace`` outside ``core/durable_io``  |
+| determinism        | no wall clock / unseeded RNG in simulator, solver and |
+|                    | shockwave modules                                     |
+| exception-hygiene  | no bare ``except:``, no silent ``except Exception:    |
+|                    | pass``                                                |
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import (Finding, RepoIndex, SourceFile, call_name, const_str,
+                   decorated_requires_lock, finding, is_self_attr,
+                   literal_str_set)
+
+# ----------------------------------------------------------------------
+# 1. lock-discipline
+# ----------------------------------------------------------------------
+
+LOCK_ATTRS = frozenset({"_lock", "_cv"})
+#: Methods that run before the object escapes its constructor thread.
+LOCK_EXEMPT_METHODS = frozenset({"__init__"})
+PROTECTED_REGISTRY_NAME = "_LOCK_PROTECTED"
+
+
+def _is_lock_expr(node: ast.AST, lock_attrs: frozenset) -> bool:
+    return (isinstance(node, ast.Attribute) and is_self_attr(node)
+            and node.attr in lock_attrs)
+
+
+def check_lock_discipline(index: RepoIndex,
+                          lock_attrs: frozenset = LOCK_ATTRS,
+                          exempt_methods: frozenset = LOCK_EXEMPT_METHODS
+                          ) -> List[Finding]:
+    """Every class that declares ``_LOCK_PROTECTED = frozenset({...})``
+    gets its methods checked: a read or write of ``self.<field>`` for a
+    protected field must sit lexically inside ``with self._lock`` /
+    ``with self._cv``, or in a method annotated ``@requires_lock``
+    (whose callers are runtime-checked by the sanitizer), or in
+    ``__init__`` (single-threaded by construction). Nested function
+    bodies run at call time, not at definition time, so they reset the
+    lock context — a timer callback defined inside a locked region is
+    NOT covered by it."""
+    pass_id = "lock-discipline"
+    findings: List[Finding] = []
+
+    def scan(src: SourceFile, protected: Set[str], node: ast.AST,
+             locked: bool, fn_line: int) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = locked or any(
+                _is_lock_expr(item.context_expr, lock_attrs)
+                for item in node.items)
+            for child in ast.iter_child_nodes(node):
+                scan(src, protected, child, inner, fn_line)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = (decorated_requires_lock(node)
+                     or node.name in exempt_methods)
+            if src.suppressed(node.lineno, pass_id):
+                return
+            for child in node.body:
+                scan(src, protected, child, inner, node.lineno)
+            return
+        if isinstance(node, ast.Lambda):
+            scan(src, protected, node.body, False, fn_line)
+            return
+        if (isinstance(node, ast.Attribute) and is_self_attr(node)
+                and node.attr in protected and not locked):
+            f = finding(src, node, pass_id,
+                        f"unlocked access to protected field "
+                        f"'self.{node.attr}' (hold self._lock/_cv, or "
+                        f"annotate the method @requires_lock)")
+            if f is not None and not src.suppressed(fn_line, pass_id):
+                findings.append(f)
+            return
+        for child in ast.iter_child_nodes(node):
+            scan(src, protected, child, locked, fn_line)
+
+    for src in index.files:
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            protected: Optional[Set[str]] = None
+            for stmt in cls.body:
+                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == PROTECTED_REGISTRY_NAME):
+                    protected = literal_str_set(stmt.value)
+            if not protected:
+                continue
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scan(src, protected, item, False, item.lineno)
+    return findings
+
+
+# ----------------------------------------------------------------------
+# 2. journal-coverage
+# ----------------------------------------------------------------------
+
+#: Methods whose first positional argument is a journal event type.
+EMIT_METHODS = frozenset({"self._emit", "self._emit_audit",
+                          "self._emit_event", "self._journal_event"})
+REPLAY_PREFIX = "_replay_"
+
+
+def check_journal_coverage(index: RepoIndex) -> List[Finding]:
+    """Journaled event types and ``_replay_*`` handlers must form a
+    bijection across the indexed tree: an emit without a handler is
+    state that recovery silently drops; a handler without an emit is
+    dead replay code masking a renamed/removed event."""
+    pass_id = "journal-coverage"
+    emits: Dict[str, Tuple[SourceFile, int]] = {}
+    handlers: Dict[str, Tuple[SourceFile, int]] = {}
+    for src in index.files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                if call_name(node) in EMIT_METHODS and node.args:
+                    etype = const_str(node.args[0])
+                    if etype is not None:
+                        emits.setdefault(etype, (src, node.lineno))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name.startswith(REPLAY_PREFIX):
+                    etype = node.name[len(REPLAY_PREFIX):]
+                    handlers.setdefault(etype, (src, node.lineno))
+    findings: List[Finding] = []
+    for etype, (src, line) in sorted(emits.items()):
+        if etype not in handlers:
+            f = finding(src, line, pass_id,
+                        f"journal event '{etype}' is emitted but has no "
+                        f"_replay_{etype} handler: recovery would "
+                        "silently drop it")
+            if f is not None:
+                findings.append(f)
+    for etype, (src, line) in sorted(handlers.items()):
+        if etype not in emits:
+            f = finding(src, line, pass_id,
+                        f"replay handler _replay_{etype} has no matching "
+                        "emit site: dead recovery code (renamed or "
+                        "removed event?)")
+            if f is not None:
+                findings.append(f)
+    return findings
+
+
+# ----------------------------------------------------------------------
+# 3. durability
+# ----------------------------------------------------------------------
+
+#: Modules that own durable state: raw write-mode opens here must go
+#: through core/durable_io instead.
+DURABILITY_STATE_GLOBS = (
+    "shockwave_tpu/sched/*.py",
+    "shockwave_tpu/models/train_common.py",
+    "shockwave_tpu/core/durable_io.py",
+)
+#: The durable-write implementation itself (and the CRC-framed journal
+#: writer built directly on fsync) — the only places the primitives may
+#: appear.
+DURABILITY_ALLOW_GLOBS = (
+    "shockwave_tpu/core/durable_io.py",
+    "shockwave_tpu/sched/journal.py",
+)
+_WRITE_MODE_CHARS = set("wax+")
+
+
+def _open_write_mode(node: ast.Call) -> Optional[str]:
+    """The constant mode string of an `open` call when it enables
+    writing, else None. A non-constant mode counts as a write (it can't
+    be proven safe)."""
+    mode_node: Optional[ast.AST] = None
+    if len(node.args) >= 2:
+        mode_node = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if mode_node is None:
+        return None  # default "r"
+    mode = const_str(mode_node)
+    if mode is None:
+        return "<dynamic>"
+    return mode if _WRITE_MODE_CHARS & set(mode) else None
+
+
+def check_durability(index: RepoIndex,
+                     state_globs: Iterable[str] = DURABILITY_STATE_GLOBS,
+                     allow_globs: Iterable[str] = DURABILITY_ALLOW_GLOBS
+                     ) -> List[Finding]:
+    """State/checkpoint bytes must reach disk only through
+    ``core/durable_io.write_durable`` (CRC footer + fsync + atomic
+    rename + dir fsync). Flags raw write-mode ``open`` calls in
+    state-owning modules, and the rename/replace primitives anywhere in
+    the indexed tree outside durable_io."""
+    pass_id = "durability"
+    findings: List[Finding] = []
+    for src in index.files:
+        if src.matches(allow_globs):
+            continue
+        in_state_scope = src.matches(state_globs)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in ("os.rename", "os.replace"):
+                f = finding(src, node, pass_id,
+                            f"{name} outside core/durable_io.py: atomic "
+                            "replacement of durable files must use "
+                            "write_durable (CRC footer + fsync + dir "
+                            "fsync)")
+                if f is not None:
+                    findings.append(f)
+            elif name == "open" and in_state_scope:
+                mode = _open_write_mode(node)
+                if mode is not None:
+                    f = finding(src, node, pass_id,
+                                f"raw open(..., {mode!r}) in a "
+                                "state-owning module: durable writes "
+                                "must go through core/durable_io."
+                                "write_durable")
+                    if f is not None:
+                        findings.append(f)
+    return findings
+
+
+# ----------------------------------------------------------------------
+# 4. determinism
+# ----------------------------------------------------------------------
+
+#: Modules whose behavior must replay bit-identically (the simulator
+#: core, every policy, and the shockwave planner/MILP stack).
+DETERMINISM_SCOPE_GLOBS = (
+    "shockwave_tpu/solver/*.py",
+    "shockwave_tpu/shockwave/*.py",
+    "shockwave_tpu/sched/scheduler.py",
+    "shockwave_tpu/sched/state.py",
+)
+#: Wall-clock measurement utilities (two-point marginal timing) are the
+#: sanctioned home for real clocks.
+DETERMINISM_ALLOW_GLOBS = ("shockwave_tpu/core/timing.py",)
+
+_CLOCK_CALLS = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+})
+#: numpy.random constructors that are deterministic WHEN SEEDED.
+_SEEDABLE_RNG = frozenset({
+    "numpy.random.RandomState", "numpy.random.default_rng",
+    "random.Random",
+})
+_RNG_MODULES = ("random", "numpy.random")
+
+
+def _alias_map(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> canonical dotted prefix, for the modules the
+    determinism pass cares about."""
+    aliases: Dict[str, str] = {}
+    interesting = {"time", "datetime", "random", "numpy", "numpy.random"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in interesting:
+                    aliases[alias.asname or alias.name] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module in interesting:
+            for alias in node.names:
+                aliases[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}")
+    return aliases
+
+
+def _canonical(name: str, aliases: Dict[str, str]) -> str:
+    head, _, rest = name.partition(".")
+    base = aliases.get(head)
+    if base is None:
+        return name
+    return f"{base}.{rest}" if rest else base
+
+
+def _is_seeded_call(node: ast.Call) -> bool:
+    """Whether an RNG constructor is given a real seed: any positional
+    arg or a seed= keyword counts, UNLESS it is a literal None (which
+    all of these constructors treat as 'seed from OS entropy')."""
+
+    def real(value: ast.AST) -> bool:
+        return not (isinstance(value, ast.Constant) and value.value is None)
+
+    if any(real(a) for a in node.args):
+        return True
+    return any(kw.arg == "seed" and real(kw.value) for kw in node.keywords)
+
+
+def check_determinism(index: RepoIndex,
+                      scope_globs: Iterable[str] = DETERMINISM_SCOPE_GLOBS,
+                      allow_globs: Iterable[str] = DETERMINISM_ALLOW_GLOBS
+                      ) -> List[Finding]:
+    """Simulator/solver/shockwave modules must not read wall clocks or
+    unseeded RNGs: PR 2's recovery acceptance (and the fidelity
+    methodology) rely on bit-identical replay, and one ``time.time()``
+    in a policy silently breaks it for every future run."""
+    pass_id = "determinism"
+    findings: List[Finding] = []
+    for src in index.files:
+        if not src.matches(scope_globs) or src.matches(allow_globs):
+            continue
+        aliases = _alias_map(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _canonical(call_name(node), aliases)
+            message = None
+            if name in _CLOCK_CALLS:
+                message = (f"wall-clock call {name}() in a "
+                           "replay-deterministic module (route time "
+                           "through get_current_timestamp / journaled "
+                           "events)")
+            elif any(name == m or name.startswith(m + ".")
+                     for m in _RNG_MODULES):
+                if name in _SEEDABLE_RNG and _is_seeded_call(node):
+                    pass  # seeded constructor: deterministic
+                else:
+                    message = (f"unseeded RNG call {name}(...) in a "
+                               "replay-deterministic module (use a "
+                               "seeded Random/RandomState instance)")
+            if message is not None:
+                f = finding(src, node, pass_id, message)
+                if f is not None:
+                    findings.append(f)
+    return findings
+
+
+# ----------------------------------------------------------------------
+# 5. exception-hygiene
+# ----------------------------------------------------------------------
+
+_BROAD_NAMES = frozenset({"Exception", "BaseException"})
+
+
+def _is_broad_handler(type_node: ast.AST) -> bool:
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD_NAMES
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad_handler(elt) for elt in type_node.elts)
+    return False
+
+
+def _body_is_silent(body: List[ast.stmt]) -> bool:
+    """True when the handler neither logs, re-raises, nor produces a
+    value — i.e. the error evaporates."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def check_exception_hygiene(index: RepoIndex) -> List[Finding]:
+    """No bare ``except:`` anywhere; no ``except Exception: pass`` —
+    in the daemon threads and gRPC servicers that keep the control
+    plane alive, a swallowed exception IS the outage, just deferred.
+    Handlers that log, re-raise, or return a fallback are fine."""
+    pass_id = "exception-hygiene"
+    findings: List[Finding] = []
+    for src in index.files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                f = finding(src, node, pass_id,
+                            "bare 'except:' catches SystemExit/"
+                            "KeyboardInterrupt too; name the exception "
+                            "types")
+                if f is not None:
+                    findings.append(f)
+            elif _is_broad_handler(node.type) and _body_is_silent(node.body):
+                f = finding(src, node, pass_id,
+                            "'except Exception: pass' silently swallows "
+                            "the error; log it (or narrow the type and "
+                            "say why it is ignorable)")
+                if f is not None:
+                    findings.append(f)
+    return findings
+
+
+# ----------------------------------------------------------------------
+# Registry
+# ----------------------------------------------------------------------
+
+ALL_PASSES = {
+    "lock-discipline": check_lock_discipline,
+    "journal-coverage": check_journal_coverage,
+    "durability": check_durability,
+    "determinism": check_determinism,
+    "exception-hygiene": check_exception_hygiene,
+}

@@ -1,0 +1,253 @@
+"""Runtime concurrency sanitizer: instrumented locks + ownership checks.
+
+The static lock-discipline pass proves field accesses are *lexically*
+covered by a lock; this module closes the dynamic half of the story:
+
+- ``SanitizedLock`` wraps a ``threading.RLock``/``Lock`` and records,
+  per acquisition, the set of locks already held by the acquiring
+  thread. Those (held -> acquired) edges form the process-wide
+  lock-acquisition **order graph**; the moment an edge closes a cycle
+  (thread A takes L1 then L2 while thread B takes L2 then L1 — a
+  deadlock waiting for the right interleaving) a violation is recorded
+  with both edges' stacks of lock names.
+- It also tracks per-lock **hold times** (first acquire -> final
+  release, recursion-aware), reporting the max per lock — the number
+  that says whether an RPC handler is stalling the round pipeline.
+- ``@requires_lock`` methods (core/locking.py) report an
+  **unowned-access** violation when entered without the receiver's
+  lock held.
+
+Enabled by ``SWTPU_SANITIZE=1`` (any non-empty value other than "0").
+The tier-1 conftest turns it on for every ``runtime``/``recovery``/
+``faults``-marked test and asserts a clean report at teardown; in
+production the wrapper is never installed (``maybe_wrap`` returns the
+raw lock), so there is zero steady-state overhead.
+
+The wrapper deliberately implements the private RLock hooks
+(``_is_owned`` / ``_release_save`` / ``_acquire_restore``) so a
+``threading.Condition`` built on it — the scheduler's ``self._cv`` —
+routes ``wait()``'s full release/reacquire through the bookkeeping.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Set
+
+
+def enabled() -> bool:
+    return os.environ.get("SWTPU_SANITIZE", "0") not in ("", "0")
+
+
+@dataclass
+class Violation:
+    kind: str      # "lock-order-cycle" | "unowned-access"
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.kind}] {self.message}"
+
+
+class _Monitor:
+    """Process-wide registry: order graph, hold times, violations.
+
+    Lock names (not instances) are the graph nodes, so two scheduler
+    incarnations in one test (crash/restart) share one ordering
+    discipline — which is exactly the invariant we want checked.
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._edges: Dict[str, Set[str]] = {}
+        self._cycle_reported: Set[tuple] = set()
+        self._violations: List[Violation] = []
+        self._max_hold: Dict[str, float] = {}
+        self._tls = threading.local()
+
+    # -- per-thread held-lock stack ------------------------------------
+
+    def _held(self) -> List[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    # -- events from SanitizedLock -------------------------------------
+
+    def note_waiting(self, name: str) -> None:
+        """Called BEFORE the (possibly blocking) inner acquire: the
+        order edge and the cycle check must land while the thread can
+        still report them — in an actual deadlock the acquire never
+        returns, and a post-acquire record would name nothing."""
+        held = self._held()
+        if not held:
+            return
+        with self._mu:
+            for outer in held:
+                if outer == name:
+                    continue
+                self._edges.setdefault(outer, set()).add(name)
+                if self._reaches(name, outer):
+                    key = tuple(sorted((outer, name)))
+                    if key not in self._cycle_reported:
+                        self._cycle_reported.add(key)
+                        self._violations.append(Violation(
+                            "lock-order-cycle",
+                            f"acquiring {name!r} while holding "
+                            f"{outer!r}, but {outer!r} is also "
+                            f"acquired while {name!r} is held "
+                            "(deadlock potential)"))
+
+    def note_acquired(self, name: str) -> None:
+        self._held().append(name)
+
+    def note_released(self, name: str, held_s: float) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                break
+        with self._mu:
+            if held_s > self._max_hold.get(name, 0.0):
+                self._max_hold[name] = held_s
+
+    def _reaches(self, src: str, dst: str) -> bool:
+        """Whether dst is reachable from src in the order graph.
+        Caller holds self._mu."""
+        seen, frontier = set(), [src]
+        while frontier:
+            node = frontier.pop()
+            if node == dst:
+                return True
+            if node in seen:
+                continue
+            seen.add(node)
+            frontier.extend(self._edges.get(node, ()))
+        return False
+
+    # -- events from @requires_lock ------------------------------------
+
+    def record_unowned(self, what: str) -> None:
+        with self._mu:
+            self._violations.append(Violation(
+                "unowned-access",
+                f"{what} entered without holding the receiver's lock"))
+
+    # -- reporting -----------------------------------------------------
+
+    def report(self) -> dict:
+        with self._mu:
+            return {
+                "violations": list(self._violations),
+                "max_hold_s": dict(self._max_hold),
+                "order_edges": {k: sorted(v)
+                                for k, v in self._edges.items()},
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._cycle_reported.clear()
+            self._violations.clear()
+            self._max_hold.clear()
+        # Per-thread held stacks are left alone on purpose: a daemon
+        # thread mid-critical-section at reset time must still balance
+        # its own acquires/releases.
+
+
+_monitor = _Monitor()
+
+
+def monitor() -> _Monitor:
+    return _monitor
+
+
+class SanitizedLock:
+    """Instrumented wrapper around an RLock (or Lock).
+
+    Recursion-aware: order edges and hold timing fire on the outermost
+    acquire/release only, so ``with self._cv:`` nested inside
+    ``with self._lock:`` (same underlying lock) records one hold."""
+
+    def __init__(self, inner, name: str):
+        self._inner = inner
+        self.name = name
+        self._local = threading.local()
+
+    # -- depth bookkeeping (per thread) --------------------------------
+
+    def _depth(self) -> int:
+        return getattr(self._local, "depth", 0)
+
+    def _on_outermost_acquire(self) -> None:
+        _monitor.note_acquired(self.name)
+        self._local.t0 = time.monotonic()
+
+    def _on_outermost_release(self) -> None:
+        t0 = getattr(self._local, "t0", None)
+        held_s = 0.0 if t0 is None else time.monotonic() - t0
+        _monitor.note_released(self.name, held_s)
+
+    # -- lock protocol -------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        outermost = self._depth() == 0
+        if outermost:
+            # Edge + cycle check BEFORE the potentially blocking inner
+            # acquire (see note_waiting) — an attempted-but-failed
+            # trylock still records the ordering fact, which is what
+            # the discipline is about.
+            _monitor.note_waiting(self.name)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            if outermost:
+                self._on_outermost_acquire()
+            self._local.depth = self._depth() + 1
+        return got
+
+    def release(self) -> None:
+        depth = self._depth()
+        self._inner.release()  # raises on unowned release before bookkeeping
+        self._local.depth = max(depth - 1, 0)
+        if depth <= 1:
+            self._on_outermost_release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # -- private hooks Condition() relies on ---------------------------
+
+    def _is_owned(self) -> bool:
+        if self._depth() > 0:
+            return True
+        probe = getattr(self._inner, "_is_owned", None)
+        return bool(probe()) if probe is not None else False
+
+    def _release_save(self):
+        depth = self._depth()
+        self._local.depth = 0
+        self._on_outermost_release()
+        return (self._inner._release_save(), depth)
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, depth = state
+        _monitor.note_waiting(self.name)
+        self._inner._acquire_restore(inner_state)
+        self._on_outermost_acquire()
+        self._local.depth = depth
+
+    def __repr__(self) -> str:
+        return f"<SanitizedLock {self.name!r} wrapping {self._inner!r}>"
+
+
+def maybe_wrap(lock, name: str):
+    """Instrument `lock` when the sanitizer is enabled; otherwise return
+    it untouched (the production path — zero overhead)."""
+    return SanitizedLock(lock, name) if enabled() else lock

@@ -1,0 +1,58 @@
+"""Lock-discipline annotations shared by the static analyzer and the
+runtime sanitizer.
+
+Convention (enforced by ``python -m shockwave_tpu.analysis``, pass
+``lock-discipline``, and spot-checked at runtime by
+``analysis/sanitizer.py`` when ``SWTPU_SANITIZE=1``):
+
+- A class declares the attribute names that must only be touched while
+  holding ``self._lock`` in a class-level ``_LOCK_PROTECTED`` frozenset.
+- A method that touches protected state but does not take the lock
+  itself is annotated ``@requires_lock``: its contract is that every
+  caller already holds ``self._lock`` (or the condition variable built
+  on it). The static pass treats the method body as lock-covered; the
+  sanitizer verifies the contract on entry when enabled.
+
+``requires_lock`` is free when the sanitizer is off apart from one env
+lookup — no lock operations, no tracebacks — so annotating hot-path
+helpers costs nothing in production.
+"""
+from __future__ import annotations
+
+import functools
+
+
+def _lock_owned(lock) -> bool:
+    """Best-effort ownership check for RLocks and the sanitizer's
+    instrumented wrapper (both expose ``_is_owned``); objects without
+    it (plain Lock) are unverifiable and count as owned."""
+    probe = getattr(lock, "_is_owned", None)
+    if probe is None:
+        return True
+    try:
+        return bool(probe())
+    except Exception:  # noqa: BLE001 - a broken probe must not fail the call
+        return True
+
+
+def requires_lock(fn):
+    """Mark `fn` as "caller must hold ``self._lock``".
+
+    The marker is what the static lock-discipline pass keys on; the
+    wrapper additionally reports a violation to the concurrency
+    sanitizer when ``SWTPU_SANITIZE=1`` and the receiver's lock is not
+    held at entry (recorded, not raised — the report surfaces at test
+    teardown with the offending qualname)."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        from ..analysis import sanitizer
+        if sanitizer.enabled():
+            lock = getattr(self, "_lock", None)
+            if lock is not None and not _lock_owned(lock):
+                sanitizer.monitor().record_unowned(
+                    f"{type(self).__name__}.{fn.__name__}")
+        return fn(self, *args, **kwargs)
+
+    wrapper.__swtpu_requires_lock__ = True
+    return wrapper

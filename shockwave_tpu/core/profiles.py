@@ -9,11 +9,11 @@ utilization from profiled tables (reference: scheduler/utils.py:706-738,
 from __future__ import annotations
 
 import pickle
-from typing import Dict, List, Optional, Sequence
+from typing import List, Sequence
 
 from .adaptation import bs_schedule_for_mode
 from .constants import (MODEL_DATASET, dataset_size, num_epochs_for,
-                        oracle_job_type, steps_per_epoch)
+                        oracle_job_type)
 from .job import Job
 
 # Profiled per-(model, batch size) device memory footprint in MB.

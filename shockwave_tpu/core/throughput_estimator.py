@@ -17,7 +17,7 @@ this runs in microseconds on the scheduler host.
 from __future__ import annotations
 
 import random
-from typing import Dict, List, Sequence
+from typing import Dict, Sequence
 
 import numpy as np
 

@@ -12,8 +12,7 @@ MXU-friendly execution).
 """
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, NamedTuple, Tuple
+from typing import NamedTuple, Tuple
 
 import flax.linen as nn
 import jax

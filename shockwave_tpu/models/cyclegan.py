@@ -8,7 +8,7 @@ norm replaces batch norm exactly as in the original paper.
 """
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp

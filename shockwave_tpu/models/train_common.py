@@ -21,7 +21,7 @@ import os
 import signal
 import sys
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Callable, Optional
 
 import flax.serialization
 import jax

@@ -18,7 +18,6 @@ workloads past data parallelism.
 """
 from __future__ import annotations
 
-from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -47,7 +47,7 @@ import threading
 import time
 import zlib
 from dataclasses import dataclass, field
-from typing import Iterator, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from ..core.durable_io import (FOOTER_OK, fsync_dir as _fsync_dir,
                                verify_footer, write_durable)
@@ -315,7 +315,11 @@ class DurabilityLayer:
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
         self.snapshot_interval_rounds = snapshot_interval_rounds
-        self._lock = threading.Lock()
+        # Instrumented under SWTPU_SANITIZE=1: the scheduler emits under
+        # its own lock, so scheduler-lock -> journal-lock is an order
+        # edge the sanitizer watches for inversions.
+        from ..analysis.sanitizer import maybe_wrap
+        self._lock = maybe_wrap(threading.Lock(), "DurabilityLayer._lock")
 
         last_seq = 0
         snapshot = load_snapshot(state_dir)

@@ -27,7 +27,9 @@ from typing import Dict, List, Optional, Tuple
 
 import grpc
 
+from ..analysis.sanitizer import maybe_wrap
 from ..core.job import JobIdPair
+from ..core.locking import requires_lock
 from ..runtime.resilience import RpcUnavailableError
 from .journal import encode_job_key
 from .scheduler import DEADLINE_SLACK, INFINITY, Scheduler, SchedulerConfig
@@ -56,6 +58,29 @@ MAX_PORT = 65535
 
 
 class PhysicalScheduler(Scheduler):
+    #: Mutable state shared between the round loop, the allocation
+    #: thread, the liveness monitor, watchdog timers and the gRPC
+    #: handlers: reads and writes must hold self._lock (self._cv is the
+    #: condition built on the same lock). Enforced statically by
+    #: `python -m shockwave_tpu.analysis` (pass lock-discipline) and at
+    #: runtime by analysis/sanitizer.py under SWTPU_SANITIZE=1; methods
+    #: whose CALLERS must hold the lock are annotated @requires_lock.
+    _LOCK_PROTECTED = frozenset({
+        # scheduling-core aggregates (inherited from Scheduler)
+        "workers", "acct", "rounds",
+        "_allocation", "_need_to_update_allocation",
+        "_running_jobs", "_in_progress_updates", "_iterator_log_buffers",
+        "_steps_run_in_current_lease", "_job_timelines", "_bs_flags",
+        "_completed_jobs",
+        # physical-mode protocol state
+        "_worker_hosts", "_worker_connections", "_lease_update_requests",
+        "_last_heartbeat", "_kill_rearm_counts", "_dispatch_stamp",
+        "_done_stamp", "_dispatch_seq", "_failure_compensated",
+        "_ever_signaled", "_max_steps_consensus", "_completion_events",
+        "_redispatch_assignments", "_current_round_start_time",
+        "_port_offset",
+    })
+
     def __init__(self, policy, throughputs_file=None, profiles=None,
                  config: Optional[SchedulerConfig] = None,
                  expected_num_workers: Optional[int] = None,
@@ -64,7 +89,9 @@ class PhysicalScheduler(Scheduler):
                          throughputs_file=throughputs_file, profiles=profiles,
                          config=config)
         self._start_time = time.time()
-        self._lock = threading.RLock()
+        # Instrumented under SWTPU_SANITIZE=1 (lock-order + hold-time
+        # recording, analysis/sanitizer.py); the raw RLock otherwise.
+        self._lock = maybe_wrap(threading.RLock(), "PhysicalScheduler._lock")
         self._cv = threading.Condition(self._lock)
         self._expected_num_workers = expected_num_workers
 
@@ -122,23 +149,27 @@ class PhysicalScheduler(Scheduler):
                              "from)")
         if self._config.state_dir:
             from .journal import DurabilityLayer, has_state, load_state
-            if self._config.resume:
-                recovered = load_state(self._config.state_dir)
-                self.restore_from_durable_state(recovered)
-                self._recovered = True
-                self._recovered_at = self.get_current_timestamp()
-            elif has_state(self._config.state_dir):
-                raise ValueError(
-                    f"state dir {self._config.state_dir!r} contains "
-                    "existing scheduler state; pass resume=True "
-                    "(--resume) to recover it, or point state_dir at a "
-                    "fresh directory")
-            self._durability = DurabilityLayer(
-                self._config.state_dir,
-                self._config.snapshot_interval_rounds)
-            self.attach_durability(self._durability)
-            if self._recovered:
-                self._requeue_inflight_after_recovery()
+            # Recovery mutates protected state and runs @requires_lock
+            # replay helpers; hold the (uncontended) lock so the
+            # discipline holds even during construction.
+            with self._lock:
+                if self._config.resume:
+                    recovered = load_state(self._config.state_dir)
+                    self.restore_from_durable_state(recovered)
+                    self._recovered = True
+                    self._recovered_at = self.get_current_timestamp()
+                elif has_state(self._config.state_dir):
+                    raise ValueError(
+                        f"state dir {self._config.state_dir!r} contains "
+                        "existing scheduler state; pass resume=True "
+                        "(--resume) to recover it, or point state_dir at "
+                        "a fresh directory")
+                self._durability = DurabilityLayer(
+                    self._config.state_dir,
+                    self._config.snapshot_interval_rounds)
+                self.attach_durability(self._durability)
+                if self._recovered:
+                    self._requeue_inflight_after_recovery()
 
         from ..runtime.servers import serve_scheduler
         self._server = serve_scheduler(port, {
@@ -174,6 +205,7 @@ class PhysicalScheduler(Scheduler):
             self._cv.notify_all()
             return job_id
 
+    @requires_lock
     def _remove_job(self, job_id: JobIdPair) -> None:
         super()._remove_job(job_id)
         # Drop per-job protocol state so a long-running scheduler does not
@@ -198,6 +230,7 @@ class PhysicalScheduler(Scheduler):
     # Durability (physical extensions)
     # ------------------------------------------------------------------
 
+    @requires_lock
     def snapshot_state(self) -> dict:
         state = super().snapshot_state()
         # Host endpoints (not clients — those are rebuilt on restore) so
@@ -210,6 +243,7 @@ class PhysicalScheduler(Scheduler):
             for key, host in self._worker_hosts.items()}
         return state
 
+    @requires_lock
     def restore_state(self, state: dict) -> None:
         super().restore_state(state)
         for key, host in state.get("worker_hosts", {}).items():
@@ -218,6 +252,7 @@ class PhysicalScheduler(Scheduler):
                                     host["num_chips"],
                                     [int(i) for i in host["worker_ids"]])
 
+    @requires_lock
     def _adopt_worker_host(self, addr: str, port: int, worker_type: str,
                            num_chips: int, worker_ids) -> None:
         """Rebuild the connection plumbing for a journaled worker host.
@@ -245,6 +280,7 @@ class PhysicalScheduler(Scheduler):
                                 int(data.get("num_chips", 1)),
                                 [int(i) for i in data["worker_ids"]])
 
+    @requires_lock
     def _requeue_inflight_after_recovery(self) -> None:
         """Conservative re-adoption of whatever was in flight at the
         crash: every assignment is dropped and its job requeued by the
@@ -285,6 +321,7 @@ class PhysicalScheduler(Scheduler):
                 "[Recovery] %d in-flight jobs requeued conservatively "
                 "(no failure charged): %s", len(requeued), requeued)
 
+    @requires_lock
     def _maybe_snapshot(self) -> None:
         """End-of-round compacting snapshot every
         snapshot_interval_rounds rounds. Must hold the lock."""
@@ -349,6 +386,7 @@ class PhysicalScheduler(Scheduler):
             self._cv.notify_all()
         return worker_ids, round_duration
 
+    @requires_lock
     def _revive_worker_host(self, key) -> List[int]:
         """Re-admit a known host (rejoin after death, daemon restart, or a
         duplicate register retry). Must hold the lock."""
@@ -382,8 +420,10 @@ class PhysicalScheduler(Scheduler):
         if old is not None and hasattr(old, "close"):
             try:
                 old.close()
-            except Exception:  # noqa: BLE001 - best-effort cleanup
-                pass
+            except Exception as e:  # noqa: BLE001 - best-effort cleanup,
+                # but say so: a close that reliably fails here would
+                # leak a channel per churn event, invisibly.
+                logger.debug("closing replaced worker channel failed: %s", e)
 
     # ------------------------------------------------------------------
     # Worker liveness
@@ -457,6 +497,7 @@ class PhysicalScheduler(Scheduler):
                         if i not in self.workers.dead:
                             self.workers.last_seen[i] = stamp
 
+    @requires_lock
     def _retire_worker_host(self, key) -> None:
         """Declare a host dead: pull its chips from capacity, fail its
         in-round micro-tasks (requeue), and prune it from the next
@@ -476,6 +517,7 @@ class PhysicalScheduler(Scheduler):
         self._fail_jobs_on_dead_workers(set(dead_ids))
         self._cv.notify_all()
 
+    @requires_lock
     def _retire_worker_by_id(self, worker_id: int) -> None:
         """Retire the host that owns `worker_id` (dispatch-failure path).
         Must hold the lock."""
@@ -490,6 +532,7 @@ class PhysicalScheduler(Scheduler):
         self._fail_jobs_on_dead_workers({worker_id})
         self._cv.notify_all()
 
+    @requires_lock
     def _fail_jobs_on_dead_workers(self, dead_ids: set) -> None:
         """Mark every micro-task scheduled on a dead chip failed-in-round
         (synthesized zero-step done, so `_end_round` completes and the
@@ -748,6 +791,7 @@ class PhysicalScheduler(Scheduler):
                        big=bool(big_bs), small=not big_bs)
             self._cv.notify_all()
 
+    @requires_lock
     def _is_duplicate_done(self, job_id: JobIdPair, worker_id: int) -> bool:
         """True when this (job, worker) already had a report accepted for
         its latest dispatch (see _dispatch_stamp)."""
@@ -756,6 +800,7 @@ class PhysicalScheduler(Scheduler):
         return (dispatched is not None and accepted is not None
                 and accepted == dispatched)
 
+    @requires_lock
     def _job_assigned(self, job_id: JobIdPair,
                       worker_id: Optional[int] = None) -> bool:
         """Whether a current/next/redispatch assignment covers job_id —
@@ -769,6 +814,7 @@ class PhysicalScheduler(Scheduler):
                    and (worker_id is None or worker_id in ids)
                    for m in maps for combo, ids in m.items())
 
+    @requires_lock
     def _is_recovery_orphan(self, job_id: JobIdPair,
                             worker_id: Optional[int] = None) -> bool:
         """Whether an Init/UpdateLease should be treated as coming from
@@ -877,6 +923,7 @@ class PhysicalScheduler(Scheduler):
                     self.rounds.next_assignments[job_id])
             self._cv.notify_all()
 
+    @requires_lock
     def _inflight_elapsed_times(self, current_time: float):
         """Unaccounted time of currently-running microtasks, charged into
         the priority fractions (reference: scheduler.py:3640-3666). Done
@@ -932,9 +979,10 @@ class PhysicalScheduler(Scheduler):
                 # next trigger instead.
                 self.log.exception("allocation solve failed; keeping "
                                    "previous allocation")
-                allocation = self._allocation
+                allocation = None
             with self._cv:
-                self._allocation = allocation
+                if allocation is not None:
+                    self._allocation = allocation
                 self._need_to_update_allocation = False
                 self._cv.notify_all()
 
@@ -942,6 +990,7 @@ class PhysicalScheduler(Scheduler):
     # Round pipeline
     # ------------------------------------------------------------------
 
+    @requires_lock
     def _try_dispatch_job(self, job_id: JobIdPair, worker_ids: Tuple[int, ...],
                           next_round: bool = False):
         if not next_round or job_id not in self.rounds.current_assignments:
@@ -1040,6 +1089,7 @@ class PhysicalScheduler(Scheduler):
             if not next_round:
                 self._remove_available_worker(worker_id)
 
+    @requires_lock
     def _fail_dispatch_in_round(self, job_id: JobIdPair, worker_ids,
                                 next_round: bool) -> None:
         """Fail one job's round after a rejected dispatch, leaving its
@@ -1075,6 +1125,7 @@ class PhysicalScheduler(Scheduler):
             for item in items:
                 self._available_workers.put(item)
 
+    @requires_lock
     def _begin_round(self):
         self._current_round_start_time = self.get_current_timestamp()
         for job_id in self.rounds.current_assignments:
@@ -1090,10 +1141,12 @@ class PhysicalScheduler(Scheduler):
         self._redispatch_assignments = collections.OrderedDict()
         self.log.info("*** START ROUND %d ***", self.rounds.num_completed_rounds)
 
+    @requires_lock
     def _is_final_round(self):
         return (self._config.max_rounds is not None
                 and self.rounds.num_completed_rounds + 1 == self._config.max_rounds)
 
+    @requires_lock
     def _mid_round(self):
         """Recompute next round's schedule, extend leases, dispatch early."""
         if self._is_final_round():
@@ -1132,6 +1185,7 @@ class PhysicalScheduler(Scheduler):
 
         self._schedule_completion_events(round_end)
 
+    @requires_lock
     def _schedule_completion_events(self, round_end):
         """Watchdogs: kill jobs that miss the round deadline; synthesize
         completion for jobs with extended leases."""
@@ -1154,6 +1208,7 @@ class PhysicalScheduler(Scheduler):
             timer.start()
             self._completion_events[job_id] = timer
 
+    @requires_lock
     def _end_round(self):
         """Wait for all scheduled jobs to complete, then roll the round."""
         jobs_to_complete = {
@@ -1354,8 +1409,8 @@ class PhysicalScheduler(Scheduler):
                 self._try_dispatch_job(job_id, worker_ids)
 
         while True:
-            final = self._is_final_round()
             with self._cv:
+                final = self._is_final_round()
                 self._begin_round()
             time.sleep(self._time_per_iteration * SCHEDULE_RECOMPUTE_FRACTION)
             with self._cv:
@@ -1365,7 +1420,8 @@ class PhysicalScheduler(Scheduler):
                 self._end_round()
                 if self._shockwave_planner is not None:
                     self._update_shockwave_planner_physical(extended)
-            if final or not self.acct.jobs and self._config.max_rounds is None:
+                idle = not self.acct.jobs
+            if final or idle and self._config.max_rounds is None:
                 if final or self._all_done():
                     break
         self._done_event.set()
@@ -1374,6 +1430,7 @@ class PhysicalScheduler(Scheduler):
         with self._lock:
             return not self.acct.jobs
 
+    @requires_lock
     def _update_shockwave_planner_physical(self, extended_leases):
         """Physical variant: account in-lease steps for extended leases
         (reference: scheduler.py:2294-2331)."""
@@ -1408,7 +1465,13 @@ class PhysicalScheduler(Scheduler):
 
     def shutdown(self):
         self._done_event.set()
-        for client in set(self._worker_connections.values()):
+        # Snapshot the client set under the lock (a re-registration RPC
+        # may be rebuilding host channels concurrently), then shut the
+        # clients down outside it — each shutdown is a bounded RPC, and
+        # holding the lock across it would stall any in-flight handler.
+        with self._lock:
+            clients = set(self._worker_connections.values())
+        for client in clients:
             client.shutdown()
         self._server.stop(grace=1)
         if self._durability is not None:

@@ -20,7 +20,7 @@ import copy
 import heapq
 import logging
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -1224,7 +1224,7 @@ class Scheduler:
             [list(k) if isinstance(k, tuple) else k, list(ids)]
             for k, ids in int_assignments.items()])
 
-    def _replay_assignments(
+    def _execute_forced_assignments(
             self, recorded: Dict[int, Sequence[int]]
     ) -> "collections.OrderedDict":
         """Schedule-replay: execute one recorded physical round verbatim
@@ -1572,27 +1572,65 @@ class Scheduler:
     # Simulation
     # ------------------------------------------------------------------
 
+    #: Integrity magic for simulation checkpoints (durable_io footer).
+    SIM_CHECKPOINT_MAGIC = b"SWTPUC1\n"
+
     def save_simulation_checkpoint(self, path: str, queued, running,
                                    remaining_jobs, current_round) -> None:
-        """Pickle the full simulator state — including the in-flight
+        """Persist the full simulator state — including the in-flight
         micro-task heap — so a resumed run re-enters the event loop with
-        identical state (reference: scheduler.py:1518-1594)."""
+        identical state (reference: scheduler.py:1518-1594). Written
+        through durable_io (CRC footer + fsync + atomic rename + .prev
+        retention): a multi-hour sweep resuming from a torn checkpoint
+        would silently produce garbage results."""
         import pickle
-        with open(path, "wb") as f:
-            pickle.dump({
-                "scheduler": self.__dict__,
-                "queued": queued,
-                "running": running,
-                "remaining_jobs": remaining_jobs,
-                "current_round": current_round,
-            }, f)
+        from ..core.durable_io import write_durable
+        write_durable(path, pickle.dumps({
+            "scheduler": self.__dict__,
+            "queued": queued,
+            "running": running,
+            "remaining_jobs": remaining_jobs,
+            "current_round": current_round,
+        }, protocol=pickle.HIGHEST_PROTOCOL), self.SIM_CHECKPOINT_MAGIC)
         self.log.info("Saved simulation checkpoint to %s (round %d, %d jobs left)",
                     path, current_round, remaining_jobs)
 
     def _load_simulation_checkpoint(self, path: str):
         import pickle
-        with open(path, "rb") as f:
-            state = pickle.load(f)
+        from ..core.durable_io import FOOTER_CORRUPT, FOOTER_OK, verify_footer
+
+        def read_generation(gen_path: str, required: bool):
+            """One checkpoint generation, or None when unreadable.
+            FOOTER_MISSING = legacy footer-less checkpoint: loadable."""
+            try:
+                with open(gen_path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                if required:
+                    raise
+                return None
+            status, body = verify_footer(blob, self.SIM_CHECKPOINT_MAGIC)
+            if status == FOOTER_CORRUPT:
+                return None
+            try:
+                return pickle.loads(body if status == FOOTER_OK else blob)
+            except Exception:  # noqa: BLE001 - any unpickle failure is
+                # corruption for fallback purposes
+                return None
+
+        state = read_generation(path, required=True)
+        if state is None:
+            # The .prev generation write_durable retains exists exactly
+            # for this moment (same fallback chain as trainer
+            # checkpoints, models/train_common.load_checkpoint).
+            state = read_generation(path + ".prev", required=False)
+            if state is None:
+                raise ValueError(
+                    f"simulation checkpoint {path!r} failed its CRC "
+                    "check and no loadable .prev generation exists; "
+                    "re-run from the trace")
+            self.log.warning("simulation checkpoint %s corrupt; resumed "
+                             "from the previous generation", path)
         self.__dict__.update(state["scheduler"])
         return (state["queued"], state["running"], state["remaining_jobs"],
                 state["current_round"])
@@ -1772,7 +1810,7 @@ class Scheduler:
             # Schedule the next round.
             if (forced_schedule is not None
                     and current_round < len(forced_schedule)):
-                assignments = self._replay_assignments(
+                assignments = self._execute_forced_assignments(
                     forced_schedule[current_round])
                 if not assignments:
                     # The recorded round ran only jobs this replay has
@@ -2030,7 +2068,8 @@ class Scheduler:
         os.makedirs(timeline_dir, exist_ok=True)
         for int_id in sorted(self._job_timelines):
             path = os.path.join(timeline_dir, f"job_id={int_id}.log")
-            with open(path, "w") as f:
+            # Telemetry dump, not durable state: a torn log costs nothing.
+            with open(path, "w") as f:  # swtpu-check: ignore[durability]
                 f.write("\n".join(self._job_timelines[int_id]) + "\n")
 
     def get_cluster_utilization(self):

@@ -165,14 +165,16 @@ def plan_schedule(jobs, round_index: int, future_nrounds: int,
     arm of the fallback chain produced the schedule and the solver's
     achieved quality (status / MIP gap / wall time)."""
     import time as _time
-    _t0 = _time.monotonic()
+    # Solve wall time is telemetry riding a journaled SolveStats record:
+    # replay reads the journaled outcome, never re-times the solve.
+    _t0 = _time.monotonic()  # swtpu-check: ignore[determinism]
 
     def _record(path, res=None, ftf_infeasible=False):
         if stats_out is not None:
             gap = getattr(res, "mip_gap", None) if res is not None else None
             stats_out.append(SolveStats(
                 round_index=round_index, njobs=len(jobs), path=path,
-                wall_s=round(_time.monotonic() - _t0, 3),
+                wall_s=round(_time.monotonic() - _t0, 3),  # swtpu-check: ignore[determinism]
                 status=getattr(res, "status", None) if res is not None
                 else None,
                 mip_gap=None if gap is None else float(gap),

@@ -7,7 +7,7 @@ constraint matrices. This replaces the reference's cvxpy/ECOS/Gurobi stack
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence
+from typing import List, Optional
 
 import numpy as np
 from scipy.optimize import linprog

@@ -30,6 +30,30 @@ REFERENCE_DIR = "/root/reference/scheduler"
 
 
 @pytest.fixture(autouse=True)
+def _lock_sanitizer(request, monkeypatch):
+    """Runtime concurrency sanitizer (analysis/sanitizer.py) for every
+    `runtime`/`recovery`/`faults`-marked test: schedulers constructed
+    during the test get instrumented locks (SWTPU_SANITIZE=1), and the
+    test FAILS at teardown on any lock-order cycle or @requires_lock
+    unowned-access report — so a concurrency regression in the round
+    pipeline is named, not flaked around."""
+    marked = any(request.node.get_closest_marker(m)
+                 for m in ("runtime", "recovery", "faults"))
+    if not marked:
+        yield
+        return
+    from shockwave_tpu.analysis import sanitizer
+    monkeypatch.setenv("SWTPU_SANITIZE", "1")
+    sanitizer.monitor().reset()
+    yield
+    report = sanitizer.monitor().report()
+    sanitizer.monitor().reset()
+    assert not report["violations"], (
+        "concurrency sanitizer reports for this test:\n  "
+        + "\n  ".join(str(v) for v in report["violations"]))
+
+
+@pytest.fixture(autouse=True)
 def _hang_guard(request):
     """Per-test wall-clock guard for tests marked @pytest.mark.timeout(N).
 

@@ -1,0 +1,18 @@
+"""determinism negative fixture: wall clock + unseeded RNGs (lines
+marked SEEDED); seeded RNG construction must NOT be reported."""
+import random
+import time
+
+import numpy as np
+
+
+def decide(jobs, seed):
+    rng = random.Random(seed)  # seeded: not a finding
+    now = time.time()  # SEEDED: wall clock
+    jitter = random.random()  # SEEDED: unseeded module-level RNG
+    noise = np.random.rand()  # SEEDED: unseeded numpy RNG
+    ok = np.random.RandomState(seed)  # seeded: not a finding
+    kw_ok = np.random.RandomState(seed=seed)  # keyword-seeded: not a finding
+    entropy = random.Random(None)  # SEEDED: None seeds from OS entropy
+    return (rng.random() + now + jitter + noise + ok.rand()
+            + kw_ok.rand() + entropy.random())

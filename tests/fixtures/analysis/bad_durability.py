@@ -1,0 +1,15 @@
+"""durability negative fixture: a raw write-mode open of state and a
+bare os.replace (lines marked SEEDED)."""
+import json
+import os
+
+
+def save_state(path, state):
+    with open(path + ".tmp", "w") as f:  # SEEDED: raw write-mode open
+        json.dump(state, f)
+    os.replace(path + ".tmp", path)  # SEEDED: rename outside durable_io
+
+
+def load_state(path):
+    with open(path) as f:  # read-mode: not a finding
+        return json.load(f)

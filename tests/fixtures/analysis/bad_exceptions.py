@@ -1,0 +1,23 @@
+"""exception-hygiene negative fixture: a bare except and a silent
+broad handler (lines marked SEEDED); logged/narrow handlers must NOT
+be reported."""
+import logging
+
+
+def run(task):
+    try:
+        task()
+    except:  # SEEDED: bare except  # noqa: E722
+        pass
+    try:
+        task()
+    except Exception:  # SEEDED: silently swallowed
+        pass
+    try:
+        task()
+    except Exception:
+        logging.exception("task failed")  # logged: not a finding
+    try:
+        task()
+    except OSError:  # narrow type: not a finding
+        pass

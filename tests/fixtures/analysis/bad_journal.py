@@ -1,0 +1,17 @@
+"""journal-coverage negative fixture: one emit without a handler, one
+handler without an emit (lines marked SEEDED)."""
+
+
+class BrokenJournaling:
+    def _emit(self, etype, **data):
+        pass
+
+    def mutate(self):
+        self._emit("ghost_event", x=1)  # SEEDED: no _replay_ghost_event
+        self._emit("covered_event", y=2)
+
+    def _replay_covered_event(self, data):
+        pass
+
+    def _replay_orphan_event(self, data):  # SEEDED: nothing emits it
+        pass

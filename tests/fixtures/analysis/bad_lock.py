@@ -1,0 +1,33 @@
+"""lock-discipline negative fixture: one seeded violation.
+
+`poke_unlocked` reads a protected field with no lock held (line marked
+SEEDED below); every other method demonstrates the sanctioned shapes
+(with-block, @requires_lock, __init__) and must NOT be reported.
+"""
+import threading
+
+from shockwave_tpu.core.locking import requires_lock
+
+
+class BrokenScheduler:
+    _LOCK_PROTECTED = frozenset({"state"})
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self.state = {}  # constructor: exempt
+
+    def poke_unlocked(self):
+        return self.state.get("x")  # SEEDED VIOLATION
+
+    def poke_locked(self):
+        with self._lock:
+            return self.state.get("x")
+
+    def poke_cv(self):
+        with self._cv:
+            self.state["x"] = 1
+
+    @requires_lock
+    def poke_annotated(self):
+        return len(self.state)

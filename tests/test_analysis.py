@@ -1,0 +1,270 @@
+"""Tier-1 gate for swtpu-check (the static analysis suite) and unit
+tests for the runtime concurrency sanitizer.
+
+- The shipped tree must be analyzer-clean (exit 0): this is the CI
+  gate that stops the invariants from rotting.
+- Every pass has a fixture-based negative test proving it reports its
+  seeded violation at the right file:line — and nothing else. The
+  fixtures mark each seeded line with the string "SEEDED", so the
+  expected line numbers are read from the fixture itself rather than
+  hard-coded.
+- The sanitizer tests prove the lock-order-cycle and unowned-access
+  detectors fire on synthetic inversions and stay quiet on clean
+  nesting (the loopback/recovery tests then run under it for real via
+  the conftest fixture).
+"""
+import os
+import shutil
+import subprocess
+import sys
+import threading
+
+from shockwave_tpu.analysis import __main__ as cli
+from shockwave_tpu.analysis import passes, sanitizer
+from shockwave_tpu.analysis.core import RepoIndex, SourceFile
+from shockwave_tpu.core.locking import requires_lock
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
+
+
+def fixture_index(*names):
+    files = []
+    for name in names:
+        path = os.path.join(FIXTURES, name)
+        with open(path) as f:
+            files.append(SourceFile(path, name, f.read()))
+    return RepoIndex(files, FIXTURES)
+
+
+def seeded_lines(name):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return {i for i, line in enumerate(f.read().splitlines(), start=1)
+                if "# SEEDED" in line}
+
+
+def assert_exactly_seeded(findings, name, pass_id):
+    """Each seeded line reported once, nothing else reported."""
+    assert {f.pass_id for f in findings} <= {pass_id}
+    assert {f.path for f in findings} <= {name}
+    got = sorted(f.line for f in findings)
+    assert got == sorted(seeded_lines(name)), (
+        f"expected findings exactly at {sorted(seeded_lines(name))}, "
+        f"got {[str(f) for f in findings]}")
+
+
+class TestRepoIsClean:
+    """The shipped tree passes its own analyzer — the tier-1 invariant
+    gate."""
+
+    def test_all_passes_clean(self):
+        findings = cli.run(root=REPO)
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_cli_exits_zero(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.analysis",
+             "--root", REPO],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "0 finding(s)" in out.stdout
+
+    def test_cli_lists_all_five_passes(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.analysis", "--list"],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0
+        for pass_id in ("lock-discipline", "journal-coverage",
+                        "durability", "determinism", "exception-hygiene"):
+            assert pass_id in out.stdout
+
+
+class TestNegativeFixtures:
+    """Each pass catches its seeded violation at the right file:line."""
+
+    def test_lock_discipline(self):
+        findings = passes.check_lock_discipline(
+            fixture_index("bad_lock.py"))
+        assert_exactly_seeded(findings, "bad_lock.py", "lock-discipline")
+
+    def test_journal_coverage(self):
+        findings = passes.check_journal_coverage(
+            fixture_index("bad_journal.py"))
+        assert_exactly_seeded(findings, "bad_journal.py",
+                              "journal-coverage")
+
+    def test_durability(self):
+        findings = passes.check_durability(
+            fixture_index("bad_durability.py"),
+            state_globs=("bad_durability.py",), allow_globs=())
+        assert_exactly_seeded(findings, "bad_durability.py", "durability")
+
+    def test_determinism(self):
+        findings = passes.check_determinism(
+            fixture_index("bad_determinism.py"),
+            scope_globs=("bad_determinism.py",), allow_globs=())
+        assert_exactly_seeded(findings, "bad_determinism.py",
+                              "determinism")
+
+    def test_exception_hygiene(self):
+        findings = passes.check_exception_hygiene(
+            fixture_index("bad_exceptions.py"))
+        assert_exactly_seeded(findings, "bad_exceptions.py",
+                              "exception-hygiene")
+
+    def test_cli_exits_one_on_violations(self, tmp_path):
+        """End-to-end exit-1 proof: a copy of a broken fixture placed
+        where the default scan looks is reported with file:line and
+        fails the run."""
+        pkg = tmp_path / "shockwave_tpu"
+        pkg.mkdir()
+        shutil.copy(os.path.join(FIXTURES, "bad_exceptions.py"),
+                    pkg / "bad_exceptions.py")
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.analysis",
+             "--root", str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 1, out.stdout + out.stderr
+        for line in seeded_lines("bad_exceptions.py"):
+            assert f"shockwave_tpu/bad_exceptions.py:{line}:" in out.stdout
+
+    def test_inline_suppression_is_honored(self, tmp_path):
+        """A swtpu-check: ignore[pass-id] comment on the offending line
+        suppresses exactly that pass."""
+        src = ("def f(t):\n"
+               "    try:\n"
+               "        t()\n"
+               "    except Exception:  # swtpu-check: ignore[exception-hygiene]\n"
+               "        pass\n")
+        path = tmp_path / "mod.py"
+        path.write_text(src)
+        idx = RepoIndex([SourceFile(str(path), "mod.py", src)],
+                        str(tmp_path))
+        assert passes.check_exception_hygiene(idx) == []
+
+
+class TestSanitizer:
+    """Synthetic proofs that the runtime detectors fire (and stay quiet
+    on clean patterns)."""
+
+    def setup_method(self):
+        sanitizer.monitor().reset()
+
+    def teardown_method(self):
+        sanitizer.monitor().reset()
+
+    def _locks(self):
+        return (sanitizer.SanitizedLock(threading.RLock(), "sanitytest.A"),
+                sanitizer.SanitizedLock(threading.RLock(), "sanitytest.B"))
+
+    def test_lock_order_inversion_fires(self):
+        a, b = self._locks()
+        with a:
+            with b:
+                pass
+
+        def inverted():
+            with b:
+                with a:
+                    pass
+
+        t = threading.Thread(target=inverted)
+        t.start()
+        t.join()
+        violations = sanitizer.monitor().report()["violations"]
+        assert any(v.kind == "lock-order-cycle" for v in violations), (
+            violations)
+
+    def test_consistent_order_is_clean(self):
+        a, b = self._locks()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        report = sanitizer.monitor().report()
+        assert report["violations"] == []
+        assert report["order_edges"].get("sanitytest.A") == ["sanitytest.B"]
+
+    def test_reentrant_hold_counts_once_and_reports_hold_time(self):
+        a, _ = self._locks()
+        with a:
+            with a:  # re-entrant: no self-edge, one hold
+                pass
+        report = sanitizer.monitor().report()
+        assert report["violations"] == []
+        assert report["max_hold_s"].get("sanitytest.A", -1) >= 0.0
+        assert not a._is_owned()
+
+    def test_condition_wait_keeps_bookkeeping_balanced(self):
+        lock = sanitizer.SanitizedLock(threading.RLock(), "sanitytest.CV")
+        cv = threading.Condition(lock)
+        with cv:
+            cv.wait(timeout=0.01)  # full release + reacquire inside
+            assert lock._is_owned()
+        assert not lock._is_owned()
+        assert sanitizer.monitor().report()["violations"] == []
+
+    def test_unowned_access_fires_and_owned_access_does_not(self,
+                                                            monkeypatch):
+        monkeypatch.setenv("SWTPU_SANITIZE", "1")
+
+        class Thing:
+            def __init__(self):
+                self._lock = sanitizer.SanitizedLock(
+                    threading.RLock(), "sanitytest.Thing")
+
+            @requires_lock
+            def poke(self):
+                return 1
+
+        thing = Thing()
+        with thing._lock:
+            thing.poke()  # owned: clean
+        assert sanitizer.monitor().report()["violations"] == []
+        thing.poke()  # unowned: fires
+        violations = sanitizer.monitor().report()["violations"]
+        assert [v.kind for v in violations] == ["unowned-access"]
+        assert "Thing.poke" in violations[0].message
+
+    def test_requires_lock_is_free_when_disabled(self, monkeypatch):
+        monkeypatch.delenv("SWTPU_SANITIZE", raising=False)
+
+        class Thing:
+            _lock = None
+
+            @requires_lock
+            def poke(self):
+                return 41
+
+        assert Thing().poke() == 41
+        assert sanitizer.monitor().report()["violations"] == []
+
+    def test_physical_scheduler_lock_is_instrumented_when_enabled(
+            self, monkeypatch, tmp_path):
+        """The scheduler's own lock rides the wrapper under the env
+        knob — the wiring the conftest fixture relies on."""
+        monkeypatch.setenv("SWTPU_SANITIZE", "1")
+        import socket
+
+        from shockwave_tpu.sched.physical import PhysicalScheduler
+        from shockwave_tpu.sched.scheduler import SchedulerConfig
+        from shockwave_tpu.solver.registry import get_policy
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(REPO, "data",
+                                          "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=2.0,
+                                   heartbeat_interval_s=0.0),
+            port=port)
+        try:
+            assert isinstance(sched._lock, sanitizer.SanitizedLock)
+            with sched._cv:
+                assert sched._lock._is_owned()
+        finally:
+            sched.shutdown()
+        report = sanitizer.monitor().report()
+        assert report["violations"] == [], report["violations"]
+        assert "PhysicalScheduler._lock" in report["max_hold_s"]

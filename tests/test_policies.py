@@ -1,5 +1,4 @@
 """Policy layer tests on tiny, hand-checkable clusters."""
-import numpy as np
 import pytest
 
 from shockwave_tpu.core.job import JobIdPair

@@ -247,6 +247,7 @@ class StubWorkerDaemon:
         self.server.stop(grace=0)
 
 
+@pytest.mark.runtime
 class TestPhysicalRounds:
     def test_end_to_end_rounds(self):
         sched_port = free_port()
@@ -501,6 +502,7 @@ class TestWorkerRegisterRetry:
                 data_dir=str(tmp_path), checkpoint_dir=str(tmp_path / "ckpt"))
 
 
+@pytest.mark.runtime
 class TestExtendedLeaseLiveness:
     def _make_sched(self):
         port = free_port()
@@ -549,6 +551,7 @@ class TestExtendedLeaseLiveness:
             sched._server.stop(grace=0)
 
 
+@pytest.mark.runtime
 class TestFirstInitGrace:
     """A freshly dispatched job that has not yet reached its first RPC is
     re-armed, not killed: cold dispatch through a relayed TPU can wait
@@ -627,6 +630,7 @@ class TestFirstInitGrace:
             sched._server.stop(grace=0)
 
 
+@pytest.mark.runtime
 class TestInitLeaseFloor:
     """A job whose startup (imports + jit) eats most of the round must not
     be granted a sliver lease that expires before one step — that
@@ -715,6 +719,7 @@ class TestInitLeaseFloor:
             sched._server.stop(grace=0)
 
 
+@pytest.mark.runtime
 class TestIteratorLogTimelines:
     def test_done_logs_reach_job_timeline(self):
         """Iterator logs shipped in Done RPCs must land in the job's
@@ -1461,6 +1466,7 @@ class TestDoneBlackholeSynthesis:
             sched._server.stop(grace=0)
 
 
+@pytest.mark.runtime
 class TestWorkerRejoinIdempotent:
     """A daemon re-registering from a known endpoint gets its ORIGINAL
     chip ids back (idempotent RegisterWorker), whether it was declared
@@ -1531,6 +1537,7 @@ class TestWorkerRejoinIdempotent:
             sched._server.stop(grace=0)
 
 
+@pytest.mark.runtime
 class TestKillRearmCap:
     """Satellite: the heartbeat-freshness kill deferral is capped per
     dispatch, so a job that keeps renewing its lease but never honors
