@@ -31,6 +31,7 @@ from shockwave_tpu.core.metrics import unfair_fraction
 from shockwave_tpu.core.oracle import read_throughputs
 from shockwave_tpu.core.profiles import build_profiles
 from shockwave_tpu.core.trace import parse_trace
+from shockwave_tpu.obs.logconfig import LEVELS, setup_logging
 from shockwave_tpu.sched import SchedulerConfig
 from shockwave_tpu.sched.physical import PhysicalScheduler
 from shockwave_tpu.solver import get_policy
@@ -105,6 +106,18 @@ def main():
                    dest="snapshot_interval", type=int, default=10,
                    help="rounds between compacting snapshots (bounds "
                         "journal size; 0 disables snapshots)")
+    # Observability knobs (see README "Observability").
+    p.add_argument("--obs_port", type=int, default=None,
+                   help="serve Prometheus /metrics + JSON /healthz on "
+                        "this port (0 = ephemeral; default disabled)")
+    p.add_argument("--obs_trace", default=None, metavar="TRACE_JSON",
+                   help="export the round-pipeline span trace as "
+                        "Chrome-trace JSON at shutdown (view in "
+                        "Perfetto, or summarize with python -m "
+                        "shockwave_tpu.obs.report)")
+    p.add_argument("--log_level", default=None, choices=LEVELS,
+                   help="root log level (default: warning, or info "
+                        "with --verbose)")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args()
     if args.resume and not args.state_dir:
@@ -113,9 +126,8 @@ def main():
         p.error("--resume requires --state_dir (the directory of the "
                 "crashed run's journal)")
 
-    logging.basicConfig(
-        level=logging.INFO if args.verbose else logging.WARNING,
-        format="%(name)s:%(levelname)s %(message)s")
+    setup_logging(args.log_level
+                  or ("info" if args.verbose else "warning"))
 
     jobs, arrival_times = parse_trace(args.trace)
     throughputs = read_throughputs(args.throughputs)
@@ -147,7 +159,14 @@ def main():
             worker_probe_failures=args.probe_failures,
             kill_wait_s=args.kill_wait,
             state_dir=args.state_dir, resume=args.resume,
-            snapshot_interval_rounds=args.snapshot_interval))
+            snapshot_interval_rounds=args.snapshot_interval,
+            obs_port=args.obs_port, obs_trace_path=args.obs_trace))
+    if sched.obs_port is not None:
+        # stderr, unconditionally: with --obs_port 0 this line is the
+        # ONLY place the resolved ephemeral port appears, and the
+        # default warning log level would swallow an info record.
+        print(f"obs endpoint: http://0.0.0.0:{sched.obs_port}/metrics "
+              "and /healthz", file=sys.stderr, flush=True)
 
     # Crash recovery: rebase on the ORIGINAL run's start time (journaled
     # as run_meta) so arrival offsets and makespan stay on one clock,

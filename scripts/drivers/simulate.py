@@ -13,7 +13,6 @@ Example:
 """
 import argparse
 import json
-import logging
 import os
 import pickle
 import sys
@@ -25,6 +24,7 @@ from shockwave_tpu.core.metrics import (parse_cluster_spec,
 from shockwave_tpu.core.oracle import read_throughputs
 from shockwave_tpu.core.profiles import build_profiles
 from shockwave_tpu.core.trace import parse_trace
+from shockwave_tpu.obs.logconfig import LEVELS, setup_logging
 from shockwave_tpu.sched import Scheduler, SchedulerConfig
 from shockwave_tpu.solver import get_policy
 
@@ -56,12 +56,17 @@ def main():
                    help="fidelity analysis: override each job's oracle "
                         "rate with its mean measured throughput from this "
                         "physical pickle's throughput_timeline")
+    p.add_argument("--obs_trace", default=None, metavar="TRACE_JSON",
+                   help="export the simulator's span trace (virtual-"
+                        "clock timeline) as Chrome-trace JSON at exit")
+    p.add_argument("--log_level", default=None, choices=LEVELS,
+                   help="root log level (default: warning, or info "
+                        "with --verbose)")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args()
 
-    logging.basicConfig(
-        level=logging.INFO if args.verbose else logging.WARNING,
-        format="%(name)s:%(levelname)s %(message)s")
+    setup_logging(args.log_level
+                  or ("info" if args.verbose else "warning"))
 
     jobs, arrival_times = parse_trace(args.trace)
     throughputs = read_throughputs(args.throughputs)
@@ -160,6 +165,8 @@ def main():
     if args.output:
         with open(args.output, "wb") as f:
             pickle.dump(metrics, f)
+    if args.obs_trace:
+        sched.obs.tracer.export_chrome_trace(args.obs_trace)
 
 
 if __name__ == "__main__":

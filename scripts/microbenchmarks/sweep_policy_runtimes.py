@@ -16,11 +16,13 @@ import json
 import os
 import random
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 from shockwave_tpu.core.job import JobIdPair
+from shockwave_tpu.obs import Observability
+from shockwave_tpu.obs import names as obs_names
+from shockwave_tpu.obs.clock import perf_clock
 from shockwave_tpu.solver import get_policy
 
 # Multi-worker-type throughput spread: jobs run fastest on the first
@@ -44,38 +46,50 @@ def synth_state(num_jobs, cluster_size, num_worker_types, seed):
     return throughputs, scale_factors, priorities, cluster
 
 
-def time_policy(policy_name, num_jobs, cluster_size, num_worker_types,
-                trials, seed):
-    times = []
+def time_policy(obs, policy_name, num_jobs, cluster_size,
+                num_worker_types, trials, seed):
+    """Times each solve through the obs pipeline (one span + one
+    histogram observation per trial) instead of an ad-hoc clock loop,
+    so the sweep's numbers come from the same instrumentation the
+    scheduler itself reports."""
+    # Slice the tracer buffer from here: a repeated sweep combination
+    # (e.g. --num_jobs 64 64) must not fold earlier calls' spans into
+    # this call's min/mean.
+    events_before = len(obs.tracer.events())
     for t in range(trials):
         throughputs, sfs, prios, cluster = synth_state(
             num_jobs, cluster_size, num_worker_types, seed + t)
         policy = get_policy(policy_name, seed=seed + t)
-        start = time.time()
         times_since_start = {j: 0.0 for j in sfs}
         num_steps = {j: 10000 for j in sfs}
-        if policy_name == "proportional":
-            policy.get_allocation(throughputs, cluster)
-        elif policy_name in ("isolated", "isolated_plus", "gandiva",
-                             "gandiva_fair") \
-                or policy_name.startswith("fifo"):
-            policy.get_allocation(throughputs, sfs, cluster)
-        elif policy_name.startswith("allox"):
-            policy.get_allocation(throughputs, sfs, times_since_start,
-                                  num_steps, [], cluster)
-        elif policy_name.startswith("min_total_duration"):
-            policy.get_allocation(throughputs, sfs, num_steps, cluster)
-        elif policy_name == "max_sum_throughput_perf":
-            policy.get_allocation(throughputs, sfs, cluster)
-        elif policy_name.startswith("max_sum_throughput"):
-            policy.get_allocation(throughputs, sfs, cluster,
-                                  num_steps_remaining=num_steps)
-        elif policy_name.startswith("finish_time_fairness"):
-            policy.get_allocation(throughputs, sfs, prios,
-                                  times_since_start, num_steps, cluster)
-        else:
-            policy.get_allocation(throughputs, sfs, prios, cluster)
-        times.append(time.time() - start)
+        with obs.span(obs_names.SPAN_POLICY_SOLVE, policy=policy_name,
+                      num_jobs=num_jobs, cluster_size=cluster_size,
+                      trial=t), \
+                obs.timed(obs_names.POLICY_SOLVE_SECONDS,
+                          policy=policy_name):
+            if policy_name == "proportional":
+                policy.get_allocation(throughputs, cluster)
+            elif policy_name in ("isolated", "isolated_plus", "gandiva",
+                                 "gandiva_fair") \
+                    or policy_name.startswith("fifo"):
+                policy.get_allocation(throughputs, sfs, cluster)
+            elif policy_name.startswith("allox"):
+                policy.get_allocation(throughputs, sfs, times_since_start,
+                                      num_steps, [], cluster)
+            elif policy_name.startswith("min_total_duration"):
+                policy.get_allocation(throughputs, sfs, num_steps, cluster)
+            elif policy_name == "max_sum_throughput_perf":
+                policy.get_allocation(throughputs, sfs, cluster)
+            elif policy_name.startswith("max_sum_throughput"):
+                policy.get_allocation(throughputs, sfs, cluster,
+                                      num_steps_remaining=num_steps)
+            elif policy_name.startswith("finish_time_fairness"):
+                policy.get_allocation(throughputs, sfs, prios,
+                                      times_since_start, num_steps, cluster)
+            else:
+                policy.get_allocation(throughputs, sfs, prios, cluster)
+    times = [e["dur"] for e in obs.tracer.events()[events_before:]
+             if e["name"] == obs_names.SPAN_POLICY_SOLVE]
     return min(times), sum(times) / len(times)
 
 
@@ -91,13 +105,23 @@ def main():
     p.add_argument("--trials", type=int, default=3)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--output", default=None, help="JSON results path")
+    p.add_argument("--trace_out", default=None, metavar="TRACE_JSON",
+                   help="export the per-trial solve spans as "
+                        "Chrome-trace JSON")
+    p.add_argument("--metrics_out", default=None, metavar="PROM_TXT",
+                   help="dump the solve-time histograms as Prometheus "
+                        "text")
     args = p.parse_args()
 
+    # Force-enabled local bundle on the perf clock: a benchmark must
+    # measure even when the ambient SWTPU_OBS=0 disables production
+    # telemetry.
+    obs = Observability(clock=perf_clock, enabled=True)
     results = []
     for policy_name in args.policies:
         for n in args.num_jobs:
             for c in args.cluster_sizes:
-                best, mean = time_policy(policy_name, n, c,
+                best, mean = time_policy(obs, policy_name, n, c,
                                          args.num_worker_types,
                                          args.trials, args.seed)
                 row = {"policy": policy_name, "num_jobs": n,
@@ -108,6 +132,11 @@ def main():
     if args.output:
         with open(args.output, "w") as f:
             json.dump(results, f, indent=1)
+    if args.trace_out:
+        obs.tracer.export_chrome_trace(args.trace_out)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            f.write(obs.registry.render_prometheus())
 
 
 if __name__ == "__main__":

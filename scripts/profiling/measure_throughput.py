@@ -34,6 +34,9 @@ import optax
 from shockwave_tpu.core.constants import DEFAULT_BS, oracle_job_type
 from shockwave_tpu.core.timing import marginal_step_time
 from shockwave_tpu.models import data
+from shockwave_tpu.obs import Observability
+from shockwave_tpu.obs import names as obs_names
+from shockwave_tpu.obs.clock import perf_clock
 from shockwave_tpu.parallel.mesh import data_parallel_sharding, make_mesh
 
 # (family -> profiled batch sizes) mirrors the job template table
@@ -273,7 +276,17 @@ def main():
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--merge", action="store_true",
                    help="merge into an existing oracle file")
+    p.add_argument("--trace_out", default=None, metavar="TRACE_JSON",
+                   help="export one span per profiled row (with the "
+                        "measured rate in its args) as Chrome-trace "
+                        "JSON — the profiling session's timeline")
     args = p.parse_args()
+
+    # Per-row wall time rides the obs pipeline (spans + the
+    # swtpu_profile_measure_seconds histogram); the device timing
+    # itself stays core/timing.marginal_step_time — the only honest
+    # primitive under async dispatch and relayed chips.
+    obs = Observability(clock=perf_clock, enabled=True)
 
     oracle = {}
     if args.merge and os.path.exists(args.output):
@@ -303,7 +316,11 @@ def main():
                 continue
             if family in DEFAULT_BS and sf > 1:
                 continue  # A3C / CycleGAN are single-chip families
-            tput = measure(family, bs, sf, args.steps, args.warmup)
+            with obs.span(obs_names.SPAN_PROFILE_MEASURE, family=family,
+                          bs=bs, sf=sf), \
+                    obs.timed(obs_names.PROFILE_MEASURE_SECONDS,
+                              family=family):
+                tput = measure(family, bs, sf, args.steps, args.warmup)
             if tput is None:
                 continue
             job_type = oracle_job_type(family, bs)
@@ -317,9 +334,14 @@ def main():
         dt_cache = {}
         for (fam_a, bs_a), (fam_b, bs_b) in \
                 itertools.combinations_with_replacement(rows, 2):
-            rate_a, rate_b, _, _ = measure_pair(
-                fam_a, bs_a, fam_b, bs_b, args.steps, args.warmup,
-                dt_cache=dt_cache)
+            with obs.span(obs_names.SPAN_PROFILE_MEASURE,
+                          family=f"{fam_a}+{fam_b}", bs=[bs_a, bs_b],
+                          sf=1), \
+                    obs.timed(obs_names.PROFILE_MEASURE_SECONDS,
+                              family=f"{fam_a}+{fam_b}"):
+                rate_a, rate_b, _, _ = measure_pair(
+                    fam_a, bs_a, fam_b, bs_b, args.steps, args.warmup,
+                    dt_cache=dt_cache)
             key_a = str((oracle_job_type(fam_a, bs_a), 1))
             key_b = str((oracle_job_type(fam_b, bs_b), 1))
             table.setdefault(key_a, {})[key_b] = [round(rate_a, 4),
@@ -333,6 +355,9 @@ def main():
     with open(args.output, "w") as f:
         json.dump(oracle, f, indent=1, sort_keys=True)
     print(f"wrote {args.output}")
+    if args.trace_out:
+        obs.tracer.export_chrome_trace(args.trace_out)
+        print(f"wrote {args.trace_out}")
 
 
 if __name__ == "__main__":

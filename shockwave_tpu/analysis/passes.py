@@ -1,4 +1,4 @@
-"""The five swtpu-check passes.
+"""The six swtpu-check passes.
 
 Each pass is a function ``check_<name>(index, ...) -> List[Finding]``
 taking a ``core.RepoIndex``; scope/allowlist arguments default to the
@@ -18,6 +18,9 @@ a deliberately-broken module.
 |                    | shockwave modules                                     |
 | exception-hygiene  | no bare ``except:``, no silent ``except Exception:    |
 |                    | pass``                                                |
+| obs-discipline     | metric/span names are attribute references into       |
+|                    | ``obs/names.py`` (no inline literals); ``obs/`` takes |
+|                    | its clock by injection (``obs/clock.py`` only)        |
 """
 from __future__ import annotations
 
@@ -399,6 +402,79 @@ def check_exception_hygiene(index: RepoIndex) -> List[Finding]:
 
 
 # ----------------------------------------------------------------------
+# 6. obs-discipline
+# ----------------------------------------------------------------------
+
+#: The central name catalog — the only module where metric/span name
+#: string literals may appear.
+OBS_NAMES_GLOBS = ("shockwave_tpu/obs/names.py",)
+#: The observability package itself, which must take its clock by
+#: injection...
+OBS_MODULE_GLOBS = ("shockwave_tpu/obs/*.py",)
+#: ...except the one designated clock adapter.
+OBS_CLOCK_ALLOW_GLOBS = ("shockwave_tpu/obs/clock.py",)
+#: Instrument entry points whose first argument is a metric/span name.
+OBS_INSTRUMENT_METHODS = frozenset({
+    "inc", "observe", "set_gauge", "timed", "span", "phase",
+})
+
+
+def check_obs_discipline(index: RepoIndex,
+                         names_globs: Iterable[str] = OBS_NAMES_GLOBS,
+                         obs_globs: Iterable[str] = OBS_MODULE_GLOBS,
+                         clock_allow_globs: Iterable[str]
+                         = OBS_CLOCK_ALLOW_GLOBS) -> List[Finding]:
+    """Two halves of the instrumentation discipline: (1) every
+    metric/span name at an instrument call site (``.inc(...)``,
+    ``.observe(...)``, ``.span(...)``, ...) must be an attribute
+    reference into ``obs/names.py``, never an inline string literal —
+    ad-hoc names fork the catalog and rot silently out of the docs and
+    dashboards; (2) ``obs/`` itself reads no wall clock outside the
+    designated adapter ``obs/clock.py`` — the injected clock is what
+    lets the same instrumentation run under the simulator's virtual
+    clock without breaking bit-identical replay."""
+    pass_id = "obs-discipline"
+    findings: List[Finding] = []
+    for src in index.files:
+        if not src.matches(names_globs):
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                name = call_name(node)
+                if "." not in name:
+                    continue
+                method = name.rsplit(".", 1)[-1]
+                if method not in OBS_INSTRUMENT_METHODS:
+                    continue
+                literal = const_str(node.args[0])
+                if literal is None:
+                    continue
+                f = finding(src, node, pass_id,
+                            f"inline metric/span name {literal!r} at an "
+                            f"instrument call site (.{method}): declare "
+                            "it in obs/names.py and reference it as an "
+                            "attribute")
+                if f is not None:
+                    findings.append(f)
+        if src.matches(obs_globs) and not src.matches(clock_allow_globs):
+            aliases = _alias_map(src.tree)
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = _canonical(call_name(node), aliases)
+                if cname in _CLOCK_CALLS:
+                    f = finding(src, node, pass_id,
+                                f"wall-clock call {cname}() inside obs/ "
+                                "outside the clock adapter: obs "
+                                "components take their clock by "
+                                "injection (obs/clock.py is the only "
+                                "sanctioned reader)")
+                    if f is not None:
+                        findings.append(f)
+    return findings
+
+
+# ----------------------------------------------------------------------
 # Registry
 # ----------------------------------------------------------------------
 
@@ -408,4 +484,5 @@ ALL_PASSES = {
     "durability": check_durability,
     "determinism": check_determinism,
     "exception-hygiene": check_exception_hygiene,
+    "obs-discipline": check_obs_discipline,
 }

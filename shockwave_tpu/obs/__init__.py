@@ -1,0 +1,135 @@
+"""Observability subsystem: metrics registry, span tracing, health
+endpoint.
+
+Three parts (see README "Observability"):
+
+- `registry` — thread-safe labeled counters/gauges/histograms, declared
+  centrally in `obs/names.py` (the `obs-discipline` swtpu-check pass
+  bans inline name literals at call sites).
+- `tracing` — nestable spans exported as Chrome-trace JSON; summarize
+  with ``python -m shockwave_tpu.obs.report``.
+- `exporter` — HTTP ``/metrics`` (Prometheus text) + ``/healthz``
+  (JSON), opt-in via ``SchedulerConfig.obs_port``.
+
+`Observability` bundles a registry and tracer around one injected clock:
+the scheduler constructs it with ``get_current_timestamp`` so the same
+instrumentation runs on the simulator's virtual clock (bit-identical
+replay preserved — recording never feeds back into scheduling) and on
+wall clocks in the physical control plane.
+
+``SWTPU_OBS=0`` disables recording globally (used by the overhead
+measurements in EXPERIMENTS.md and the obs-on/off determinism tests).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from contextlib import contextmanager
+from typing import Optional
+
+from . import names
+from .clock import Clock, wall_clock
+from .registry import MetricsRegistry
+from .tracing import Tracer
+
+__all__ = ["Observability", "MetricsRegistry", "Tracer", "names",
+           "get_observability", "dump_all", "obs_enabled_by_env"]
+
+#: Every live Observability, for end-of-session artifact dumps
+#: (dump_all). Weak so short-lived test schedulers don't accumulate.
+_ALL_OBS: "weakref.WeakSet[Observability]" = weakref.WeakSet()
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL: Optional["Observability"] = None
+
+
+def obs_enabled_by_env() -> bool:
+    return os.environ.get("SWTPU_OBS", "1") not in ("", "0")
+
+
+class Observability:
+    """One registry + one tracer sharing an injected clock, plus the
+    convenience delegates instrumentation call sites use."""
+
+    def __init__(self, clock: Optional[Clock] = None,
+                 enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = obs_enabled_by_env()
+        self.enabled = enabled
+        self.clock: Clock = clock or wall_clock
+        self.registry = MetricsRegistry(clock=self.clock, enabled=enabled)
+        self.tracer = Tracer(clock=self.clock, enabled=enabled)
+        self._bind_delegates()
+        _ALL_OBS.add(self)
+
+    def _bind_delegates(self) -> None:
+        # Hot-path aliases bound as instance attributes: the simulator
+        # calls inc/observe thousands of times per wall second, and the
+        # extra delegate frame + kwargs repack measurably shows up
+        # (EXPERIMENTS.md "Observability overhead").
+        self.inc = self.registry.inc
+        self.set_gauge = self.registry.set_gauge
+        self.observe = self.registry.observe
+        self.timed = self.registry.timed
+        self.span = self.tracer.span
+
+    def __getstate__(self):
+        # The bound delegates would pickle whole object subgraphs;
+        # rebind from the unpickled registry/tracer instead.
+        state = dict(self.__dict__)
+        for name in ("inc", "set_gauge", "observe", "timed", "span"):
+            state.pop(name, None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._bind_delegates()
+        _ALL_OBS.add(self)
+
+    @contextmanager
+    def phase(self, name: str, **args):
+        """A round-pipeline phase: one trace span plus one observation
+        into the shared phase histogram, so the trace timeline and the
+        /metrics scrape tell the same story."""
+        if not self.enabled:
+            yield
+            return
+        t0 = self.clock()
+        with self.tracer.span(name, **args):
+            try:
+                yield
+            finally:
+                self.registry.observe(names.ROUND_PHASE_SECONDS,
+                                      max(self.clock() - t0, 0.0),
+                                      phase=name)
+
+
+def get_observability() -> Observability:
+    """Process-global wall-clock Observability (job-side runtime and
+    components without a scheduler-injected handle)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = Observability()
+        return _GLOBAL
+
+
+def dump_all(directory: str) -> list:
+    """Write every live Observability's metrics (.prom) and trace
+    (.json) into `directory`; returns the written paths. Used by the CI
+    failure-artifact hook (tests/conftest.py) so a distributed-test
+    flake arrives with a timeline attached."""
+    os.makedirs(directory, exist_ok=True)
+    written = []
+    for i, obs in enumerate(sorted(_ALL_OBS, key=id)):
+        text = obs.registry.render_prometheus()
+        if text.strip():
+            path = os.path.join(directory, f"metrics-{i}.prom")
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(text)
+            written.append(path)
+        if obs.tracer.events():
+            path = os.path.join(directory, f"trace-{i}.json")
+            obs.tracer.export_chrome_trace(path)
+            written.append(path)
+    return written

@@ -1,0 +1,32 @@
+"""Render the metric catalog from `obs/names.py` as a Markdown table.
+
+    python -m shockwave_tpu.obs.catalog
+
+README's "Observability" section embeds this output; a test keeps the
+two in sync (every declared metric name must appear in README.md), so
+the catalog cannot silently drift from the docs.
+"""
+from __future__ import annotations
+
+import sys
+
+from . import names
+
+
+def catalog_markdown() -> str:
+    rows = [("metric", "kind", "labels", "description"),
+            ("---", "---", "---", "---")]
+    for spec in names.all_metric_specs():
+        rows.append((f"`{spec.name}`", spec.kind,
+                     ", ".join(spec.labels) or "—",
+                     spec.help.replace("\n", " ")))
+    return "\n".join("| " + " | ".join(r) + " |" for r in rows)
+
+
+def main(argv=None) -> int:
+    print(catalog_markdown())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

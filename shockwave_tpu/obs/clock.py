@@ -1,0 +1,28 @@
+"""The designated clock adapter for the observability subsystem.
+
+Every obs component (registry, tracer) takes its clock by injection so
+the same instrumentation runs under the simulator's virtual clock
+(`Scheduler.get_current_timestamp`) without perturbing bit-identical
+replay, and under wall clocks in the physical control plane. This module
+is the ONLY place in `shockwave_tpu/obs/` allowed to read a real clock —
+enforced statically by the `obs-discipline` swtpu-check pass.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+#: A clock is any zero-arg callable returning seconds as a float.
+Clock = Callable[[], float]
+
+
+def wall_clock() -> float:
+    """Wall-clock seconds (epoch). The default clock for physical-mode
+    components; timestamps line up with log lines and journal records."""
+    return time.time()
+
+
+def perf_clock() -> float:
+    """High-resolution monotonic seconds, for benchmark harnesses where
+    durations matter and absolute timestamps do not."""
+    return time.perf_counter()

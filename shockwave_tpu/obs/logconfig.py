@@ -1,0 +1,29 @@
+"""One-place logging configuration for every driver and daemon.
+
+Before this module, each driver called ``logging.basicConfig`` with its
+own ad-hoc format (or not at all — the ``shockwave_tpu.sched`` logger
+was effectively unconfigured under pytest and library embedding).
+``setup_logging`` is the single entry point: drivers expose
+``--log_level`` and pass it here.
+"""
+from __future__ import annotations
+
+import logging
+
+#: Level names accepted by --log_level flags.
+LEVELS = ("debug", "info", "warning", "error", "critical")
+
+DEFAULT_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+
+
+def setup_logging(level: str = "warning", fmt: str = DEFAULT_FORMAT) -> int:
+    """Configure the root logger (handlers replaced, so repeated calls
+    and prior ad-hoc basicConfig setups don't stack). Returns the
+    numeric level. Raises ValueError on an unknown level name."""
+    name = str(level).strip().lower()
+    if name not in LEVELS:
+        raise ValueError(
+            f"unknown log level {level!r} (choose from {', '.join(LEVELS)})")
+    numeric = getattr(logging, name.upper())
+    logging.basicConfig(level=numeric, format=fmt, force=True)
+    return numeric

@@ -1,0 +1,216 @@
+"""Central catalog of every metric and span name in the tree.
+
+Instrumentation call sites reference these as attributes
+(``names.DISPATCHES_TOTAL``), never as inline string literals — enforced
+by the `obs-discipline` swtpu-check pass — so the catalog below IS the
+complete instrumentation surface: grep-able, documentable (README's
+"Observability" table is generated from it by
+``python -m shockwave_tpu.obs.catalog``), and safe to rename in one
+place.
+
+Conventions: counters end in ``_total``; durations are seconds in
+histograms named ``*_seconds``; label sets are small and bounded (no
+job ids — per-job detail lives in spans and job timelines, not in
+metric cardinality).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    """Declaration of one metric: pure data, no behavior. The registry
+    instantiates storage from it on first use."""
+    name: str
+    kind: str                      # "counter" | "gauge" | "histogram"
+    help: str
+    labels: Tuple[str, ...] = ()
+    buckets: Tuple[float, ...] = ()   # histograms only
+
+    def __post_init__(self):
+        if self.kind not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"unknown metric kind {self.kind!r}")
+        if self.kind == "histogram" and not self.buckets:
+            raise ValueError(f"{self.name}: histogram needs buckets")
+
+
+#: Default latency buckets: sub-millisecond RPCs through multi-minute
+#: MILP solves.
+LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                   120.0, 300.0)
+
+
+def _counter(name, help, labels=()):
+    return MetricSpec(name, "counter", help, tuple(labels))
+
+
+def _gauge(name, help, labels=()):
+    return MetricSpec(name, "gauge", help, tuple(labels))
+
+
+def _histogram(name, help, labels=(), buckets=LATENCY_BUCKETS):
+    return MetricSpec(name, "histogram", help, tuple(labels),
+                      tuple(buckets))
+
+
+# ----------------------------------------------------------------------
+# Scheduling core (shared by the simulator and the physical scheduler;
+# in simulation these run on the virtual clock)
+# ----------------------------------------------------------------------
+
+MICROTASKS_TOTAL = _counter(
+    "swtpu_microtasks_total",
+    "Round micro-task aggregates completed, by outcome", ("outcome",))
+JOBS_SUBMITTED_TOTAL = _counter(
+    "swtpu_jobs_submitted_total", "Jobs admitted into the scheduler")
+JOBS_COMPLETED_TOTAL = _counter(
+    "swtpu_jobs_completed_total", "Jobs completed (or dropped at the "
+    "failure cap) and removed from the active set")
+ALLOCATION_SOLVE_SECONDS = _histogram(
+    "swtpu_allocation_solve_seconds",
+    "Policy allocation solve time (LP policies; virtual-clock zero in "
+    "simulation)", ("policy",))
+CURRENT_ROUND = _gauge(
+    "swtpu_current_round", "Completed scheduling rounds")
+ACTIVE_JOBS = _gauge(
+    "swtpu_active_jobs", "Jobs currently in the active set")
+LIVE_WORKERS = _gauge(
+    "swtpu_live_workers", "Schedulable (non-dead) worker chips")
+
+# ----------------------------------------------------------------------
+# Physical round pipeline (sched/physical.py)
+# ----------------------------------------------------------------------
+
+ROUND_PHASE_SECONDS = _histogram(
+    "swtpu_round_phase_seconds",
+    "Wall time of each round-pipeline phase (also exported as trace "
+    "spans)", ("phase",))
+DISPATCH_LATENCY_SECONDS = _histogram(
+    "swtpu_dispatch_latency_seconds",
+    "RunJob dispatch RPC latency to a worker daemon")
+DISPATCHES_TOTAL = _counter(
+    "swtpu_dispatches_total",
+    "RunJob dispatch RPCs, by outcome (ok / unavailable / rejected)",
+    ("outcome",))
+JOBS_REQUEUED_TOTAL = _counter(
+    "swtpu_jobs_requeued_total",
+    "Jobs failed-in-round and requeued, by reason (worker_dead / "
+    "dispatch_rejected / recovery)", ("reason",))
+JOB_KILLS_TOTAL = _counter(
+    "swtpu_job_kills_total", "Unresponsive-job kills issued by the "
+    "round-end watchdog")
+WORKER_RETIREMENTS_TOTAL = _counter(
+    "swtpu_worker_retirements_total",
+    "Worker hosts declared dead and retired from capacity")
+WORKER_REVIVALS_TOTAL = _counter(
+    "swtpu_worker_revivals_total",
+    "Worker hosts revived (rejoin or partition heal)")
+WORKER_HEARTBEAT_AGE_SECONDS = _gauge(
+    "swtpu_worker_heartbeat_age_seconds",
+    "Seconds since each live worker host was last heard from "
+    "(refreshed by the liveness monitor)", ("host",))
+
+# ----------------------------------------------------------------------
+# Solver / shockwave planner
+# ----------------------------------------------------------------------
+
+MILP_SOLVE_SECONDS = _histogram(
+    "swtpu_milp_solve_seconds",
+    "Shockwave EG-MILP plan_schedule wall time, by fallback path",
+    ("path",))
+SOLVER_FALLBACKS_TOTAL = _counter(
+    "swtpu_solver_fallbacks_total",
+    "MILP solves that fell off the primary (ftf) arm, by landing path "
+    "(relaxed / relaxed_retry / greedy)", ("path",))
+
+# ----------------------------------------------------------------------
+# Durability (sched/journal.py)
+# ----------------------------------------------------------------------
+
+JOURNAL_APPEND_SECONDS = _histogram(
+    "swtpu_journal_append_seconds",
+    "Write-ahead journal append latency (sync=true includes the fsync "
+    "barrier)", ("sync",))
+JOURNAL_RECORDS_TOTAL = _counter(
+    "swtpu_journal_records_total", "Journal records appended", ("sync",))
+JOURNAL_BYTES_TOTAL = _counter(
+    "swtpu_journal_bytes_total", "Framed journal bytes written")
+JOURNAL_COMPACTIONS_TOTAL = _counter(
+    "swtpu_journal_compactions_total",
+    "Compacting snapshots written (journal segments rotated)")
+SNAPSHOT_WRITE_SECONDS = _histogram(
+    "swtpu_snapshot_write_seconds",
+    "Durable snapshot write time (pickle + fsync + rename)")
+JOURNAL_LAG_EVENTS = _gauge(
+    "swtpu_journal_lag_events",
+    "Journal events appended since the last compacting snapshot")
+
+# ----------------------------------------------------------------------
+# RPC resilience (runtime/resilience.py)
+# ----------------------------------------------------------------------
+
+RPC_RETRIES_TOTAL = _counter(
+    "swtpu_rpc_retries_total",
+    "Transport-level RPC attempt failures that were retried, by method",
+    ("method",))
+RPC_UNAVAILABLE_TOTAL = _counter(
+    "swtpu_rpc_unavailable_total",
+    "RPCs that exhausted their whole retry budget, by method",
+    ("method",))
+BREAKER_TRANSITIONS_TOTAL = _counter(
+    "swtpu_breaker_transitions_total",
+    "Circuit-breaker state transitions, by destination state "
+    "(open / half_open / closed)", ("to",))
+
+# ----------------------------------------------------------------------
+# Worker daemon (runtime/worker.py)
+# ----------------------------------------------------------------------
+
+WORKER_JOBS_DISPATCHED_TOTAL = _counter(
+    "swtpu_worker_jobs_dispatched_total",
+    "RunJob dispatches received by this worker daemon")
+WORKER_LAST_DISPATCH_TIMESTAMP = _gauge(
+    "swtpu_worker_last_dispatch_timestamp_seconds",
+    "Wall-clock time of the last RunJob this daemon received")
+
+# ----------------------------------------------------------------------
+# Offline harnesses (scripts/microbenchmarks, scripts/profiling)
+# ----------------------------------------------------------------------
+
+POLICY_SOLVE_SECONDS = _histogram(
+    "swtpu_policy_solve_seconds",
+    "Microbenchmark get_allocation wall time", ("policy",))
+PROFILE_MEASURE_SECONDS = _histogram(
+    "swtpu_profile_measure_seconds",
+    "Throughput-profiler measurement wall time per oracle row "
+    "(device timing itself stays core/timing.marginal_step_time)",
+    ("family",))
+
+# ----------------------------------------------------------------------
+# Span names (tracer). The round-pipeline phases are the rows of
+# `python -m shockwave_tpu.obs.report`.
+# ----------------------------------------------------------------------
+
+SPAN_BEGIN_ROUND = "begin_round"
+SPAN_SOLVE = "solve"
+SPAN_DISPATCH = "dispatch"
+SPAN_WAIT = "wait"
+SPAN_END_ROUND = "end_round"
+SPAN_JOURNAL_FSYNC = "journal-fsync"
+SPAN_SNAPSHOT = "snapshot"
+SPAN_ESTIMATE_REFRESH = "estimate-refresh"
+SPAN_PLANNER_SOLVE = "planner-solve"
+SPAN_POLICY_SOLVE = "policy-solve"
+SPAN_PROFILE_MEASURE = "profile-measure"
+
+#: Default phase columns of the report table, in pipeline order.
+REPORT_PHASES = (SPAN_SOLVE, SPAN_DISPATCH, SPAN_WAIT, SPAN_END_ROUND,
+                 SPAN_JOURNAL_FSYNC)
+
+
+def all_metric_specs():
+    """Every MetricSpec declared in this module, in declaration order."""
+    return [v for v in globals().values() if isinstance(v, MetricSpec)]

@@ -1,0 +1,138 @@
+"""Per-round phase summary of an exported Chrome trace.
+
+    python -m shockwave_tpu.obs.report <trace.json> [--phases a,b,...]
+
+Reads a trace written by ``Tracer.export_chrome_trace`` and prints one
+row per round with the total seconds spent in each pipeline phase
+(solve / dispatch / wait / end_round / journal-fsync by default), plus
+per-phase totals, counts and means. Spans that carry no ``round`` arg
+(journal fsyncs fire from RPC threads that don't know the round) are
+attributed to the round whose [start, next-start) window contains their
+start timestamp; spans outside every window land in the "-" row.
+"""
+from __future__ import annotations
+
+import argparse
+import bisect
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from . import names
+
+
+def load_spans(path: str) -> List[dict]:
+    """Chrome-trace events -> [{name, ts, dur, args}] in seconds."""
+    with open(path, encoding="utf-8") as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    spans = []
+    for e in events:
+        if e.get("ph", "X") != "X":
+            continue
+        spans.append({"name": e.get("name", "?"),
+                      "ts": float(e.get("ts", 0.0)) / 1e6,
+                      "dur": float(e.get("dur", 0.0)) / 1e6,
+                      "args": e.get("args", {}) or {}})
+    return spans
+
+
+def _round_windows(spans: List[dict]) -> Tuple[List[float], List[int]]:
+    """Sorted (start_ts, round) windows from spans that carry a round
+    arg, for attributing round-less spans by timestamp."""
+    starts: Dict[int, float] = {}
+    for s in spans:
+        rnd = s["args"].get("round")
+        if isinstance(rnd, int):
+            starts[rnd] = min(starts.get(rnd, s["ts"]), s["ts"])
+    ordered = sorted(starts.items(), key=lambda kv: kv[1])
+    return [ts for _, ts in ordered], [rnd for rnd, _ in ordered]
+
+
+def assign_round(span: dict, window_ts: List[float],
+                 window_round: List[int]) -> Optional[int]:
+    rnd = span["args"].get("round")
+    if isinstance(rnd, int):
+        return rnd
+    if not window_ts:
+        return None
+    i = bisect.bisect_right(window_ts, span["ts"]) - 1
+    return window_round[i] if i >= 0 else None
+
+
+def phase_table(spans: List[dict],
+                phases: Tuple[str, ...] = names.REPORT_PHASES):
+    """-> (sorted round keys, {round: {phase: seconds}},
+    {phase: (count, total)})."""
+    window_ts, window_round = _round_windows(spans)
+    per_round: Dict[object, Dict[str, float]] = defaultdict(
+        lambda: defaultdict(float))
+    totals: Dict[str, List[float]] = {p: [0, 0.0] for p in phases}
+    for s in spans:
+        if s["name"] not in phases:
+            continue
+        rnd = assign_round(s, window_ts, window_round)
+        key = rnd if rnd is not None else "-"
+        per_round[key][s["name"]] += s["dur"]
+        totals[s["name"]][0] += 1
+        totals[s["name"]][1] += s["dur"]
+    rounds = sorted((k for k in per_round if k != "-"),
+                    key=lambda r: int(r))
+    if "-" in per_round:
+        rounds.append("-")
+    return rounds, per_round, {p: (int(c), t)
+                               for p, (c, t) in totals.items()}
+
+
+def render(spans: List[dict],
+           phases: Tuple[str, ...] = names.REPORT_PHASES) -> str:
+    rounds, per_round, totals = phase_table(spans, phases)
+    header = ["round"] + [p for p in phases] + ["row_total"]
+    widths = [max(len(h), 13) for h in header]
+
+    def fmt_row(cells):
+        return "  ".join(str(c).rjust(w) for c, w in zip(cells, widths))
+
+    lines = [fmt_row(header), fmt_row(["-" * w for w in widths])]
+    for rnd in rounds:
+        row = [per_round[rnd].get(p, 0.0) for p in phases]
+        lines.append(fmt_row([rnd] + [f"{v:.3f}" for v in row]
+                             + [f"{sum(row):.3f}"]))
+    lines.append(fmt_row(["-" * w for w in widths]))
+    total_row = [totals[p][1] for p in phases]
+    lines.append(fmt_row(["total_s"] + [f"{v:.3f}" for v in total_row]
+                         + [f"{sum(total_row):.3f}"]))
+    lines.append(fmt_row(["count"] + [str(totals[p][0]) for p in phases]
+                         + [str(sum(totals[p][0] for p in phases))]))
+    lines.append(fmt_row(
+        ["mean_s"]
+        + [f"{(totals[p][1] / totals[p][0]):.4f}" if totals[p][0]
+           else "-" for p in phases] + [""]))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m shockwave_tpu.obs.report",
+        description=__doc__.splitlines()[0])
+    p.add_argument("trace", help="Chrome-trace JSON exported by the "
+                                 "tracer (--obs_trace / "
+                                 "export_chrome_trace)")
+    p.add_argument("--phases", default=None,
+                   help="comma-separated span names to tabulate "
+                        f"(default: {','.join(names.REPORT_PHASES)})")
+    args = p.parse_args(argv)
+    phases = (tuple(s.strip() for s in args.phases.split(",") if s.strip())
+              if args.phases else names.REPORT_PHASES)
+    spans = load_spans(args.trace)
+    if not spans:
+        print(f"{args.trace}: no spans", file=sys.stderr)
+        return 1
+    print(f"{args.trace}: {len(spans)} spans")
+    print(render(spans, phases))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

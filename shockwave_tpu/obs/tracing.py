@@ -1,0 +1,99 @@
+"""Nestable span tracer with Chrome-trace (Perfetto) JSON export.
+
+Spans are recorded as complete ("ph": "X") events keyed by thread id, so
+nesting falls out of the viewer's per-track stacking — no explicit
+parent bookkeeping. The event buffer is a bounded ring (oldest spans
+drop first) so a long-lived scheduler cannot grow without bound.
+
+The clock is injected (see obs/clock.py): under the simulator's virtual
+clock the trace is laid out in simulated seconds; under wall clocks it
+lines up with logs and journal records. Export is plain
+``json.dump`` — traces are telemetry, not durable state.
+
+View an exported trace in ``chrome://tracing`` / https://ui.perfetto.dev,
+or summarize it with ``python -m shockwave_tpu.obs.report <trace>``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+from contextlib import contextmanager
+from typing import List, Optional
+
+from .clock import Clock, wall_clock
+
+#: Default ring size: a 360 s-round physical run emits ~10 spans/round
+#: plus one per journal fsync; 200k events covers days of rounds.
+DEFAULT_MAX_EVENTS = 200_000
+
+
+class Tracer:
+    def __init__(self, clock: Optional[Clock] = None, enabled: bool = True,
+                 max_events: int = DEFAULT_MAX_EVENTS):
+        self._clock: Clock = clock or wall_clock
+        self._enabled = enabled
+        self._events: "deque[dict]" = deque(maxlen=max_events)
+        from ..analysis.sanitizer import maybe_wrap
+        self._lock = maybe_wrap(threading.Lock(), "Tracer._lock")
+
+    # Rides inside pickled scheduler objects (simulation checkpoints);
+    # locks are recreated on load.
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        from ..analysis.sanitizer import maybe_wrap
+        self._lock = maybe_wrap(threading.Lock(), "Tracer._lock")
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @contextmanager
+    def span(self, name: str, **args):
+        """Record one span covering the block. `args` must be
+        JSON-serializable; they land in the trace event's `args` and are
+        what the report CLI groups by (e.g. ``round=N``)."""
+        if not self._enabled:
+            yield
+            return
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            t1 = self._clock()
+            event = {"name": name, "ts": t0, "dur": max(t1 - t0, 0.0),
+                     "tid": threading.get_ident(), "args": args}
+            with self._lock:
+                self._events.append(event)
+
+    def events(self) -> List[dict]:
+        """Snapshot of recorded spans, oldest first."""
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write the buffer as Chrome-trace JSON; returns `path`."""
+        pid = os.getpid()
+        trace = {
+            "displayTimeUnit": "ms",
+            "traceEvents": [
+                {"name": e["name"], "ph": "X", "cat": "swtpu",
+                 "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6,
+                 "pid": pid, "tid": e["tid"], "args": e["args"]}
+                for e in self.events()],
+        }
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        return path

@@ -35,7 +35,17 @@ from dataclasses import dataclass, replace
 
 import grpc
 
+from ..obs import get_observability
+from ..obs import names as obs_names
+
 logger = logging.getLogger("shockwave_tpu.runtime")
+
+
+def _method_label(method: str) -> str:
+    """Bounded-cardinality metric label for a call site: the RPC name
+    without the peer address (`worker 10.0.0.3:50061/RunJob` ->
+    `RunJob`)."""
+    return method.rsplit("/", 1)[-1]
 
 #: Transport-level failures: the peer may be dead or unreachable. Anything
 #: else (INVALID_ARGUMENT, INTERNAL, ...) proves the peer answered.
@@ -161,16 +171,28 @@ class CircuitBreaker:
             if self._half_open_probe_inflight:
                 return False
             self._half_open_probe_inflight = True
-            return True
+        get_observability().inc(obs_names.BREAKER_TRANSITIONS_TOTAL,
+                                to="half_open")
+        return True
 
     def record_success(self) -> None:
         with self._lock:
+            was_open = self._opened_at is not None
             self._consecutive_failures = 0
             self._opened_at = None
             self._half_open_probe_inflight = False
+        if was_open:
+            get_observability().inc(obs_names.BREAKER_TRANSITIONS_TOTAL,
+                                    to="closed")
 
     def record_failure(self) -> None:
         with self._lock:
+            was_open = self._opened_at is not None
+            # A failure with a probe in flight is a failed half-open
+            # probe re-opening the circuit — a real open transition that
+            # must be counted, or a breaker flapping open N times reads
+            # as one open event.
+            probe_failed = self._half_open_probe_inflight
             self._consecutive_failures += 1
             self._half_open_probe_inflight = False
             if (self._consecutive_failures >= self.failure_threshold
@@ -178,6 +200,11 @@ class CircuitBreaker:
                 # A half-open probe failure re-opens immediately; restart
                 # the reset window from now.
                 self._opened_at = self._clock()
+            opened = (self._opened_at is not None
+                      and (not was_open or probe_failed))
+        if opened:
+            get_observability().inc(obs_names.BREAKER_TRANSITIONS_TOTAL,
+                                    to="open")
 
 
 def call_with_retry(callable_, request, *, method: str,
@@ -205,6 +232,8 @@ def call_with_retry(callable_, request, *, method: str,
             raise CircuitOpenError(method)
         remaining = policy.total_budget_s - (clock() - start)
         if attempt > 0 and remaining <= 0:
+            get_observability().inc(obs_names.RPC_UNAVAILABLE_TOTAL,
+                                    method=_method_label(method))
             raise RpcUnavailableError(method, attempt, last_code)
         deadline = (min(policy.deadline_s, remaining) if attempt > 0
                     else policy.deadline_s)
@@ -226,7 +255,11 @@ def call_with_retry(callable_, request, *, method: str,
             backoff = policy.backoff(attempt - 1)
             out_of_budget = (clock() - start) + backoff >= policy.total_budget_s
             if attempt >= policy.max_attempts or out_of_budget:
+                get_observability().inc(obs_names.RPC_UNAVAILABLE_TOTAL,
+                                        method=_method_label(method))
                 raise RpcUnavailableError(method, attempt, last_code) from e
+            get_observability().inc(obs_names.RPC_RETRIES_TOTAL,
+                                    method=_method_label(method))
             logger.debug("%s attempt %d failed (%s); retrying in %.2fs",
                          method, attempt, last_code, backoff)
             sleep(backoff)

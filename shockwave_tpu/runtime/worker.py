@@ -17,6 +17,9 @@ import time
 
 import grpc
 
+from ..obs import get_observability
+from ..obs import names as obs_names
+from ..obs.logconfig import LEVELS, setup_logging
 from . import resilience
 from .clients import WorkerToSchedulerClient
 from .dispatcher import Dispatcher
@@ -39,8 +42,18 @@ def detect_num_chips() -> int:
 class WorkerDaemon:
     def __init__(self, worker_type: str, sched_addr: str, sched_port: int,
                  worker_port: int, num_chips: int, run_dirs: dict,
-                 data_dir: str, checkpoint_dir: str):
+                 data_dir: str, checkpoint_dir: str,
+                 obs_port: int = None):
         self._shutdown_event = threading.Event()
+        self._obs = get_observability()
+        self._obs_server = None
+        if obs_port is not None:
+            from ..obs.exporter import ObsHttpServer
+            self._obs_server = ObsHttpServer(
+                self._obs.registry, health_fn=self._obs_health,
+                port=obs_port).start()
+        self._worker_type = worker_type
+        self._last_dispatch_time = 0.0
         self._rpc_client = WorkerToSchedulerClient(sched_addr, sched_port)
 
         callbacks = {
@@ -90,7 +103,23 @@ class WorkerDaemon:
             sched_port=sched_port, run_dirs=run_dirs, data_dir=data_dir,
             checkpoint_dir=checkpoint_dir)
 
+    def _obs_health(self) -> dict:
+        return {
+            "worker_type": self._worker_type,
+            "worker_ids": list(getattr(self, "_worker_ids", [])),
+            "last_dispatch_age_s": round(
+                time.time() - self._last_dispatch_time, 3)
+            if self._last_dispatch_time else None,
+        }
+
     def _run_job(self, jobs, worker_id, round_id):
+        # Worker-side dispatch heartbeat: a daemon that stops receiving
+        # RunJobs (partitioned, or starved by the scheduler) shows up as
+        # a growing age on this stamp.
+        self._last_dispatch_time = time.time()
+        self._obs.inc(obs_names.WORKER_JOBS_DISPATCHED_TOTAL)
+        self._obs.set_gauge(obs_names.WORKER_LAST_DISPATCH_TIMESTAMP,
+                            self._last_dispatch_time)
         self._dispatcher.dispatch_jobs(jobs, worker_id, round_id)
 
     def _kill_job(self, job_id):
@@ -106,6 +135,8 @@ class WorkerDaemon:
     def join(self):
         self._shutdown_event.wait()
         self._server.stop(grace=1)
+        if self._obs_server is not None:
+            self._obs_server.stop()
 
 
 def main(argv=None):
@@ -121,10 +152,13 @@ def main(argv=None):
     p.add_argument("--gns_run_dir", default="shockwave_tpu/workloads")
     p.add_argument("--data_dir", default=None)
     p.add_argument("--checkpoint_dir", default="/tmp/swtpu_checkpoints")
+    p.add_argument("--obs_port", type=int, default=None,
+                   help="serve /metrics + /healthz for this daemon "
+                        "(0 = ephemeral port; default disabled)")
+    p.add_argument("--log_level", default="info", choices=LEVELS)
     args = p.parse_args(argv)
 
-    logging.basicConfig(level=logging.INFO,
-                        format="%(name)s:%(levelname)s %(message)s")
+    setup_logging(args.log_level)
 
     num_chips = args.num_chips if args.num_chips is not None else detect_num_chips()
     if num_chips <= 0:
@@ -137,7 +171,8 @@ def main(argv=None):
         run_dirs={"static": args.static_run_dir,
                   "accordion": args.accordion_run_dir,
                   "gns": args.gns_run_dir},
-        data_dir=args.data_dir, checkpoint_dir=args.checkpoint_dir)
+        data_dir=args.data_dir, checkpoint_dir=args.checkpoint_dir,
+        obs_port=args.obs_port)
     signal.signal(signal.SIGINT, lambda s, f: daemon._shutdown())
     daemon.join()
 

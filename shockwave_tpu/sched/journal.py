@@ -151,12 +151,13 @@ class JournalWriter:
             os.fsync(self._f.fileno())
             _fsync_dir(os.path.dirname(path) or ".")
 
-    def append(self, record: dict, sync: bool = True) -> None:
-        """Append one framed record. With `sync` (the default) the
-        record is fsync'd before return — required for write-ahead
-        semantics. Audit-only records may pass sync=False: they ride to
-        disk with the next durable append, and losing the tail of them
-        in a crash costs nothing (their replay handlers are no-ops)."""
+    def append(self, record: dict, sync: bool = True) -> int:
+        """Append one framed record; returns the framed byte count.
+        With `sync` (the default) the record is fsync'd before return —
+        required for write-ahead semantics. Audit-only records may pass
+        sync=False: they ride to disk with the next durable append, and
+        losing the tail of them in a crash costs nothing (their replay
+        handlers are no-ops)."""
         payload = json.dumps(record, separators=(",", ":"),
                              default=str).encode("utf-8")
         self._f.write(_FRAME.pack(len(payload), zlib.crc32(payload)))
@@ -164,6 +165,7 @@ class JournalWriter:
         self._f.flush()
         if sync:
             os.fsync(self._f.fileno())
+        return _FRAME.size + len(payload)
 
     def close(self) -> None:
         try:
@@ -311,10 +313,20 @@ class DurabilityLayer:
     the round loop all emit)."""
 
     def __init__(self, state_dir: str,
-                 snapshot_interval_rounds: int = 10):
+                 snapshot_interval_rounds: int = 10, obs=None):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
         self.snapshot_interval_rounds = snapshot_interval_rounds
+        # Observability: append/fsync latency histograms, byte counters
+        # and journal-fsync spans. The owning scheduler injects its
+        # bundle; standalone layers (tests, fsck) fall back to the
+        # process-global wall-clock one. The registry/tracer locks are
+        # leaves, so recording under this layer's lock (itself under
+        # the scheduler lock) cannot invert any watched order.
+        if obs is None:
+            from ..obs import get_observability
+            obs = get_observability()
+        self._obs = obs
         # Instrumented under SWTPU_SANITIZE=1: the scheduler emits under
         # its own lock, so scheduler-lock -> journal-lock is an order
         # edge the sanitizer watches for inversions.
@@ -349,6 +361,7 @@ class DurabilityLayer:
     def record(self, etype: str, data: dict, sync: bool = True) -> int:
         """Append one event; returns its sequence number. sync=False is
         for audit-only events (see JournalWriter.append)."""
+        from ..obs import names as obs_names
         with self._lock:
             if self._writer is None:
                 raise JournalError("durability layer is closed")
@@ -357,11 +370,33 @@ class DurabilityLayer:
             # and burning the number would leave a permanent gap that
             # fsck_journal flags as lost events.
             seq = self._seq + 1
-            self._writer.append({"seq": seq, "type": etype,
-                                 "t": time.time(), "data": data},
-                                sync=sync)
+            rec = {"seq": seq, "type": etype, "t": time.time(),
+                   "data": data}
+            t0 = self._obs.clock()
+            if sync:
+                with self._obs.span(obs_names.SPAN_JOURNAL_FSYNC,
+                                    etype=etype):
+                    nbytes = self._writer.append(rec, sync=True)
+            else:
+                nbytes = self._writer.append(rec, sync=False)
+            sync_label = "true" if sync else "false"
+            self._obs.observe(obs_names.JOURNAL_APPEND_SECONDS,
+                              max(self._obs.clock() - t0, 0.0),
+                              sync=sync_label)
+            self._obs.inc(obs_names.JOURNAL_RECORDS_TOTAL,
+                          sync=sync_label)
+            self._obs.inc(obs_names.JOURNAL_BYTES_TOTAL, amount=nbytes)
             self._seq = seq
+            self._obs.set_gauge(obs_names.JOURNAL_LAG_EVENTS,
+                                self._seq - self._snap_seq)
             return seq
+
+    @property
+    def pending_events(self) -> int:
+        """Events appended since the last compacting snapshot (the
+        journal lag the /healthz endpoint reports)."""
+        with self._lock:
+            return self._seq - self._snap_seq
 
     def snapshot(self, payload: dict) -> None:
         """Write a compacting snapshot covering every event so far, then
@@ -374,15 +409,20 @@ class DurabilityLayer:
         `last_seq`, so a crash between the snapshot rename and the
         segment deletion only leaves already-covered (skipped) events
         behind."""
+        from ..obs import names as obs_names
         with self._lock:
             if self._writer is None:
                 raise JournalError("durability layer is closed")
             payload = dict(payload)
             payload["last_seq"] = self._seq
             payload.setdefault("time", time.time())
-            write_snapshot(self.state_dir, payload)
+            with self._obs.span(obs_names.SPAN_SNAPSHOT, seq=self._seq), \
+                    self._obs.timed(obs_names.SNAPSHOT_WRITE_SECONDS):
+                write_snapshot(self.state_dir, payload)
+            self._obs.inc(obs_names.JOURNAL_COMPACTIONS_TOTAL)
             prev_horizon = self._snap_seq  # the snapshot now at .prev
             self._snap_seq = self._seq
+            self._obs.set_gauge(obs_names.JOURNAL_LAG_EVENTS, 0)
             old_segment = self._writer.path
             self._writer.close()
             for path in list_segments(self.state_dir):

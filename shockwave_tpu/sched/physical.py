@@ -30,6 +30,7 @@ import grpc
 from ..analysis.sanitizer import maybe_wrap
 from ..core.job import JobIdPair
 from ..core.locking import requires_lock
+from ..obs import names as obs_names
 from ..runtime.resilience import RpcUnavailableError
 from .journal import encode_job_key
 from .scheduler import DEADLINE_SLACK, INFINITY, Scheduler, SchedulerConfig
@@ -166,10 +167,20 @@ class PhysicalScheduler(Scheduler):
                         "a fresh directory")
                 self._durability = DurabilityLayer(
                     self._config.state_dir,
-                    self._config.snapshot_interval_rounds)
+                    self._config.snapshot_interval_rounds,
+                    obs=self._obs)
                 self.attach_durability(self._durability)
                 if self._recovered:
                     self._requeue_inflight_after_recovery()
+
+        # Health endpoint (opt-in): /metrics + /healthz. Started before
+        # the gRPC server so a hung bring-up is already observable.
+        self._obs_server = None
+        if self._config.obs_port is not None:
+            from ..obs.exporter import ObsHttpServer
+            self._obs_server = ObsHttpServer(
+                self._obs.registry, health_fn=self.obs_health,
+                port=self._config.obs_port).start()
 
         from ..runtime.servers import serve_scheduler
         self._server = serve_scheduler(port, {
@@ -196,6 +207,60 @@ class PhysicalScheduler(Scheduler):
 
     def get_current_timestamp(self) -> float:
         return time.time()
+
+    # ------------------------------------------------------------------
+    # Observability
+    # ------------------------------------------------------------------
+
+    @property
+    def obs_port(self) -> Optional[int]:
+        """Bound port of the /metrics + /healthz endpoint (resolves an
+        ephemeral obs_port=0), or None when the endpoint is disabled."""
+        return self._obs_server.port if self._obs_server else None
+
+    def obs_health(self) -> dict:
+        """Live scheduler health for /healthz: round/job/worker state,
+        per-host breaker states, journal lag. Runs on the exporter's
+        request thread with a BOUNDED lock acquire: the scheduler lock
+        is legitimately held for tens of seconds across a dead worker's
+        dispatch retry budget, and a health probe that blocks behind it
+        would time out exactly when the cluster is degraded — the
+        moment it exists to report. On contention it answers "busy"
+        instead of hanging."""
+        if not self._lock.acquire(timeout=2.0):
+            return {"status": "busy",
+                    "detail": "scheduler lock contended >2s (round "
+                              "pipeline may be stalled on a worker "
+                              "RPC); metrics remain live on /metrics"}
+        try:
+            payload = self._obs_health_locked()
+        finally:
+            self._lock.release()
+        if self._durability is not None:
+            payload["journal"] = {
+                "last_seq": self._durability.last_seq,
+                "lag_events": self._durability.pending_events,
+            }
+        return payload
+
+    @requires_lock
+    def _obs_health_locked(self) -> dict:
+        breakers = {}
+        for (addr, port), host in self._worker_hosts.items():
+            breaker = getattr(host.get("client"), "breaker", None)
+            if breaker is not None:
+                breakers[f"{addr}:{port}"] = breaker.state
+        return {
+            "round": self.rounds.num_completed_rounds,
+            "active_jobs": len(self.acct.jobs),
+            "completed_jobs": len(self._completed_jobs),
+            "live_workers": len(self.workers.worker_ids),
+            "dead_workers": len(self.workers.dead),
+            "worker_hosts": len(self._worker_hosts),
+            "breakers": breakers,
+            "recovered": self._recovered,
+            "uptime_s": round(time.time() - self._start_time, 3),
+        }
 
     def add_job(self, job, timestamp=None):
         with self._cv:
@@ -299,6 +364,9 @@ class PhysicalScheduler(Scheduler):
                     self._job_timelines[int_id].append(
                         f"t={now:.1f} RECOVERY_REQUEUE scheduler "
                         "restarted mid-round; lease abandoned")
+        if requeued:
+            self._obs.inc(obs_names.JOBS_REQUEUED_TOTAL,
+                          amount=len(requeued), reason="recovery")
         self.rounds.abandon_in_flight()
         self._redispatch_assignments = collections.OrderedDict()
         self._running_jobs.clear()
@@ -400,6 +468,7 @@ class PhysicalScheduler(Scheduler):
         from ..runtime.clients import SchedulerToWorkerClient
         self._close_host_client(host)
         client = SchedulerToWorkerClient(*key)
+        self._obs.inc(obs_names.WORKER_REVIVALS_TOTAL)
         self.revive_workers(ids, host["worker_type"])
         now = self.get_current_timestamp()
         for worker_id in ids:
@@ -456,6 +525,9 @@ class PhysicalScheduler(Scheduler):
                     dead.append((key, host))
                     continue
                 last = max(self.workers.last_seen.get(i, 0.0) for i in live)
+                self._obs.set_gauge(obs_names.WORKER_HEARTBEAT_AGE_SECONDS,
+                                    max(now - last, 0.0),
+                                    host=f"{key[0]}:{key[1]}")
                 if now - last >= self._config.worker_timeout_s:
                     stale.append((key, host))
         for key, host in stale + dead:
@@ -511,6 +583,12 @@ class PhysicalScheduler(Scheduler):
             return
         self.log.warning("worker %s:%d presumed dead; retiring chips %s",
                          key[0], key[1], dead_ids)
+        self._obs.inc(obs_names.WORKER_RETIREMENTS_TOTAL)
+        # Drop the host's heartbeat-age series: a frozen last-known age
+        # would keep a dead host looking live on /metrics.
+        self._obs.registry.remove_series(
+            obs_names.WORKER_HEARTBEAT_AGE_SECONDS,
+            host=f"{key[0]}:{key[1]}")
         self.deregister_workers(dead_ids)
         for worker_id in dead_ids:
             self._remove_available_worker(worker_id)
@@ -581,6 +659,8 @@ class PhysicalScheduler(Scheduler):
             self.log.warning(
                 "[Worker failed] job %s lost chips %s mid-round; marking "
                 "failed-in-round and requeuing", job_id, missing)
+            self._obs.inc(obs_names.JOBS_REQUEUED_TOTAL,
+                          reason="worker_dead")
             # The crash is the WORKER's fault: pre-decrement the job's
             # failure counter so the synthesized zero-step micro-task's
             # +1 nets to zero and worker churn can never drop an
@@ -1042,10 +1122,15 @@ class PhysicalScheduler(Scheduler):
                     needs_data_dir=job.needs_data_dir,
                     num_steps_arg=job.num_steps_arg,
                     num_steps=job.total_steps, mode=job.mode))
+            dispatch_start = self._obs.clock()
             try:
                 self._worker_connections[worker_id].run_job(
                     descriptions, worker_id, round_id)
             except WORKER_RPC_ERRORS as e:
+                self._obs.inc(obs_names.DISPATCHES_TOTAL,
+                              outcome=("unavailable"
+                                       if isinstance(e, RpcUnavailableError)
+                                       else "rejected"))
                 if isinstance(e, RpcUnavailableError):
                     # Graceful degradation: the worker is unreachable
                     # (retry budget exhausted or circuit open). Retire
@@ -1086,6 +1171,9 @@ class PhysicalScheduler(Scheduler):
                         except WORKER_RPC_ERRORS:
                             break  # host unreachable too; probe reaps it
                 return
+            self._obs.observe(obs_names.DISPATCH_LATENCY_SECONDS,
+                              max(self._obs.clock() - dispatch_start, 0.0))
+            self._obs.inc(obs_names.DISPATCHES_TOTAL, outcome="ok")
             if not next_round:
                 self._remove_available_worker(worker_id)
 
@@ -1104,6 +1192,8 @@ class PhysicalScheduler(Scheduler):
         if (job_id not in self.rounds.current_assignments
                 or job_id in self.rounds.completed_in_round):
             return
+        self._obs.inc(obs_names.JOBS_REQUEUED_TOTAL,
+                      reason="dispatch_rejected")
         reported = {u[0] for u in self._in_progress_updates.get(job_id, [])}
         zeros = [0 for _ in job_id.singletons()]
         for worker_id in worker_ids:
@@ -1153,8 +1243,10 @@ class PhysicalScheduler(Scheduler):
             self.rounds.extended_leases = set()
             return
         round_end = self._current_round_start_time + self._time_per_iteration
+        round_id = self.rounds.num_completed_rounds
 
-        self.rounds.next_assignments = self._schedule_jobs_on_workers()
+        with self._obs.phase(obs_names.SPAN_SOLVE, round=round_id):
+            self.rounds.next_assignments = self._schedule_jobs_on_workers()
 
         for job_id in self.rounds.current_assignments:
             if any(m in self.acct.jobs for m in job_id.singletons()):
@@ -1174,14 +1266,18 @@ class PhysicalScheduler(Scheduler):
 
         # list(): a dispatch failure retires the worker's host, which
         # prunes that host's entries from next_assignments.
-        for job_id, worker_ids in list(self.rounds.next_assignments.items()):
-            if job_id not in self.rounds.next_assignments:
-                continue  # pruned by a dead-worker retirement above
-            if not any(m in self.acct.jobs for m in job_id.singletons()):
-                continue
-            if (job_id not in self.rounds.extended_leases
-                    or job_id in self.rounds.completed_in_round):
-                self._try_dispatch_job(job_id, worker_ids, next_round=True)
+        with self._obs.phase(obs_names.SPAN_DISPATCH, round=round_id):
+            for job_id, worker_ids in list(
+                    self.rounds.next_assignments.items()):
+                if job_id not in self.rounds.next_assignments:
+                    continue  # pruned by a dead-worker retirement above
+                if not any(m in self.acct.jobs
+                           for m in job_id.singletons()):
+                    continue
+                if (job_id not in self.rounds.extended_leases
+                        or job_id in self.rounds.completed_in_round):
+                    self._try_dispatch_job(job_id, worker_ids,
+                                           next_round=True)
 
         self._schedule_completion_events(round_end)
 
@@ -1211,15 +1307,25 @@ class PhysicalScheduler(Scheduler):
     @requires_lock
     def _end_round(self):
         """Wait for all scheduled jobs to complete, then roll the round."""
+        round_id = self.rounds.num_completed_rounds
         jobs_to_complete = {
             job_id for job_id in self.rounds.current_assignments
             if any(m in self.acct.jobs for m in job_id.singletons())}
-        while not jobs_to_complete.issubset(self.rounds.completed_in_round):
-            # Bounded wait: completion normally arrives with a notify
-            # (done callback, watchdog, or dead-worker retirement), but
-            # round liveness must not hinge on never missing one.
-            self._cv.wait(timeout=5.0)
+        with self._obs.phase(obs_names.SPAN_WAIT, round=round_id):
+            while not jobs_to_complete.issubset(
+                    self.rounds.completed_in_round):
+                # Bounded wait: completion normally arrives with a
+                # notify (done callback, watchdog, or dead-worker
+                # retirement), but round liveness must not hinge on
+                # never missing one.
+                self._cv.wait(timeout=5.0)
+        with self._obs.phase(obs_names.SPAN_END_ROUND, round=round_id):
+            self._finish_round()
 
+    @requires_lock
+    def _finish_round(self):
+        """Post-wait half of the round roll: free extended-lease chips,
+        reserve next-round chips, sleep out the boundary, advance."""
         for job_id in list(self.rounds.extended_leases):
             if job_id in self.acct.jobs:
                 for worker_id in self.rounds.current_assignments[job_id]:
@@ -1252,6 +1358,7 @@ class PhysicalScheduler(Scheduler):
         self.rounds.next_assignments = None
         self._emit("round_ended", round=self.rounds.num_completed_rounds)
         self._maybe_snapshot()
+        self._obs_update_round_gauges()
         self._cv.notify_all()
         self.log.info("*** END ROUND %d ***", self.rounds.num_completed_rounds - 1)
 
@@ -1313,6 +1420,7 @@ class PhysicalScheduler(Scheduler):
                     "job %s exhausted %d freshness deferrals; killing "
                     "despite recent heartbeat", job_id, rearms)
             self.log.warning("killing unresponsive job %s", job_id)
+            self._obs.inc(obs_names.JOB_KILLS_TOTAL)
             worker_ids = self.rounds.current_assignments[job_id]
             self._kill_rearm_counts.pop(job_id, None)
             servers = set()
@@ -1411,7 +1519,9 @@ class PhysicalScheduler(Scheduler):
         while True:
             with self._cv:
                 final = self._is_final_round()
-                self._begin_round()
+                with self._obs.phase(obs_names.SPAN_BEGIN_ROUND,
+                                     round=self.rounds.num_completed_rounds):
+                    self._begin_round()
             time.sleep(self._time_per_iteration * SCHEDULE_RECOMPUTE_FRACTION)
             with self._cv:
                 self._mid_round()
@@ -1465,6 +1575,15 @@ class PhysicalScheduler(Scheduler):
 
     def shutdown(self):
         self._done_event.set()
+        if self._config.obs_trace_path:
+            try:
+                self._obs.tracer.export_chrome_trace(
+                    self._config.obs_trace_path)
+            except OSError:
+                self.log.exception("obs trace export to %s failed",
+                                   self._config.obs_trace_path)
+        if self._obs_server is not None:
+            self._obs_server.stop()
         # Snapshot the client set under the lock (a re-registration RPC
         # may be rebuilding host channels concurrently), then shut the
         # clients down outside it — each shutdown is a bounded RPC, and

@@ -28,6 +28,8 @@ import numpy as np
 from ..core import constants
 from ..core.job import Job, JobIdPair
 from ..core.oracle import read_oracle
+from ..obs import Observability
+from ..obs import names as obs_names
 from .journal import decode_job_key, encode_job_key
 from .state import JobAccounting, RoundState, WorkerState
 
@@ -143,6 +145,15 @@ class SchedulerConfig:
     # retained interval is the previous snapshot's replay tail). 0
     # disables snapshots (journal grows without bound).
     snapshot_interval_rounds: int = 10
+    # ---- observability (physical mode; see README "Observability") ----
+    # HTTP port serving /metrics (Prometheus text) + /healthz (JSON).
+    # 0 binds an ephemeral port (read PhysicalScheduler.obs_port);
+    # None disables the endpoint entirely.
+    obs_port: Optional[int] = None
+    # Chrome-trace JSON path the span tracer exports to at shutdown
+    # (view in Perfetto, or summarize with
+    # `python -m shockwave_tpu.obs.report`). None skips the export.
+    obs_trace_path: Optional[str] = None
 
 
 class Scheduler:
@@ -162,6 +173,13 @@ class Scheduler:
 
         self._current_timestamp: float = 0.0
         self._job_id_counter = 0
+
+        # Observability: registry + tracer driven by THIS scheduler's
+        # clock — the simulator's virtual clock here, wall time in the
+        # physical subclass (get_current_timestamp is overridden), so
+        # the same metric names exist in both modes and recording never
+        # feeds back into scheduling (bit-identical replay preserved).
+        self._obs = Observability(clock=self.get_current_timestamp)
 
         self.workers = WorkerState()
         self.acct = JobAccounting()
@@ -304,6 +322,9 @@ class Scheduler:
                     cap = 0.5
                 sw["solver_budget_cap_rounds"] = cap
             self._shockwave_planner = ShockwavePlanner.from_config(sw)
+            # Planner-side observability: spans/histograms ride this
+            # scheduler's injected clock (virtual in simulation).
+            self._shockwave_planner.obs = self._obs
             # Planner-side durability hook: mark_progress /
             # add_waiting_delay / increment_round / solve outcomes are
             # journaled at their source so replay reproduces the
@@ -320,6 +341,26 @@ class Scheduler:
 
     def get_current_timestamp(self) -> float:
         return self._current_timestamp
+
+    # ------------------------------------------------------------------
+    # Observability
+    # ------------------------------------------------------------------
+
+    @property
+    def obs(self) -> Observability:
+        """This scheduler's observability bundle (registry + tracer on
+        the scheduler clock)."""
+        return self._obs
+
+    def _obs_update_round_gauges(self) -> None:
+        """Refresh the round-state gauges. Called at every round
+        boundary in both execution modes (the physical caller holds the
+        scheduler lock; the simulator is single-threaded)."""
+        self._obs.set_gauge(obs_names.CURRENT_ROUND,
+                            self.rounds.num_completed_rounds)
+        self._obs.set_gauge(obs_names.ACTIVE_JOBS, len(self.acct.jobs))
+        self._obs.set_gauge(obs_names.LIVE_WORKERS,
+                            len(self.workers.worker_ids))
 
     # ------------------------------------------------------------------
     # Durability (write-ahead journal + snapshot/restore)
@@ -645,6 +686,7 @@ class Scheduler:
         else:
             self._throughput_timeline[job_id.integer_job_id()] = collections.OrderedDict()
 
+        self._obs.inc(obs_names.JOBS_SUBMITTED_TOTAL)
         self._emit("job_added", int_id=int_id, ts=ts, job=dict(
             job_type=job.job_type, command=job.command,
             working_directory=job.working_directory,
@@ -690,6 +732,7 @@ class Scheduler:
             self._shockwave_job_completed = True
         self._remove_from_priorities(job_id)
         self._need_to_update_allocation = True
+        self._obs.inc(obs_names.JOBS_COMPLETED_TOTAL)
         self._emit("job_removed", int_id=int_id,
                    ts=a.latest_timestamps[job_id])
         self.log.info("[Job completed] job %s after %.1fs (%d active)",
@@ -1002,6 +1045,11 @@ class Scheduler:
         # linprog). Jobs re-plan when a worker registers or revives.
         if sum(state["cluster_spec"].values()) <= 0:
             return {}
+        with self._obs.timed(obs_names.ALLOCATION_SOLVE_SECONDS,
+                             policy=name):
+            return self._policy_allocation(state, name)
+
+    def _policy_allocation(self, state: dict, name: str) -> dict:
         throughputs = state["throughputs"]
         sf = state["scale_factors"]
         cluster = state["cluster_spec"]
@@ -1489,6 +1537,8 @@ class Scheduler:
                 agg_steps[j] += num_steps_u[j]
                 agg_times[j] = max(agg_times[j], times_u[j])
 
+        self._obs.inc(obs_names.MICROTASKS_TOTAL,
+                      outcome="ok" if micro_task_succeeded else "failed")
         if not micro_task_succeeded:
             self.log.info("[Micro-task failed] job %s", job_id)
             if not job_id.is_pair() and is_active[job_id]:
@@ -1585,8 +1635,13 @@ class Scheduler:
         would silently produce garbage results."""
         import pickle
         from ..core.durable_io import write_durable
+        # _obs is excluded: its clock is a bound method of this
+        # scheduler (pickling it would drag a ghost scheduler copy into
+        # the checkpoint), and metrics are telemetry, not sim state — a
+        # resumed run keeps its own fresh bundle.
         write_durable(path, pickle.dumps({
-            "scheduler": self.__dict__,
+            "scheduler": {k: v for k, v in self.__dict__.items()
+                          if k != "_obs"},
             "queued": queued,
             "running": running,
             "remaining_jobs": remaining_jobs,
@@ -1632,6 +1687,14 @@ class Scheduler:
             self.log.warning("simulation checkpoint %s corrupt; resumed "
                              "from the previous generation", path)
         self.__dict__.update(state["scheduler"])
+        # The checkpoint replaced _shockwave_planner with the unpickled
+        # one, whose obs/journal hooks were dropped at pickle time (they
+        # are bound into the saving scheduler); re-wire them to THIS
+        # scheduler so post-resume planner spans and journal events land
+        # in the live bundle, not a dangling ghost.
+        if self._shockwave_planner is not None:
+            self._shockwave_planner.obs = self._obs
+            self._shockwave_planner.journal = self._emit_event
         return (state["queued"], state["running"], state["remaining_jobs"],
                 state["current_round"])
 
@@ -1828,7 +1891,9 @@ class Scheduler:
                         break
                     continue
             else:
-                assignments = self._schedule_jobs_on_workers()
+                with self._obs.phase(obs_names.SPAN_SOLVE,
+                                     round=current_round):
+                    assignments = self._schedule_jobs_on_workers()
             for job_id in self.rounds.current_assignments:
                 if any(m in self.acct.jobs for m in job_id.singletons()):
                     self.rounds.num_lease_opportunities += 1
@@ -1868,6 +1933,7 @@ class Scheduler:
 
             current_round += 1
             self.rounds.num_completed_rounds += 1
+            self._obs_update_round_gauges()
             if (self._config.max_rounds is not None
                     and self.rounds.num_completed_rounds >= self._config.max_rounds):
                 break

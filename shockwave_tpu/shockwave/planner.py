@@ -10,6 +10,7 @@ import logging
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from ..obs import names as obs_names
 from .metadata import JobMetadata
 from .milp import MilpOptions, plan_schedule
 
@@ -42,10 +43,31 @@ class ShockwavePlanner:
         # journaled at their source and replay rebuilds the planner's
         # estimate state exactly. None = no journaling.
         self.journal = None
+        # Observability handle, wired by the owning scheduler so spans
+        # ride its injected clock (virtual in simulation). None falls
+        # back to the process-global wall-clock bundle.
+        self.obs = None
 
     def _journal_event(self, etype: str, data: dict) -> None:
         if self.journal is not None:
             self.journal(etype, data)
+
+    def _obs_handle(self):
+        if self.obs is None:
+            from ..obs import get_observability
+            return get_observability()
+        return self.obs
+
+    # The simulator checkpoints pickle the whole planner; the obs
+    # handle's clock and the journal hook are bound methods of the
+    # owning scheduler, so neither may ride along (each would drag a
+    # ghost scheduler copy into the pickle). The resume path
+    # (Scheduler._load_simulation_checkpoint) re-wires both.
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["obs"] = None
+        state["journal"] = None
+        return state
 
     @classmethod
     def from_config(cls, config: dict) -> "ShockwavePlanner":
@@ -111,15 +133,18 @@ class ShockwavePlanner:
         if not self._reestimate_share:
             return
         njobs = len(self.metadata)
-        for job_id, job in self.metadata.items():
-            share = min(1.0, self.ngpus / njobs)
-            job.calibrate_profiled_epoch_duration()
-            estimate = job.timestamp_submit + (
-                sum(job.epoch_duration[:job.epoch_progress])
-                + job.dirichlet_posterior_remaining_runtime(job.epoch_progress)
-            ) / share
-            self.share_series.setdefault(job_id, []).append(
-                (self.round_ptr, estimate))
+        with self._obs_handle().span(obs_names.SPAN_ESTIMATE_REFRESH,
+                                     njobs=njobs, round=self.round_ptr):
+            for job_id, job in self.metadata.items():
+                share = min(1.0, self.ngpus / njobs)
+                job.calibrate_profiled_epoch_duration()
+                estimate = job.timestamp_submit + (
+                    sum(job.epoch_duration[:job.epoch_progress])
+                    + job.dirichlet_posterior_remaining_runtime(
+                        job.epoch_progress)
+                ) / share
+                self.share_series.setdefault(job_id, []).append(
+                    (self.round_ptr, estimate))
         self._reestimate_share = False
 
     # -- scheduling --------------------------------------------------------
@@ -137,12 +162,24 @@ class ShockwavePlanner:
         self._estimate_uniform_share_finish_times()
         share_series = [self.share_series[j] for j in job_ids]
 
-        x = plan_schedule(jobs, self.round_ptr, self.future_nrounds,
-                          self.round_duration, self.ngpus, share_series,
-                          self.opts, stats_out=self.solve_stats)
+        obs = self._obs_handle()
+        with obs.span(obs_names.SPAN_PLANNER_SOLVE, njobs=len(jobs),
+                      round=self.round_ptr):
+            x = plan_schedule(jobs, self.round_ptr, self.future_nrounds,
+                              self.round_duration, self.ngpus, share_series,
+                              self.opts, stats_out=self.solve_stats)
         if self.solve_stats:
             from dataclasses import asdict
-            self._journal_event("solve_outcome", asdict(self.solve_stats[-1]))
+            stats = self.solve_stats[-1]
+            # The MILP's own wall time is already measured inside
+            # plan_schedule (SolveStats.wall_s, journaled with the
+            # outcome) — observe that rather than re-timing, so replay
+            # and live runs histogram the same number.
+            obs.observe(obs_names.MILP_SOLVE_SECONDS, stats.wall_s,
+                        path=stats.path)
+            if stats.path != "ftf":
+                obs.inc(obs_names.SOLVER_FALLBACKS_TOTAL, path=stats.path)
+            self._journal_event("solve_outcome", asdict(stats))
         self.schedules = self._construct_schedules(x, job_ids, jobs)
         self._resolve = False
         return self.schedules[self.round_ptr]

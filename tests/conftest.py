@@ -137,3 +137,23 @@ def cpu_subprocess_env():
     env = ambient_accelerator_env("PALLAS_AXON_POOL_IPS")
     env["JAX_PLATFORMS"] = "cpu"
     return env
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """On a FAILED run with SWTPU_OBS_DUMP_DIR set (CI exports it),
+    dump every live Observability's /metrics text and Chrome trace so
+    the failure artifact carries a timeline — a distributed-test flake
+    arrives with the round phases that led up to it, not just a
+    traceback."""
+    dump_dir = os.environ.get("SWTPU_OBS_DUMP_DIR")
+    if not dump_dir or exitstatus == 0:
+        return
+    try:
+        from shockwave_tpu.obs import dump_all
+        written = dump_all(dump_dir)
+        if written:
+            print(f"\n[obs] dumped {len(written)} observability "
+                  f"artifact(s) to {dump_dir}")
+    except Exception as e:  # noqa: BLE001 - artifact dumping must never
+        # mask the real test failure
+        print(f"\n[obs] artifact dump failed: {type(e).__name__}: {e}")

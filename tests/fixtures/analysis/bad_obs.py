@@ -1,0 +1,45 @@
+"""Seeded obs-discipline violations.
+
+Two halves, mirroring the pass: an inline metric-name literal at an
+instrument call site (the catalog in obs/names.py is the only place
+names may be spelled), and a wall-clock read inside what the test
+treats as the obs package (``obs_globs=("bad_obs.py",)``) — the clock
+must arrive by injection through obs/clock.py.
+"""
+import time
+
+
+class _Registry:
+    """Stand-in with the real instrument method names."""
+
+    def inc(self, spec, amount=1.0, **labels):
+        return (spec, amount, labels)
+
+    def observe(self, spec, value, **labels):
+        return (spec, value, labels)
+
+
+REGISTRY = _Registry()
+
+GOOD_SPEC = object()
+
+
+def emit_adhoc():
+    REGISTRY.inc("swtpu_adhoc_total")  # SEEDED
+
+
+def observe_adhoc():
+    REGISTRY.observe("swtpu_adhoc_seconds", 0.25)  # SEEDED
+
+
+def emit_declared():
+    # Attribute/spec references are the sanctioned form — not flagged.
+    REGISTRY.inc(GOOD_SPEC)
+
+
+def read_clock():
+    return time.time()  # SEEDED
+
+
+def read_perf_clock():
+    return time.perf_counter()  # SEEDED

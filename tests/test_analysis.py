@@ -69,13 +69,14 @@ class TestRepoIsClean:
         assert out.returncode == 0, out.stdout + out.stderr
         assert "0 finding(s)" in out.stdout
 
-    def test_cli_lists_all_five_passes(self):
+    def test_cli_lists_all_six_passes(self):
         out = subprocess.run(
             [sys.executable, "-m", "shockwave_tpu.analysis", "--list"],
             capture_output=True, text=True, cwd=REPO)
         assert out.returncode == 0
         for pass_id in ("lock-discipline", "journal-coverage",
-                        "durability", "determinism", "exception-hygiene"):
+                        "durability", "determinism", "exception-hygiene",
+                        "obs-discipline"):
             assert pass_id in out.stdout
 
 
@@ -111,6 +112,13 @@ class TestNegativeFixtures:
             fixture_index("bad_exceptions.py"))
         assert_exactly_seeded(findings, "bad_exceptions.py",
                               "exception-hygiene")
+
+    def test_obs_discipline(self):
+        findings = passes.check_obs_discipline(
+            fixture_index("bad_obs.py"),
+            names_globs=(), obs_globs=("bad_obs.py",),
+            clock_allow_globs=())
+        assert_exactly_seeded(findings, "bad_obs.py", "obs-discipline")
 
     def test_cli_exits_one_on_violations(self, tmp_path):
         """End-to-end exit-1 proof: a copy of a broken fixture placed

@@ -1,0 +1,527 @@
+"""Observability subsystem tests: registry semantics + concurrency
+(under the lock sanitizer), golden Chrome-trace export, the report CLI,
+the /metrics + /healthz endpoint (unit and scraped mid-run through a
+real loopback scheduler), and obs-on/off simulator determinism."""
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from shockwave_tpu.core.job import Job, JobIdPair
+from shockwave_tpu.obs import Observability, names
+from shockwave_tpu.obs.exporter import ObsHttpServer
+from shockwave_tpu.obs.names import MetricSpec
+from shockwave_tpu.obs.registry import MetricsRegistry
+from shockwave_tpu.obs.report import load_spans, phase_table, render
+from shockwave_tpu.obs.tracing import Tracer
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+DATA = os.path.join(REPO, "data")
+
+
+class SteppingClock:
+    """Deterministic clock: every read advances by `step`."""
+
+    def __init__(self, start=100.0, step=1.0):
+        self.now = start
+        self.step = step
+
+    def __call__(self):
+        t = self.now
+        self.now += self.step
+        return t
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text):
+    """Exposition text -> {(name, frozenset(label pairs)): value}.
+    Doubles as the 'is this parseable' check: any malformed sample
+    line raises."""
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        body, value = line.rsplit(" ", 1)
+        if "{" in body:
+            name, labels_body = body.split("{", 1)
+            assert labels_body.endswith("}")
+            labels = _LABEL_RE.findall(labels_body[:-1])
+            key = (name, frozenset(labels))
+        else:
+            key = (body, frozenset())
+        samples[key] = float(value)
+    return samples
+
+
+COUNTER = MetricSpec("test_events_total", "counter", "events", ("kind",))
+GAUGE = MetricSpec("test_depth", "gauge", "depth")
+HIST = MetricSpec("test_latency_seconds", "histogram", "latency",
+                  ("op",), (0.1, 1.0, 10.0))
+
+
+class TestRegistry:
+    def test_counter_accumulates_per_label(self):
+        reg = MetricsRegistry()
+        reg.inc(COUNTER, kind="a")
+        reg.inc(COUNTER, amount=2.5, kind="a")
+        reg.inc(COUNTER, kind="b")
+        assert reg.value(COUNTER, kind="a") == 3.5
+        assert reg.value(COUNTER, kind="b") == 1.0
+        assert reg.value(COUNTER, kind="never") == 0.0
+
+    def test_gauge_overwrites(self):
+        reg = MetricsRegistry()
+        reg.set_gauge(GAUGE, 4)
+        reg.set_gauge(GAUGE, 2)
+        assert reg.value(GAUGE) == 2.0
+
+    def test_histogram_buckets_and_stats(self):
+        reg = MetricsRegistry()
+        for v in (0.05, 0.5, 5.0, 50.0):
+            reg.observe(HIST, v, op="x")
+        count, total = reg.histogram_stats(HIST, op="x")
+        assert count == 4
+        assert total == pytest.approx(55.55)
+        samples = parse_prometheus(reg.render_prometheus())
+        le = lambda b: samples[("test_latency_seconds_bucket",
+                                frozenset({("op", "x"), ("le", b)}))]
+        assert le("0.1") == 1        # cumulative
+        assert le("1") == 2
+        assert le("10") == 3
+        assert le("+Inf") == 4
+
+    def test_kind_and_label_misuse_raise(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.inc(GAUGE)                       # wrong kind
+        with pytest.raises(ValueError):
+            reg.observe(COUNTER, 1.0, kind="a")  # wrong kind
+        with pytest.raises(ValueError):
+            reg.inc(COUNTER)                     # missing label
+        with pytest.raises(ValueError):
+            reg.inc(COUNTER, kind="a", extra="b")
+        with pytest.raises(ValueError):
+            reg.inc(COUNTER, amount=-1, kind="a")
+
+    def test_timed_uses_injected_clock(self):
+        clock = SteppingClock(step=2.0)
+        reg = MetricsRegistry(clock=clock)
+        with reg.timed(HIST, op="solve"):
+            pass
+        count, total = reg.histogram_stats(HIST, op="solve")
+        assert (count, total) == (1, 2.0)  # exactly one clock step
+
+    def test_disabled_registry_records_nothing(self):
+        reg = MetricsRegistry(enabled=False)
+        reg.inc(COUNTER, kind="a")
+        reg.set_gauge(GAUGE, 9)
+        reg.observe(HIST, 1.0, op="x")
+        assert reg.render_prometheus().strip() == ""
+
+    def test_rendering_is_parseable_and_typed(self):
+        reg = MetricsRegistry()
+        reg.inc(COUNTER, kind='we"ird\nlabel')
+        reg.set_gauge(GAUGE, 1.5)
+        text = reg.render_prometheus()
+        assert "# TYPE test_events_total counter" in text
+        assert "# HELP test_depth depth" in text
+        samples = parse_prometheus(text)
+        assert samples[("test_depth", frozenset())] == 1.5
+
+
+@pytest.mark.runtime
+class TestRegistryConcurrency:
+    """Exact counts under thread contention, with the registry lock
+    instrumented by the sanitizer (the conftest `runtime`-marker
+    fixture sets SWTPU_SANITIZE=1 and asserts a clean report)."""
+
+    def test_parallel_increments_are_exact(self):
+        reg = MetricsRegistry()
+        n_threads, n_ops = 8, 2000
+        barrier = threading.Barrier(n_threads)
+
+        def worker(k):
+            barrier.wait()
+            for _ in range(n_ops):
+                reg.inc(COUNTER, kind="shared")
+                reg.observe(HIST, 0.5, op=f"t{k % 2}")
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert reg.value(COUNTER, kind="shared") == n_threads * n_ops
+        c0, _ = reg.histogram_stats(HIST, op="t0")
+        c1, _ = reg.histogram_stats(HIST, op="t1")
+        assert c0 + c1 == n_threads * n_ops
+
+
+class TestTracer:
+    def test_golden_chrome_trace_export(self, tmp_path):
+        clock = SteppingClock(start=10.0, step=1.0)
+        tracer = Tracer(clock=clock)
+        with tracer.span(names.SPAN_SOLVE, round=0):       # t=10..13
+            with tracer.span(names.SPAN_DISPATCH, round=0):  # t=11..12
+                pass
+        path = str(tmp_path / "trace.json")
+        tracer.export_chrome_trace(path)
+        with open(path) as f:
+            trace = json.load(f)
+        golden = [
+            {"name": "dispatch", "ph": "X", "cat": "swtpu",
+             "ts": 11_000_000.0, "dur": 1_000_000.0,
+             "args": {"round": 0}},
+            {"name": "solve", "ph": "X", "cat": "swtpu",
+             "ts": 10_000_000.0, "dur": 3_000_000.0,
+             "args": {"round": 0}},
+        ]
+        got = [{k: e[k] for k in ("name", "ph", "cat", "ts", "dur",
+                                  "args")}
+               for e in trace["traceEvents"]]
+        assert got == golden
+        assert trace["displayTimeUnit"] == "ms"
+        # pid/tid present on every event (Perfetto requires them).
+        assert all("pid" in e and "tid" in e for e in trace["traceEvents"])
+
+    def test_ring_buffer_bounds_memory(self):
+        tracer = Tracer(clock=SteppingClock(), max_events=3)
+        for i in range(10):
+            with tracer.span(names.SPAN_WAIT, i=i):
+                pass
+        events = tracer.events()
+        assert len(events) == 3
+        assert [e["args"]["i"] for e in events] == [7, 8, 9]
+
+    def test_disabled_tracer_records_nothing(self):
+        tracer = Tracer(enabled=False)
+        with tracer.span(names.SPAN_WAIT):
+            pass
+        assert tracer.events() == []
+
+
+class TestReport:
+    def _write_trace(self, tmp_path):
+        clock = SteppingClock(start=0.0, step=0.5)
+        tracer = Tracer(clock=clock)
+        for rnd in range(2):
+            with tracer.span(names.SPAN_SOLVE, round=rnd):
+                pass
+            with tracer.span(names.SPAN_DISPATCH, round=rnd):
+                pass
+            # Round-less span (journal fsync fires from RPC threads):
+            # attributed to the round whose window contains it.
+            with tracer.span(names.SPAN_JOURNAL_FSYNC, etype="x"):
+                pass
+            with tracer.span(names.SPAN_WAIT, round=rnd):
+                pass
+            with tracer.span(names.SPAN_END_ROUND, round=rnd):
+                pass
+        path = str(tmp_path / "trace.json")
+        tracer.export_chrome_trace(path)
+        return path
+
+    def test_phase_table_assigns_roundless_spans(self, tmp_path):
+        spans = load_spans(self._write_trace(tmp_path))
+        rounds, per_round, totals = phase_table(spans)
+        assert rounds == [0, 1]
+        for rnd in (0, 1):
+            assert per_round[rnd][names.SPAN_JOURNAL_FSYNC] > 0
+        assert totals[names.SPAN_SOLVE][0] == 2
+
+    def test_render_has_all_phase_columns(self, tmp_path):
+        spans = load_spans(self._write_trace(tmp_path))
+        table = render(spans)
+        for phase in names.REPORT_PHASES:
+            assert phase in table
+        assert "total_s" in table and "mean_s" in table
+
+    def test_cli_prints_table(self, tmp_path):
+        path = self._write_trace(tmp_path)
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.obs.report", path],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "solve" in out.stdout
+        assert "journal-fsync" in out.stdout
+
+    def test_cli_fails_on_empty_trace(self, tmp_path):
+        path = tmp_path / "empty.json"
+        path.write_text('{"traceEvents": []}')
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.obs.report", str(path)],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 1
+
+
+class TestCatalog:
+    def test_catalog_covers_every_spec(self):
+        from shockwave_tpu.obs.catalog import catalog_markdown
+        table = catalog_markdown()
+        for spec in names.all_metric_specs():
+            assert spec.name in table
+
+    def test_readme_contains_every_metric(self):
+        """README's generated catalog must not drift from names.py."""
+        with open(os.path.join(REPO, "README.md")) as f:
+            readme = f.read()
+        for spec in names.all_metric_specs():
+            assert spec.name in readme, (
+                f"{spec.name} missing from README.md — regenerate the "
+                "catalog with `python -m shockwave_tpu.obs.catalog`")
+
+
+class TestExporter:
+    def test_metrics_and_healthz_roundtrip(self):
+        reg = MetricsRegistry()
+        reg.inc(COUNTER, kind="a")
+        server = ObsHttpServer(
+            reg, health_fn=lambda: {"round": 7, "live_workers": 2},
+            addr="127.0.0.1", port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+                assert r.status == 200
+                assert "text/plain" in r.headers["Content-Type"]
+                samples = parse_prometheus(r.read().decode())
+            assert samples[("test_events_total",
+                            frozenset({("kind", "a")}))] == 1.0
+            with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+                health = json.loads(r.read())
+            assert health == {"round": 7, "live_workers": 2,
+                              "status": "ok"}
+            try:
+                urllib.request.urlopen(base + "/nope", timeout=5)
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.stop()
+
+    def test_failing_health_callback_returns_500(self):
+        def broken():
+            raise RuntimeError("wedged")
+
+        server = ObsHttpServer(MetricsRegistry(), health_fn=broken,
+                               addr="127.0.0.1", port=0).start()
+        try:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/healthz", timeout=5)
+                assert False, "expected 500"
+            except urllib.error.HTTPError as e:
+                assert e.code == 500
+                body = json.loads(e.read())
+                assert body["status"] == "error"
+                assert "wedged" in body["error"]
+        finally:
+            server.stop()
+
+
+class _StubWorker:
+    """Minimal in-process worker daemon (mirrors test_runtime's stub):
+    simulates execution at a fixed throughput, no subprocesses."""
+
+    def __init__(self, sched_port, worker_port, num_chips=2,
+                 throughput=100.0, execution_time=0.4):
+        from shockwave_tpu.runtime.clients import (
+            IteratorToSchedulerClient, WorkerToSchedulerClient)
+        from shockwave_tpu.runtime.servers import serve_worker
+        self.throughput = throughput
+        self.execution_time = execution_time
+        self.sched_port = sched_port
+        self._iter_client = IteratorToSchedulerClient
+        self._client = WorkerToSchedulerClient("localhost", sched_port)
+        self.server = serve_worker(worker_port, {
+            "RunJob": self._run_job, "KillJob": lambda j: None,
+            "Reset": lambda: None, "Shutdown": lambda: None,
+        })
+        self.worker_ids, self.round_duration = self._client.register_worker(
+            "v5e", "127.0.0.1", worker_port, num_chips)
+
+    def _run_job(self, jobs, worker_id, round_id):
+        def execute():
+            for j in jobs:
+                it = self._iter_client(j["job_id"], worker_id,
+                                       "localhost", self.sched_port)
+                max_steps, _, _ = it.init()
+            time.sleep(self.execution_time)
+            steps = [min(int(self.throughput * self.round_duration),
+                         j["num_steps"], int(max_steps)) for j in jobs]
+            self._client.notify_done(
+                [j["job_id"] for j in jobs], worker_id, steps,
+                [self.execution_time] * len(jobs))
+        threading.Thread(target=execute, daemon=True).start()
+
+    def stop(self):
+        self.server.stop(grace=0)
+
+
+@pytest.mark.runtime
+@pytest.mark.timeout(120)
+class TestPhysicalObsLoopback:
+    """Scrape /metrics and /healthz from a REAL loopback scheduler
+    mid-run, then report on its exported trace — the acceptance drive
+    for the endpoint and the round-phase spans."""
+
+    def test_scrape_mid_run_and_report_after(self, tmp_path):
+        from shockwave_tpu.sched.physical import PhysicalScheduler
+        from shockwave_tpu.sched.scheduler import SchedulerConfig
+        from shockwave_tpu.solver import get_policy
+        sched_port, worker_port = free_port(), free_port()
+        trace_path = str(tmp_path / "round_trace.json")
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(
+                time_per_iteration=2.0, max_rounds=4,
+                state_dir=str(tmp_path / "state"),
+                snapshot_interval_rounds=2,
+                obs_port=0, obs_trace_path=trace_path),
+            expected_num_workers=2, port=sched_port)
+        worker = _StubWorker(sched_port, worker_port, num_chips=2)
+        base = f"http://127.0.0.1:{sched.obs_port}"
+        try:
+            for _ in range(2):
+                sched.add_job(Job(
+                    None, "ResNet-18 (batch size 32)",
+                    "python3 main.py --batch_size 32",
+                    "image_classification/cifar10", "--num_steps",
+                    total_steps=600, duration=10000))
+            runner = threading.Thread(target=sched.run, daemon=True)
+            runner.start()
+
+            # Mid-run scrape: poll until the first dispatch lands.
+            deadline = time.time() + 30
+            samples = {}
+            while time.time() < deadline:
+                with urllib.request.urlopen(base + "/metrics",
+                                            timeout=5) as r:
+                    samples = parse_prometheus(r.read().decode())
+                if samples.get(("swtpu_dispatches_total",
+                                frozenset({("outcome", "ok")})), 0) >= 1:
+                    break
+                time.sleep(0.2)
+            assert samples.get(("swtpu_dispatches_total",
+                                frozenset({("outcome", "ok")})), 0) >= 1
+            # Journal fsync histogram is live (state_dir set).
+            assert samples.get(("swtpu_journal_append_seconds_count",
+                                frozenset({("sync", "true")})), 0) >= 1
+
+            with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+                health = json.loads(r.read())
+            assert health["status"] == "ok"
+            assert health["live_workers"] == 2
+            assert isinstance(health["round"], int)
+            assert health["journal"]["last_seq"] >= 1
+            assert isinstance(health["breakers"], dict)
+
+            deadline = time.time() + 40
+            while time.time() < deadline and len(sched._completed_jobs) < 2:
+                time.sleep(0.2)
+            assert len(sched._completed_jobs) == 2
+
+            # Final scrape: solve-time histogram and phase histogram.
+            with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+                samples = parse_prometheus(r.read().decode())
+            assert samples.get(
+                ("swtpu_allocation_solve_seconds_count",
+                 frozenset({("policy", "MaxMinFairness")})), 0) >= 1
+            assert samples.get(
+                ("swtpu_round_phase_seconds_count",
+                 frozenset({("phase", "solve")})), 0) >= 1
+            assert samples[("swtpu_jobs_completed_total",
+                            frozenset())] == 2.0
+        finally:
+            sched._done_event.set()
+            worker.stop()
+            sched.shutdown()
+            sched._server.stop(grace=0)
+
+        # Trace exported at shutdown; the report CLI digests it.
+        assert os.path.exists(trace_path)
+        span_names = {e["name"] for e in load_spans(trace_path)}
+        for phase in (names.SPAN_SOLVE, names.SPAN_DISPATCH,
+                      names.SPAN_WAIT, names.SPAN_END_ROUND,
+                      names.SPAN_JOURNAL_FSYNC):
+            assert phase in span_names, span_names
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.obs.report",
+             trace_path], capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "journal-fsync" in out.stdout
+
+
+class TestSimObsDeterminism:
+    """Scheduling decisions are bit-identical with obs recording on and
+    off: instrumentation observes, never steers."""
+
+    def _run(self, monkeypatch, obs_value):
+        from shockwave_tpu.sched.scheduler import (Scheduler,
+                                                   SchedulerConfig)
+        from shockwave_tpu.solver import get_policy
+        monkeypatch.setenv("SWTPU_OBS", obs_value)
+        jobs = [Job(None, "ResNet-18 (batch size 32)",
+                    "python3 main.py --batch_size 32",
+                    "image_classification/cifar10", "--num_steps",
+                    total_steps=(i + 1) * 20000, duration=4000)
+                for i in range(5)]
+        arrivals = [i * 150.0 for i in range(5)]
+        sched = Scheduler(
+            get_policy("max_min_fairness", seed=0), simulate=True,
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(time_per_iteration=120.0))
+        makespan = sched.simulate({"v100": 2}, arrivals, jobs)
+        assert sched.obs.enabled == (obs_value == "1")
+        return (makespan, sched.get_average_jct()[3],
+                sched.rounds.per_round_schedule)
+
+    def test_enabled_vs_disabled_bit_identical(self, monkeypatch):
+        on = self._run(monkeypatch, "1")
+        off = self._run(monkeypatch, "0")
+        assert on == off
+
+
+@pytest.mark.slow
+class TestCanonicalObsDeterminism:
+    """The canonical 120-job replay stays bit-identical (33207.58
+    max_min makespan, exact JSON match with the recorded reproduce
+    pickle) with obs instrumentation enabled vs. disabled."""
+
+    def _simulate(self, obs_value):
+        env = dict(os.environ, SWTPU_OBS=obs_value, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts/drivers/simulate.py"),
+             "--trace", os.path.join(DATA, "canonical_120job.trace"),
+             "--policy", "max_min_fairness",
+             "--throughputs", os.path.join(DATA, "tacc_throughputs.json"),
+             "--cluster_spec", "v100:32", "--round_duration", "120"],
+            capture_output=True, text=True, timeout=1800, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    def test_canonical_replay_bit_identical(self):
+        enabled = self._simulate("1")
+        disabled = self._simulate("0")
+        assert enabled == disabled
+        with open(os.path.join(REPO, "reproduce", "pickles",
+                               "max_min_fairness.json")) as f:
+            recorded = json.load(f)
+        assert enabled == recorded
+        assert enabled["makespan"] == 33207.58
