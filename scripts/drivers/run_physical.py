@@ -106,6 +106,12 @@ def main():
                    dest="snapshot_interval", type=int, default=10,
                    help="rounds between compacting snapshots (bounds "
                         "journal size; 0 disables snapshots)")
+    p.add_argument("--no_pipelined_solve", action="store_true",
+                   help="disable the background planner solve thread "
+                        "(shockwave policy): the MILP runs inline at "
+                        "mid-round under the historical half-round "
+                        "budget clamp (see README 'Planner "
+                        "performance')")
     # Observability knobs (see README "Observability").
     p.add_argument("--obs_port", type=int, default=None,
                    help="serve Prometheus /metrics + JSON /healthz on "
@@ -160,6 +166,7 @@ def main():
             kill_wait_s=args.kill_wait,
             state_dir=args.state_dir, resume=args.resume,
             snapshot_interval_rounds=args.snapshot_interval,
+            pipelined_planning=not args.no_pipelined_solve,
             obs_port=args.obs_port, obs_trace_path=args.obs_trace))
     if sched.obs_port is not None:
         # stderr, unconditionally: with --obs_port 0 this line is the

@@ -1,0 +1,168 @@
+#!/usr/bin/env python3
+"""Shockwave MILP assembly-vs-solve split microbenchmark.
+
+Times, at each job count, (a) assembling the EG model — both fallback
+arms, the work one `plan_schedule` call pays before HiGHS ever runs —
+and (b) one bounded relaxed solve, through the same obs histograms the
+planner reports (`swtpu_milp_assembly_seconds` /
+`swtpu_milp_solve_seconds`, dumpable with --metrics_out). Prints one
+JSON line per job count.
+
+`--assembler loop` times the historical pure-python loop assembler —
+the SAME single copy (milp_loop_reference.py, next to this script) the
+golden-equivalence suite in tests/test_milp_assembly.py certifies
+byte-identical to the vectorized path — so the before/after table in
+EXPERIMENTS.md is reproducible against the tested oracle.
+
+`--smoke` asserts the assembly wall stays under the instance's solve-
+budget floor (opts.timeout x njobs/120) — the CI guard that model
+assembly never again grows into round-budget territory.
+
+Example:
+    python scripts/microbenchmarks/bench_milp_assembly.py \
+        --num_jobs 120 220 460 900 --metrics_out assembly.prom
+"""
+import argparse
+import json
+import math
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from milp_loop_reference import reference_assemble
+from shockwave_tpu.obs import Observability
+from shockwave_tpu.obs import names as obs_names
+from shockwave_tpu.obs.clock import perf_clock
+from shockwave_tpu.shockwave import milp as milp_mod
+from shockwave_tpu.shockwave.milp import MilpOptions
+
+
+def synth_instance(njobs, seed, future_nrounds=20, ngpus=None):
+    """Deterministic synthetic solve inputs shaped like the scale
+    traces: mostly single-chip jobs, wide duration/remaining spreads."""
+    rng = np.random.RandomState(seed)
+    ngpus = ngpus or max(32, njobs // 4)
+    data = dict(
+        nworkers=[int(rng.choice([1, 1, 1, 2, 4])) for _ in range(njobs)],
+        durations=[float(rng.uniform(20, 400)) for _ in range(njobs)],
+        dirichlet=[float(rng.uniform(100, 9000)) for _ in range(njobs)],
+        epochs=[int(rng.randint(2, 60)) for _ in range(njobs)],
+        ftf_caps=[float(rng.uniform(10, 9000)) for _ in range(njobs)],
+        round_duration=120.0, ngpus=ngpus,
+        future_nrounds=future_nrounds)
+    data["progress"] = [int(rng.randint(0, e)) for e in data["epochs"]]
+    return data
+
+
+def loop_assemble(data, bases, base_logs, priorities, with_ftf, k):
+    """One arm of the shared loop oracle, adapted to the synth dict."""
+    njobs = len(data["nworkers"])
+    R = data["future_nrounds"]
+    return reference_assemble(
+        milp_mod._Layout(njobs, R, len(bases)), njobs, R,
+        data["round_duration"], data["ngpus"], bases, base_logs,
+        data["nworkers"], data["durations"], data["dirichlet"],
+        data["progress"], data["epochs"], data["ftf_caps"], k,
+        priorities, with_ftf)
+
+
+def time_assembly(obs, assembler, data, opts, trials):
+    """Both fallback arms per trial (what one plan_schedule pays),
+    through the assembly histogram. Returns (best_s, mean_s, model)."""
+    bases = list(opts.logapx_bases)
+    base_logs = [math.log(opts.logapx_origin)] + [
+        math.log(b) for b in bases[1:]]
+    ones = [1.0] * len(data["nworkers"])
+    model = None
+    events_before = len(obs.tracer.events())
+    for t in range(trials):
+        with obs.span(obs_names.SPAN_PLANNER_SOLVE,
+                      phase="assembly", assembler=assembler, trial=t), \
+                obs.timed(obs_names.MILP_ASSEMBLY_SECONDS, path="ftf"):
+            if assembler == "loop":
+                loop_assemble(data, bases, base_logs, ones, True, opts.k)
+                model = loop_assemble(data, bases, base_logs, ones, False,
+                                      opts.k)
+            else:
+                inst = milp_mod._InstanceAssembler(
+                    milp_mod._structure_for(len(ones),
+                                            data["future_nrounds"],
+                                            len(bases)),
+                    bases, base_logs, data["nworkers"], data["durations"],
+                    data["dirichlet"], data["progress"], data["epochs"],
+                    data["ftf_caps"], data["round_duration"],
+                    data["ngpus"], opts.k)
+                inst.model(ones, True)
+                model = inst.model(ones, False)
+    times = [e["dur"] for e in obs.tracer.events()[events_before:]
+             if e["name"] == obs_names.SPAN_PLANNER_SOLVE]
+    return min(times), sum(times) / len(times), model
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num_jobs", nargs="*", type=int,
+                   default=[120, 220, 460, 900])
+    p.add_argument("--assembler", choices=["vectorized", "loop"],
+                   default="vectorized")
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--solve_timeout", type=float, default=5.0,
+                   help="bounded relaxed-solve budget per size (seconds); "
+                        "keeps the solve leg of the split cheap")
+    p.add_argument("--skip_solve", action="store_true")
+    p.add_argument("--smoke", action="store_true",
+                   help="exit 1 unless assembly stays under the solve-"
+                        "budget floor (opts.timeout x njobs/120)")
+    p.add_argument("--output", default=None, help="JSON results path")
+    p.add_argument("--metrics_out", default=None, metavar="PROM_TXT",
+                   help="dump the assembly/solve histograms as "
+                        "Prometheus text")
+    args = p.parse_args()
+
+    # Force-enabled local bundle on the perf clock: a benchmark must
+    # measure even when the ambient SWTPU_OBS=0 disables production
+    # telemetry.
+    obs = Observability(clock=perf_clock, enabled=True)
+    opts = MilpOptions()
+    results, smoke_ok = [], True
+    for n in args.num_jobs:
+        data = synth_instance(n, args.seed)
+        best, mean, model = time_assembly(obs, args.assembler, data, opts,
+                                          args.trials)
+        row = {"njobs": n, "assembler": args.assembler,
+               "assembly_best_s": round(best, 4),
+               "assembly_mean_s": round(mean, 4)}
+        if not args.skip_solve and model is not None:
+            solve_opts = MilpOptions(timeout=args.solve_timeout)
+            t0 = perf_clock()
+            with obs.timed(obs_names.MILP_SOLVE_SECONDS, path="relaxed"):
+                res = milp_mod._solve(*model, solve_opts)
+            row["solve_s"] = round(perf_clock() - t0, 4)
+            row["solve_status"] = getattr(res, "status", None)
+        floor = opts.timeout * max(1.0, n / 120.0)
+        row["solve_budget_floor_s"] = round(floor, 1)
+        if args.smoke and best >= floor:
+            row["smoke"] = "FAIL"
+            smoke_ok = False
+        results.append(row)
+        print(json.dumps(row), flush=True)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(results, f, indent=1)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            f.write(obs.registry.render_prometheus())
+    if not smoke_ok:
+        print("SMOKE FAIL: assembly wall reached the solve-budget floor",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""The pre-vectorization MILP loop assemblers, verbatim — the ONE copy.
+
+Two consumers, deliberately sharing this module so they can never
+drift: the golden-equivalence suite (tests/test_milp_assembly.py) pins
+the vectorized assembler byte-identical to these loops, and
+bench_milp_assembly.py's `--assembler loop` arm produces the
+EXPERIMENTS.md 'before' numbers from the same certified oracle. Not
+part of the shockwave_tpu package: production code must never call the
+loop path again.
+"""
+from __future__ import annotations
+
+import numpy as np
+from scipy import sparse
+
+
+def reference_assemble(L, njobs, future_nrounds, round_duration, ngpus,
+                       bases, base_logs, nworkers, durations, dirichlet,
+                       progress, epochs, ftf_caps, k, priorities, with_ftf):
+    """The historical `assemble` closure from milp.plan_schedule."""
+    rows_ub, cols_ub, vals_ub, b_ub = [], [], [], []
+    rows_eq, cols_eq, vals_eq, b_eq = [], [], [], []
+
+    def add_ub(entries, rhs):
+        r = len(b_ub)
+        for col, val in entries:
+            rows_ub.append(r); cols_ub.append(col); vals_ub.append(val)
+        b_ub.append(rhs)
+
+    def add_eq(entries, rhs):
+        r = len(b_eq)
+        for col, val in entries:
+            rows_eq.append(r); cols_eq.append(col); vals_eq.append(val)
+        b_eq.append(rhs)
+
+    for r in range(future_nrounds):
+        add_ub([(L.x(j, r), nworkers[j]) for j in range(njobs)], ngpus)
+    for j in range(njobs):
+        add_ub([(L.p(j), durations[j])]
+               + [(L.x(j, r), -round_duration)
+                  for r in range(future_nrounds)], 0.0)
+        add_eq([(L.w(j, b), bases[b]) for b in range(L.B)]
+               + [(L.p(j), -1.0 / epochs[j])], progress[j] / epochs[j])
+        add_eq([(L.w(j, b), 1.0) for b in range(L.B)], 1.0)
+        for b in range(L.B):
+            add_ub([(L.w(j, b), 1.0), (L.z(j, b), -1.0)], 0.0)
+        add_ub([(L.z(j, b), 1.0) for b in range(L.B)], 2.0)
+        for lo in range(L.B - 2):
+            for hi in range(lo + 2, L.B):
+                add_ub([(L.z(j, lo), 1.0), (L.z(j, hi), 1.0)], 1.0)
+        add_ub([(L.s(j), -1.0), (L.p(j), -durations[j])], -dirichlet[j])
+        add_ub([(L.s(j), 1.0), (L.t, -1.0)], 0.0)
+        if with_ftf:
+            if ftf_caps[j] < 0:
+                return None
+            add_ub([(L.s(j), 1.0)], ftf_caps[j])
+    A_ub = sparse.coo_matrix((vals_ub, (rows_ub, cols_ub)),
+                             shape=(len(b_ub), L.n)).tocsr()
+    A_eq = sparse.coo_matrix((vals_eq, (rows_eq, cols_eq)),
+                             shape=(len(b_eq), L.n)).tocsr()
+    c = np.zeros(L.n)
+    for j in range(njobs):
+        for b in range(L.B):
+            c[L.w(j, b)] = -priorities[j] * base_logs[b] / (
+                njobs * future_nrounds)
+    c[L.t] = k
+    integrality = np.zeros(L.n)
+    ub = np.full(L.n, np.inf)
+    for j in range(njobs):
+        for r in range(future_nrounds):
+            integrality[L.x(j, r)] = 1
+            ub[L.x(j, r)] = 1
+        for b in range(L.B):
+            integrality[L.z(j, b)] = 1
+            ub[L.z(j, b)] = 1
+            ub[L.w(j, b)] = 1
+    return c, A_ub, np.array(b_ub), A_eq, np.array(b_eq), integrality, ub
+
+
+def reference_rank_model(x, priorities, nworkers, ngpus):
+    """The historical `_rank_in_schedule` model assembly."""
+    njobs, nrounds = x.shape
+    counts = x.sum(axis=1)
+    n = njobs * nrounds
+    rows_ub, cols_ub, vals_ub, b_ub = [], [], [], []
+    rows_eq, cols_eq, vals_eq, b_eq = [], [], [], []
+    for r in range(nrounds):
+        row = len(b_ub)
+        for j in range(njobs):
+            rows_ub.append(row); cols_ub.append(j * nrounds + r)
+            vals_ub.append(nworkers[j])
+        b_ub.append(ngpus)
+    for j in range(njobs):
+        row = len(b_eq)
+        for r in range(nrounds):
+            rows_eq.append(row); cols_eq.append(j * nrounds + r)
+            vals_eq.append(1.0)
+        b_eq.append(float(counts[j]))
+    c = np.zeros(n)
+    for j in range(njobs):
+        if counts[j] > 0:
+            for r in range(nrounds):
+                c[j * nrounds + r] = priorities[j] * r / counts[j]
+    A_ub = sparse.coo_matrix((vals_ub, (rows_ub, cols_ub)),
+                             shape=(len(b_ub), n)).tocsr()
+    A_eq = sparse.coo_matrix((vals_eq, (rows_eq, cols_eq)),
+                             shape=(len(b_eq), n)).tocsr()
+    return c, A_ub, np.array(b_ub), A_eq, np.array(b_eq)

@@ -121,10 +121,22 @@ MILP_SOLVE_SECONDS = _histogram(
     "swtpu_milp_solve_seconds",
     "Shockwave EG-MILP plan_schedule wall time, by fallback path",
     ("path",))
+MILP_ASSEMBLY_SECONDS = _histogram(
+    "swtpu_milp_assembly_seconds",
+    "Sparse-model assembly share of each plan_schedule wall "
+    "(structure splice + COO->CSR; included in the solve wall)",
+    ("path",))
 SOLVER_FALLBACKS_TOTAL = _counter(
     "swtpu_solver_fallbacks_total",
     "MILP solves that fell off the primary (ftf) arm, by landing path "
     "(relaxed / relaxed_retry / greedy)", ("path",))
+PIPELINED_SOLVES_TOTAL = _counter(
+    "swtpu_pipelined_solves_total",
+    "Physical pipelined-planning outcomes: hit (background solve "
+    "committed before its re-solve round), late (committed after — its "
+    "round already ran on the fallback), miss (one planner query "
+    "served by the deadline fallback: cached schedule / backfill), "
+    "inline (startup solve on the round loop)", ("outcome",))
 
 # ----------------------------------------------------------------------
 # Durability (sched/journal.py)

@@ -11,13 +11,17 @@
   active Ping probe; a dead worker's chips leave the schedulable pool,
   its in-round jobs are failed-in-round and requeued (so `_end_round`
   never blocks on a crashed daemon), and a rejoining daemon revives its
-  old chip ids via an idempotent RegisterWorker
+  old chip ids via an idempotent RegisterWorker,
+- pipelined planning (shockwave policy): the EG MILP runs on a
+  background solve thread kicked at round start, committed at the
+  mid-round re-solve point, with a deadline fallback to the cached
+  schedule + work-conserving backfill — the round loop never waits on
+  the solver, so physical mode can grant the full solver budget
 (reference: scheduler/scheduler.py:2382-2777, 3880-4339).
 """
 from __future__ import annotations
 
 import collections
-import copy
 import logging
 import math
 import queue
@@ -80,6 +84,8 @@ class PhysicalScheduler(Scheduler):
         "_ever_signaled", "_max_steps_consensus", "_completion_events",
         "_redispatch_assignments", "_current_round_start_time",
         "_port_offset",
+        # pipelined-planning handoff (round loop <-> solve thread)
+        "_planner_request", "_planner_result", "_planner_busy",
     })
 
     def __init__(self, policy, throughputs_file=None, profiles=None,
@@ -136,6 +142,12 @@ class PhysicalScheduler(Scheduler):
         self._current_round_start_time = 0.0
         self._port_offset = 0
         self._done_event = threading.Event()
+        # Pipelined planning: one in-flight MILP request/result pair
+        # handed between the round loop and the background solve thread
+        # (same pattern as _allocation_thread; all three under _lock).
+        self._planner_request = None
+        self._planner_result = None
+        self._planner_busy = False
 
         # Durability: recover BEFORE the gRPC server starts (RPCs land
         # the moment the port is bound, and they must see the rebuilt
@@ -198,6 +210,16 @@ class PhysicalScheduler(Scheduler):
 
         if policy.name != "shockwave":
             threading.Thread(target=self._allocation_thread, daemon=True).start()
+        elif self._config.pipelined_planning:
+            # Background MILP solve thread: _begin_round kicks a
+            # prepared request, _mid_round commits the result (or the
+            # planner serves its deadline fallback). The solve itself
+            # runs OFF the scheduler lock, so the round pipeline and
+            # every RPC handler stay responsive through a full-budget
+            # solve.
+            self._shockwave_planner.pipelined = True
+            threading.Thread(target=self._planner_solve_loop,
+                             daemon=True).start()
         if self._config.heartbeat_interval_s:
             threading.Thread(target=self._liveness_loop, daemon=True).start()
 
@@ -1067,6 +1089,66 @@ class PhysicalScheduler(Scheduler):
                 self._cv.notify_all()
 
     # ------------------------------------------------------------------
+    # Pipelined planning (shockwave policy only)
+    # ------------------------------------------------------------------
+
+    def _planner_solve_loop(self):
+        """Background MILP solver: waits for a prepared request, solves
+        it OUTSIDE the scheduler lock, and parks the result for the
+        round loop to commit at the next re-solve point."""
+        while not self._done_event.is_set():
+            with self._cv:
+                while self._planner_request is None:
+                    self._cv.wait(timeout=1.0)
+                    if self._done_event.is_set():
+                        return
+                request = self._planner_request
+                self._planner_request = None
+            try:
+                result = self._shockwave_planner.solve_prepared(
+                    request, pipelined=True)
+            except Exception:  # noqa: BLE001 - the solve thread is a
+                # singleton: if a pathological instance kills it, every
+                # later re-solve round would fall back forever. Drop
+                # this request (the planner serves its cached schedule)
+                # and keep the thread alive for the next kick.
+                self.log.exception("pipelined planner solve failed; "
+                                   "round will use the cached schedule")
+                result = None
+            with self._cv:
+                if result is not None:
+                    self._planner_result = result
+                self._planner_busy = False
+                self._cv.notify_all()
+
+    @requires_lock
+    def _commit_planner_result(self):
+        """Install a finished background solve into the planner (round
+        loop thread, under the lock)."""
+        if self._planner_result is not None:
+            self._shockwave_planner.commit_result(self._planner_result)
+            self._planner_result = None
+
+    @requires_lock
+    def _maybe_kick_planner_solve(self):
+        """At round start: if this round's re-solve point needs a fresh
+        schedule, snapshot the inputs NOW and hand them to the solve
+        thread, so the solve wall overlaps round execution."""
+        planner = self._shockwave_planner
+        if planner is None or not planner.pipelined:
+            return
+        self._commit_planner_result()
+        if (self._planner_busy or self._is_final_round()
+                or not planner.needs_resolve()):
+            return
+        request = planner.prepare_solve()
+        if request is None:
+            return
+        self._planner_request = request
+        self._planner_busy = True
+        self._cv.notify_all()
+
+    # ------------------------------------------------------------------
     # Round pipeline
     # ------------------------------------------------------------------
 
@@ -1218,6 +1300,7 @@ class PhysicalScheduler(Scheduler):
     @requires_lock
     def _begin_round(self):
         self._current_round_start_time = self.get_current_timestamp()
+        self._maybe_kick_planner_solve()
         for job_id in self.rounds.current_assignments:
             for m in job_id.singletons():
                 self._lease_update_requests[m] = []
@@ -1246,6 +1329,13 @@ class PhysicalScheduler(Scheduler):
         round_id = self.rounds.num_completed_rounds
 
         with self._obs.phase(obs_names.SPAN_SOLVE, round=round_id):
+            # Pipelined planning: the MILP ran on the background thread
+            # since round start; commit it here if it finished (the
+            # planner serves its deadline fallback otherwise), so this
+            # phase span now measures selection + assignment, not the
+            # solve wall.
+            if self._shockwave_planner is not None:
+                self._commit_planner_result()
             self.rounds.next_assignments = self._schedule_jobs_on_workers()
 
         for job_id in self.rounds.current_assignments:
@@ -1510,6 +1600,16 @@ class PhysicalScheduler(Scheduler):
             if self._policy.name != "shockwave":
                 while self._need_to_update_allocation:
                     self._cv.wait()
+            planner = self._shockwave_planner
+            if (planner is not None and planner.pipelined
+                    and planner.needs_resolve()):
+                # Startup solve, inline: no round is executing yet, so
+                # there is nothing to overlap with — solve before the
+                # first dispatch rather than running round 0 on the
+                # backfill fallback.
+                request = planner.prepare_solve()
+                if request is not None:
+                    planner.commit_result(planner.solve_prepared(request))
             self.rounds.current_assignments = self._schedule_jobs_on_workers()
             if self._shockwave_planner is not None:
                 self._shockwave_planner.increment_round()
@@ -1526,7 +1626,11 @@ class PhysicalScheduler(Scheduler):
             with self._cv:
                 self._mid_round()
                 if self._shockwave_planner is not None:
-                    extended = copy.deepcopy(self.rounds.extended_leases)
+                    # Set of immutable JobIdPairs consumed for membership
+                    # only — a shallow set copy isolates it from
+                    # _finish_round's discard()s; deepcopy did the same
+                    # job with per-element memoization overhead.
+                    extended = set(self.rounds.extended_leases)
                 self._end_round()
                 if self._shockwave_planner is not None:
                     self._update_shockwave_planner_physical(extended)

@@ -16,7 +16,6 @@ placement so unchanged assignments can become lease extensions.
 from __future__ import annotations
 
 import collections
-import copy
 import heapq
 import logging
 import math
@@ -145,6 +144,20 @@ class SchedulerConfig:
     # retained interval is the previous snapshot's replay tail). 0
     # disables snapshots (journal grows without bound).
     snapshot_interval_rounds: int = 10
+    # ---- planner pipelining (physical mode; see README "Planner
+    # performance") ----
+    # Run the Shockwave MILP on a background solve thread, kicked at
+    # round start for the round's re-solve point, so the solve wall
+    # overlaps round execution instead of blocking `_mid_round` under
+    # the scheduler lock. With pipelining on, physical mode no longer
+    # clamps `solver_budget_cap_rounds` to 0.5 — the solver gets its
+    # full `timeout x njobs/120` budget (bounded by the config cap,
+    # default 2.0 rounds) and a solve that misses the re-solve round
+    # falls back to the cached schedule + work-conserving backfill
+    # (planner._fallback_round_schedule) instead of stalling the round.
+    # Simulation ignores this flag entirely (solves stay inline and
+    # bit-identical).
+    pipelined_planning: bool = True
     # ---- observability (physical mode; see README "Observability") ----
     # HTTP port serving /metrics (Prometheus text) + /healthz (JSON).
     # 0 binds an ephemeral port (read PhysicalScheduler.obs_port);
@@ -299,26 +312,34 @@ class Scheduler:
             sw = dict(self._config.shockwave or {})
             sw.setdefault("time_per_iteration", self._time_per_iteration)
             if not simulate:
-                # solver_budget_cap_rounds is simulation-only: a physical
-                # round loop must never stall on a hard MILP instance, so
-                # the per-solve bound is clamped to the half-round default
-                # regardless of what the config ships. A config shipping
-                # null means "use the default"; anything non-numeric is a
-                # config error, reported as such rather than a bare
-                # TypeError out of the comparison below.
-                cap = sw.get("solver_budget_cap_rounds", 0.5)
+                # Physical-mode solve budget. With pipelined planning
+                # (default) the solve runs on a background thread and a
+                # late result degrades to the cached-schedule fallback,
+                # so a hard instance can never stall the round loop —
+                # the solver gets its full budget (default cap 2.0
+                # rounds, the setting that eliminated greedy fallbacks
+                # at 256 chips in EXPERIMENTS.md). With pipelining
+                # DISABLED the solve blocks `_mid_round` under the
+                # scheduler lock, so the historical half-round clamp
+                # applies regardless of what the config ships. A config
+                # shipping null means "use the mode default"; anything
+                # non-numeric is a config error, reported as such rather
+                # than a bare TypeError out of the comparison below.
+                pipelined = self._config.pipelined_planning
+                cap = sw.get("solver_budget_cap_rounds",
+                             2.0 if pipelined else 0.5)
                 if cap is None:
-                    cap = 0.5
+                    cap = 2.0 if pipelined else 0.5
                 try:
                     cap = float(cap)
                 except (TypeError, ValueError):
                     raise ValueError(
                         "config error: solver_budget_cap_rounds must be a "
                         f"number (rounds) or null, got {cap!r}") from None
-                if cap > 0.5:
+                if not pipelined and cap > 0.5:
                     self.log.warning(
                         "clamping solver_budget_cap_rounds %.2f -> 0.5 "
-                        "(physical mode)", cap)
+                        "(physical mode without pipelined planning)", cap)
                     cap = 0.5
                 sw["solver_budget_cap_rounds"] = cap
             self._shockwave_planner = ShockwavePlanner.from_config(sw)
@@ -1029,7 +1050,15 @@ class Scheduler:
             "num_steps_remaining": num_steps_remaining,
             "times_since_start": {
                 j: now - a.start_timestamps[j] for j in a.jobs},
-            "throughputs": copy.deepcopy(self._throughputs),
+            # Explicit two-level copy (pair entries hold [a, b] lists the
+            # EMA mutates in place) instead of deepcopy: this snapshot is
+            # rebuilt every allocation solve and deepcopy's memo
+            # machinery dominated it at scale. JobIdPair keys and the
+            # scalar rates are immutable and safely shared.
+            "throughputs": {
+                job_id: {wt: (list(v) if isinstance(v, list) else v)
+                         for wt, v in per_wt.items()}
+                for job_id, per_wt in self._throughputs.items()},
             "per_round_schedule": list(self.rounds.per_round_schedule),
             "cluster_spec": dict(self.workers.cluster_spec),
             "instance_costs": self._config.per_worker_type_prices,
@@ -1163,7 +1192,11 @@ class Scheduler:
         for wt in worker_types:
             scheduled[wt].sort(key=lambda x: x[1], reverse=True)
             state = {
-                "servers": copy.deepcopy(self.workers.type_to_server_ids[wt]),
+                # _take_workers pops chips off the inner server lists, so
+                # copy both levels — but they are plain lists of ints, and
+                # deepcopy here ran every round on the hot path.
+                "servers": [list(s)
+                            for s in self.workers.type_to_server_ids[wt]],
                 "assigned": set(),
                 "ptr": 0,
             }

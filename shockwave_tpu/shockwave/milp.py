@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import logging
 import math
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
@@ -83,6 +85,15 @@ class SolveStats:
     # around _solve: the round loop degraded to the next fallback arm
     # instead of dying. "<ExcType>: <msg>" of the last raise, else None.
     error: Optional[str] = None
+    # Assembly/solve wall split: time spent building the sparse model
+    # (structure splice + COO->CSR), included in wall_s. Proves where
+    # the wall went (the scale pickles previously could not distinguish
+    # a slow solver from a slow model build).
+    assembly_s: float = 0.0
+    # True when this solve ran on the background planner thread
+    # (physical pipelined planning) instead of the round-loop critical
+    # path.
+    pipelined: bool = False
 
 
 def finish_time_momentumed_average(series, round_index, momentum=0.9) -> float:
@@ -101,6 +112,53 @@ def finish_time_momentumed_average(series, round_index, momentum=0.9) -> float:
     return momentum * running + (1.0 - momentum) * values[-1]
 
 
+def finish_time_momentumed_averages(series_list, round_index,
+                                    momentum=0.9) -> List[float]:
+    """Vectorized `finish_time_momentumed_average` over all jobs.
+
+    plan_schedule calls the scalar version once per job per solve; at
+    900 jobs that rebuilds ~900 tiny numpy arrays per re-solve. Series
+    grow in lockstep (every estimate refresh appends to every active
+    job), so batching by length turns the whole pass into a handful of
+    2D diff/divide/accumulate calls.
+
+    Bit-identical to the scalar version by construction: elementwise
+    ops reassociate nothing, and the weighted sum uses
+    ``np.add.accumulate`` (strictly sequential prefix sums — the same
+    left-to-right association as the scalar ``sum()``), never
+    ``np.sum`` (pairwise). Returns python floats so downstream
+    ``ratio ** power`` overflow behavior (OverflowError, caught in
+    _relaxation_priorities) is preserved — numpy scalars would yield
+    inf silently.
+    """
+    out: List[float] = [0.0] * len(series_list)
+    by_len: dict = {}
+    for i, series in enumerate(series_list):
+        assert len(series) > 0
+        by_len.setdefault(len(series), []).append(i)
+    for length, idxs in by_len.items():
+        arr = np.asarray([series_list[i] for i in idxs],
+                         dtype=np.float64)               # (G, L, 2)
+        values = arr[:, :, 1]
+        rounds = np.concatenate(
+            [arr[:, :, 0],
+             np.full((len(idxs), 1), round_index, dtype=np.float64)],
+            axis=1)
+        windows = np.diff(rounds, axis=1)                # (G, L)
+        totals = windows.sum(axis=1)
+        degenerate = totals == 0
+        safe_totals = np.where(degenerate, 1.0, totals)
+        probs = windows / safe_totals[:, None]
+        running = np.add.accumulate(probs * values, axis=1)[:, -1]
+        # All-zero windows: the scalar version collapses probs to [1.0]
+        # and the weighted sum reduces to the first value.
+        running = np.where(degenerate, values[:, 0], running)
+        blended = momentum * running + (1.0 - momentum) * values[:, -1]
+        for g, i in enumerate(idxs):
+            out[i] = float(blended[g])
+    return out
+
+
 class _Layout:
     """Variable indexing for the MILP."""
 
@@ -117,6 +175,284 @@ class _Layout:
     def s(self, j): return j * self.stride + self.R + 1 + 2 * self.B
     @property
     def t(self): return self.n - 1
+
+
+class _ShapeStructure:
+    """Structurally-static assembly pattern for one (njobs, R, B) shape.
+
+    Every COO row/col index of the EG model, the constant coefficient
+    values, the b-vector constants, integrality and variable bounds
+    depend only on the shape — not on the per-solve data — so they are
+    built once here (vectorized) and cached (`_structure_for`). A solve
+    then only splices the data that changes (nworkers, durations,
+    dirichlet, progress, ftf caps, priorities) into preallocated slots:
+    see _InstanceAssembler.
+
+    Two row numberings coexist: the FTF variant appends one extra
+    inequality row per job *inside* that job's block, shifting every
+    later row, so both variants' row arrays are materialized.
+    """
+
+    def __init__(self, njobs: int, R: int, B: int):
+        self.njobs, self.R, self.B = njobs, R, B
+        stride = R + 1 + 2 * B + 1
+        self.stride = stride
+        self.n = njobs * stride + 1
+        self.t = self.n - 1
+        nadj = (B - 2) * (B - 1) // 2 if B > 2 else 0
+        self.nadj = nadj
+
+        j = np.arange(njobs, dtype=np.int64)
+        b = np.arange(B, dtype=np.int64)
+        r = np.arange(R, dtype=np.int64)
+        jcol = j * stride
+        self.x_cols = jcol[:, None] + r[None, :]          # (njobs, R)
+        self.p_cols = jcol + R
+        self.w_cols = jcol[:, None] + (R + 1) + b[None, :]  # (njobs, B)
+        self.z_cols = self.w_cols + B
+        self.s_cols = jcol + R + 1 + 2 * B
+
+        # Adjacency pair offsets (lo, hi) with hi >= lo + 2, lo-major —
+        # the loop order of the reference assembler.
+        lo, hi = [], []
+        for lo_i in range(B - 2):
+            for hi_i in range(lo_i + 2, B):
+                lo.append(lo_i)
+                hi.append(hi_i)
+        lo_a = np.asarray(lo, dtype=np.int64)
+        hi_a = np.asarray(hi, dtype=np.int64)
+
+        # ---- common A_ub column pattern (concatenation order fixed) --
+        # cap:    R rows x njobs entries      (vals <- nworkers)
+        # run-p:  1 row/job, p entry          (vals <- durations)
+        # run-x:  same rows, R entries        (vals <- -round_duration)
+        # wz-w /  B rows/job, w then z entry  (vals 1 / -1)
+        # wz-z
+        # sumz:   1 row/job, B entries        (vals 1)
+        # adj-lo/ nadj rows/job, two entries  (vals 1)
+        # adj-hi
+        # rem-s:  1 row/job, s entry          (vals -1)
+        # rem-p:  same rows, p entry          (vals <- -durations)
+        # mk-s:   1 row/job, s entry          (vals 1)
+        # mk-t:   same rows, t entry          (vals -1)
+        cols = [
+            np.tile(jcol, R) + np.repeat(r, njobs),       # cap
+            self.p_cols,                                  # run-p
+            self.x_cols.ravel(),                          # run-x
+            self.w_cols.ravel(),                          # wz-w
+            self.z_cols.ravel(),                          # wz-z
+            self.z_cols.ravel(),                          # sumz
+            (jcol[:, None] + (R + 1 + B) + lo_a[None, :]).ravel(),
+            (jcol[:, None] + (R + 1 + B) + hi_a[None, :]).ravel(),
+            self.s_cols,                                  # rem-s
+            self.p_cols,                                  # rem-p
+            self.s_cols,                                  # mk-s
+            np.full(njobs, self.t, dtype=np.int64),       # mk-t
+        ]
+        sizes = [c.size for c in cols]
+        self.cols_common = np.concatenate(cols)
+        offsets = np.cumsum([0] + sizes)
+        sl = [slice(offsets[i], offsets[i + 1]) for i in range(len(sizes))]
+        (self.sl_cap, self.sl_runp, self.sl_runx, self.sl_wzw,
+         self.sl_wzz, self.sl_sumz, self.sl_adjlo, self.sl_adjhi,
+         self.sl_rems, self.sl_remp, self.sl_mks, self.sl_mkt) = sl
+
+        # Constant coefficients pre-filled; per-solve slots overwritten
+        # by the assembler (cap / run-p / run-x / rem-p).
+        tmpl = np.empty(self.cols_common.size, dtype=np.float64)
+        tmpl[self.sl_wzw] = 1.0
+        tmpl[self.sl_wzz] = -1.0
+        tmpl[self.sl_sumz] = 1.0
+        tmpl[self.sl_adjlo] = 1.0
+        tmpl[self.sl_adjhi] = 1.0
+        tmpl[self.sl_rems] = -1.0
+        tmpl[self.sl_mks] = 1.0
+        tmpl[self.sl_mkt] = -1.0
+        self.vals_template = tmpl
+
+        # ---- row numbering for both variants ------------------------
+        def rows_for(block):
+            base = R + j * block
+            parts = [
+                np.repeat(r, njobs),                      # cap rows
+                base,                                     # run-p
+                np.repeat(base, R),                       # run-x
+                (base[:, None] + 1 + b[None, :]).ravel(),  # wz-w
+                (base[:, None] + 1 + b[None, :]).ravel(),  # wz-z
+                np.repeat(base + 1 + B, B),               # sumz
+                (base[:, None] + B + 2
+                 + np.arange(nadj, dtype=np.int64)[None, :]).ravel(),
+                (base[:, None] + B + 2
+                 + np.arange(nadj, dtype=np.int64)[None, :]).ravel(),
+                base + B + 2 + nadj,                      # rem-s
+                base + B + 2 + nadj,                      # rem-p
+                base + B + 3 + nadj,                      # mk-s
+                base + B + 3 + nadj,                      # mk-t
+            ]
+            return np.concatenate(parts), base
+
+        block_relaxed = B + nadj + 4
+        block_ftf = block_relaxed + 1
+        self.rows_relaxed, base_r = rows_for(block_relaxed)
+        rows_ftf_common, base_f = rows_for(block_ftf)
+        self.ftf_rows = base_f + B + 4 + nadj
+        self.rows_ftf = np.concatenate([rows_ftf_common, self.ftf_rows])
+        self.cols_ftf = np.concatenate([self.cols_common, self.s_cols])
+        self.nrows_relaxed = R + njobs * block_relaxed
+        self.nrows_ftf = R + njobs * block_ftf
+
+        # b_ub templates (constants filled; ngpus / dirichlet / ftf caps
+        # spliced per solve). Row index arrays for the spliced slots.
+        def b_template(base, nrows):
+            tmpl = np.zeros(nrows, dtype=np.float64)
+            tmpl[base + 1 + B] = 2.0                      # sumz
+            adj_rows = (base[:, None] + B + 2
+                        + np.arange(nadj, dtype=np.int64)[None, :]).ravel()
+            tmpl[adj_rows] = 1.0
+            return tmpl, base + B + 2 + nadj              # rem rows
+
+        self.b_template_relaxed, self.rem_rows_relaxed = b_template(
+            base_r, self.nrows_relaxed)
+        self.b_template_ftf, self.rem_rows_ftf = b_template(
+            base_f, self.nrows_ftf)
+
+        # ---- equality pattern ----------------------------------------
+        # Per job: row 2j (log cursor), row 2j+1 (sum w = 1).
+        self.eq_rows = np.concatenate([
+            np.repeat(2 * j, B),                          # cursor-w
+            2 * j,                                        # cursor-p
+            np.repeat(2 * j + 1, B),                      # sumw
+        ])
+        self.eq_cols = np.concatenate([
+            self.w_cols.ravel(), self.p_cols, self.w_cols.ravel()])
+        self.sl_eq_bases = slice(0, njobs * B)
+        self.sl_eq_p = slice(njobs * B, njobs * B + njobs)
+        eq_tmpl = np.empty(self.eq_cols.size, dtype=np.float64)
+        eq_tmpl[njobs * B + njobs:] = 1.0                 # sumw entries
+        self.vals_eq_template = eq_tmpl
+        self.nrows_eq = 2 * njobs
+
+        # ---- integrality / bounds (pure shape) -----------------------
+        integrality = np.zeros(self.n)
+        ub = np.full(self.n, np.inf)
+        integrality[self.x_cols.ravel()] = 1
+        integrality[self.z_cols.ravel()] = 1
+        ub[self.x_cols.ravel()] = 1
+        ub[self.z_cols.ravel()] = 1
+        ub[self.w_cols.ravel()] = 1
+        self.integrality = integrality
+        self.ub = ub
+
+
+_STRUCTURE_CACHE: "OrderedDict[tuple, _ShapeStructure]" = OrderedDict()
+_STRUCTURE_CACHE_MAX = 8
+_STRUCTURE_LOCK = threading.Lock()
+
+
+def _structure_for(njobs: int, R: int, B: int) -> _ShapeStructure:
+    """LRU-cached shape structure. njobs shrinks as the trace drains, so
+    a handful of recent shapes covers the REOPT_ROUNDS solve cadence."""
+    key = (njobs, R, B)
+    with _STRUCTURE_LOCK:
+        cached = _STRUCTURE_CACHE.get(key)
+        if cached is not None:
+            _STRUCTURE_CACHE.move_to_end(key)
+            return cached
+    built = _ShapeStructure(njobs, R, B)
+    with _STRUCTURE_LOCK:
+        _STRUCTURE_CACHE[key] = built
+        _STRUCTURE_CACHE.move_to_end(key)
+        while len(_STRUCTURE_CACHE) > _STRUCTURE_CACHE_MAX:
+            _STRUCTURE_CACHE.popitem(last=False)
+    return built
+
+
+class _InstanceAssembler:
+    """Per-solve model assembly over the cached shape structure.
+
+    One assembler is built per plan_schedule call and SHARED between
+    the FTF attempt and the relax fallback: the equality block and the
+    common inequality values are spliced once; each variant then only
+    differs by its row numbering (cached structure), its b vector, and
+    the objective (priorities). Produces matrices byte-identical to the
+    historical pure-python loop assembler (golden-equivalence suite in
+    tests/test_milp_assembly.py keeps the loop oracle).
+    """
+
+    def __init__(self, S: _ShapeStructure, bases, base_logs, nworkers,
+                 durations, dirichlet, progress, epochs, ftf_caps,
+                 round_duration: float, ngpus: int, k: float):
+        self.S = S
+        self.base_logs = np.asarray(base_logs, dtype=np.float64)
+        self.ngpus = ngpus
+        self.k = k
+        self.ftf_caps = np.asarray(ftf_caps, dtype=np.float64)
+        self.ftf_infeasible = bool(np.any(self.ftf_caps < 0))
+        durations_f = np.asarray(durations, dtype=np.float64)
+        self.dirichlet = np.asarray(dirichlet, dtype=np.float64)
+
+        vals = S.vals_template.copy()
+        vals[S.sl_cap] = np.tile(
+            np.asarray(nworkers, dtype=np.float64), S.R)
+        vals[S.sl_runp] = durations_f
+        vals[S.sl_runx] = -round_duration
+        vals[S.sl_remp] = -durations_f
+        self._vals_common = vals
+
+        vals_eq = S.vals_eq_template.copy()
+        vals_eq[S.sl_eq_bases] = np.tile(
+            np.asarray(bases, dtype=np.float64), S.njobs)
+        epochs_f = np.asarray(epochs, dtype=np.float64)
+        vals_eq[S.sl_eq_p] = -1.0 / epochs_f
+        self.A_eq = sparse.coo_matrix(
+            (vals_eq, (S.eq_rows, S.eq_cols)),
+            shape=(S.nrows_eq, S.n)).tocsr()
+        self.b_eq = np.zeros(S.nrows_eq)
+        self.b_eq[0::2] = np.asarray(progress, dtype=np.float64) / epochs_f
+        self.b_eq[1::2] = 1.0
+
+        self._A_ub = {}  # variant -> CSR, built lazily, reused per arm
+        self._b_ub = {}
+
+    def _inequalities(self, with_ftf: bool):
+        S = self.S
+        cached = self._A_ub.get(with_ftf)
+        if cached is None:
+            if with_ftf:
+                vals = np.concatenate(
+                    [self._vals_common, np.ones(S.njobs)])
+                cached = sparse.coo_matrix(
+                    (vals, (S.rows_ftf, S.cols_ftf)),
+                    shape=(S.nrows_ftf, S.n)).tocsr()
+                b = S.b_template_ftf.copy()
+                b[:S.R] = self.ngpus
+                b[S.rem_rows_ftf] = -self.dirichlet
+                b[S.ftf_rows] = self.ftf_caps
+            else:
+                cached = sparse.coo_matrix(
+                    (self._vals_common, (S.rows_relaxed, S.cols_common)),
+                    shape=(S.nrows_relaxed, S.n)).tocsr()
+                b = S.b_template_relaxed.copy()
+                b[:S.R] = self.ngpus
+                b[S.rem_rows_relaxed] = -self.dirichlet
+            self._A_ub[with_ftf] = cached
+            self._b_ub[with_ftf] = b
+        return cached, self._b_ub[with_ftf]
+
+    def model(self, priorities, with_ftf: bool):
+        """(c, A_ub, b_ub, A_eq, b_eq, integrality, ub) for one arm, or
+        None when with_ftf and the caps are provably infeasible."""
+        if with_ftf and self.ftf_infeasible:
+            return None
+        S = self.S
+        A_ub, b_ub = self._inequalities(with_ftf)
+        c = np.zeros(S.n)
+        c[S.w_cols.ravel()] = (
+            (-np.asarray(priorities, dtype=np.float64))[:, None]
+            * self.base_logs[None, :] / (S.njobs * S.R)).ravel()
+        c[S.t] = self.k
+        return (c, A_ub, b_ub, self.A_eq, self.b_eq,
+                S.integrality.copy(), S.ub.copy())
 
 
 class _FailedSolve:
@@ -158,16 +494,20 @@ def _solve(c, A_ub, b_ub, A_eq, b_eq, integrality, ub, opts: MilpOptions,
 def plan_schedule(jobs, round_index: int, future_nrounds: int,
                   round_duration: float, ngpus: int, share_series: List[list],
                   opts: MilpOptions,
-                  stats_out: Optional[list] = None) -> np.ndarray:
+                  stats_out: Optional[list] = None,
+                  pipelined: bool = False) -> np.ndarray:
     """Returns a boolean (njobs x future_nrounds) schedule matrix.
 
     With `stats_out`, appends one SolveStats record describing which
     arm of the fallback chain produced the schedule and the solver's
-    achieved quality (status / MIP gap / wall time)."""
+    achieved quality (status / MIP gap / wall time, with the
+    assembly/solve split). `pipelined` is caller-provided provenance:
+    True when this call runs on the background planner thread."""
     import time as _time
     # Solve wall time is telemetry riding a journaled SolveStats record:
     # replay reads the journaled outcome, never re-times the solve.
     _t0 = _time.monotonic()  # swtpu-check: ignore[determinism]
+    _assembly = [0.0]
 
     def _record(path, res=None, ftf_infeasible=False):
         if stats_out is not None:
@@ -180,7 +520,9 @@ def plan_schedule(jobs, round_index: int, future_nrounds: int,
                 mip_gap=None if gap is None else float(gap),
                 ftf_infeasible=ftf_infeasible,
                 error=getattr(res, "error", None) if res is not None
-                else None))
+                else None,
+                assembly_s=round(_assembly[0], 4),
+                pipelined=pipelined))
     njobs = len(jobs)
     bases = list(opts.logapx_bases)
     assert bases[0] == 0.0
@@ -195,76 +537,28 @@ def plan_schedule(jobs, round_index: int, future_nrounds: int,
 
     future_share = min(1.0, ngpus / njobs)
     next_sched_time = round_duration * (round_index + future_nrounds)
-    runavg = [finish_time_momentumed_average(share_series[j], round_index)
-              for j in range(njobs)]
+    runavg = finish_time_momentumed_averages(share_series, round_index)
     ftf_caps = [(opts.rhomax * runavg[j] - next_sched_time) * future_share
                 for j in range(njobs)]
 
+    # Vectorized incremental assembly: structure cached per shape, one
+    # shared per-solve assembler across both fallback arms (the
+    # historical pure-python loop assembler rebuilt the whole COO model
+    # from scratch per arm — O(njobs * R * B^2) list appends; the loop
+    # oracle survives in tests/test_milp_assembly.py as the
+    # golden-equivalence reference).
+    _a0 = _time.monotonic()  # swtpu-check: ignore[determinism]
+    assembler = _InstanceAssembler(
+        _structure_for(njobs, future_nrounds, len(bases)),
+        bases, base_logs, nworkers, durations, dirichlet, progress,
+        epochs, ftf_caps, round_duration, ngpus, opts.k)
+    _assembly[0] += _time.monotonic() - _a0  # swtpu-check: ignore[determinism]
+
     def assemble(priorities, with_ftf: bool):
-        rows_ub, cols_ub, vals_ub, b_ub = [], [], [], []
-        rows_eq, cols_eq, vals_eq, b_eq = [], [], [], []
-
-        def add_ub(entries, rhs):
-            r = len(b_ub)
-            for col, val in entries:
-                rows_ub.append(r); cols_ub.append(col); vals_ub.append(val)
-            b_ub.append(rhs)
-
-        def add_eq(entries, rhs):
-            r = len(b_eq)
-            for col, val in entries:
-                rows_eq.append(r); cols_eq.append(col); vals_eq.append(val)
-            b_eq.append(rhs)
-
-        # Capacity per round.
-        for r in range(future_nrounds):
-            add_ub([(L.x(j, r), nworkers[j]) for j in range(njobs)], ngpus)
-
-        for j in range(njobs):
-            # Planned runtime bounded by scheduled rounds.
-            add_ub([(L.p(j), durations[j])]
-                   + [(L.x(j, r), -round_duration) for r in range(future_nrounds)], 0.0)
-            # Log approximation cursor.
-            add_eq([(L.w(j, b), bases[b]) for b in range(L.B)]
-                   + [(L.p(j), -1.0 / epochs[j])], progress[j] / epochs[j])
-            add_eq([(L.w(j, b), 1.0) for b in range(L.B)], 1.0)
-            for b in range(L.B):
-                add_ub([(L.w(j, b), 1.0), (L.z(j, b), -1.0)], 0.0)
-            add_ub([(L.z(j, b), 1.0) for b in range(L.B)], 2.0)
-            for lo in range(L.B - 2):
-                for hi in range(lo + 2, L.B):
-                    add_ub([(L.z(j, lo), 1.0), (L.z(j, hi), 1.0)], 1.0)
-            # Remaining runtime after plan.
-            add_ub([(L.s(j), -1.0), (L.p(j), -durations[j])], -dirichlet[j])
-            # Makespan regularizer linkage.
-            add_ub([(L.s(j), 1.0), (L.t, -1.0)], 0.0)
-            if with_ftf:
-                if ftf_caps[j] < 0:
-                    return None  # provably infeasible
-                add_ub([(L.s(j), 1.0)], ftf_caps[j])
-
-        A_ub = sparse.coo_matrix((vals_ub, (rows_ub, cols_ub)),
-                                 shape=(len(b_ub), L.n)).tocsr()
-        A_eq = sparse.coo_matrix((vals_eq, (rows_eq, cols_eq)),
-                                 shape=(len(b_eq), L.n)).tocsr()
-
-        c = np.zeros(L.n)
-        for j in range(njobs):
-            for b in range(L.B):
-                c[L.w(j, b)] = -priorities[j] * base_logs[b] / (njobs * future_nrounds)
-        c[L.t] = opts.k
-
-        integrality = np.zeros(L.n)
-        ub = np.full(L.n, np.inf)
-        for j in range(njobs):
-            for r in range(future_nrounds):
-                integrality[L.x(j, r)] = 1
-                ub[L.x(j, r)] = 1
-            for b in range(L.B):
-                integrality[L.z(j, b)] = 1
-                ub[L.z(j, b)] = 1
-                ub[L.w(j, b)] = 1
-        return c, A_ub, np.array(b_ub), A_eq, np.array(b_eq), integrality, ub
+        _a0 = _time.monotonic()  # swtpu-check: ignore[determinism]
+        model = assembler.model(priorities, with_ftf)
+        _assembly[0] += _time.monotonic() - _a0  # swtpu-check: ignore[determinism]
+        return model
 
     # The reference gives Gurobi a flat 15 s on 24 threads
     # (configurations/*.json); single-threaded HiGHS needs the budget to
@@ -328,11 +622,10 @@ def plan_schedule(jobs, round_index: int, future_nrounds: int,
 
 
 def _extract(xvec, L, njobs, nrounds) -> np.ndarray:
-    out = np.zeros((njobs, nrounds), dtype=bool)
-    for j in range(njobs):
-        for r in range(nrounds):
-            out[j, r] = round(xvec[L.x(j, r)]) == 1
-    return out
+    # np.rint rounds half-to-even exactly like the historical per-entry
+    # python round(); one gather instead of njobs*R indexing calls.
+    idx = (np.arange(njobs) * L.stride)[:, None] + np.arange(nrounds)
+    return np.rint(np.asarray(xvec)[idx]) == 1
 
 
 def _relaxation_priorities(jobs, dirichlet, runavg, round_index,
@@ -380,6 +673,39 @@ def _relaxation_priorities(jobs, dirichlet, runavg, round_index,
     return priorities
 
 
+def _rank_model(x: np.ndarray, priorities, nworkers, ngpus):
+    """Vectorized assembly of the rank-in-schedule model:
+    (c, A_ub, b_ub, A_eq, b_eq). Same matrices the historical loop
+    assembler produced (oracle kept in tests/test_milp_assembly.py)."""
+    njobs, nrounds = x.shape
+    counts = x.sum(axis=1)
+    n = njobs * nrounds
+    j = np.arange(njobs, dtype=np.int64)
+    r = np.arange(nrounds, dtype=np.int64)
+
+    rows_ub = np.repeat(r, njobs)
+    cols_ub = np.tile(j * nrounds, nrounds) + rows_ub
+    vals_ub = np.tile(np.asarray(nworkers, dtype=np.float64), nrounds)
+    b_ub = np.full(nrounds, ngpus, dtype=np.float64)
+
+    rows_eq = np.repeat(j, nrounds)
+    cols_eq = np.arange(n, dtype=np.int64)
+    vals_eq = np.ones(n)
+    b_eq = counts.astype(np.float64)
+
+    counts_f = counts.astype(np.float64)
+    c = (np.asarray(priorities, dtype=np.float64)[:, None]
+         * r.astype(np.float64)[None, :])
+    np.divide(c, counts_f[:, None], out=c, where=counts_f[:, None] > 0)
+    c[counts == 0, :] = 0.0
+
+    A_ub = sparse.coo_matrix((vals_ub, (rows_ub, cols_ub)),
+                             shape=(nrounds, n)).tocsr()
+    A_eq = sparse.coo_matrix((vals_eq, (rows_eq, cols_eq)),
+                             shape=(njobs, n)).tocsr()
+    return c.ravel(), A_ub, b_ub, A_eq, b_eq
+
+
 def _rank_in_schedule(x: np.ndarray, priorities, nworkers, ngpus,
                       opts: MilpOptions,
                       time_limit: Optional[float] = None) -> np.ndarray:
@@ -393,36 +719,14 @@ def _rank_in_schedule(x: np.ndarray, priorities, nworkers, ngpus,
         return x
 
     n = njobs * nrounds
-    rows_ub, cols_ub, vals_ub, b_ub = [], [], [], []
-    rows_eq, cols_eq, vals_eq, b_eq = [], [], [], []
-    for r in range(nrounds):
-        row = len(b_ub)
-        for j in range(njobs):
-            rows_ub.append(row); cols_ub.append(j * nrounds + r)
-            vals_ub.append(nworkers[j])
-        b_ub.append(ngpus)
-    for j in range(njobs):
-        row = len(b_eq)
-        for r in range(nrounds):
-            rows_eq.append(row); cols_eq.append(j * nrounds + r); vals_eq.append(1.0)
-        b_eq.append(float(counts[j]))
-
-    c = np.zeros(n)
-    for j in range(njobs):
-        if counts[j] > 0:
-            for r in range(nrounds):
-                c[j * nrounds + r] = priorities[j] * r / counts[j]
+    c, A_ub, b_ub, A_eq, b_eq = _rank_model(x, priorities, nworkers, ngpus)
 
     try:
         res = milp(
             c,
             constraints=[
-                LinearConstraint(
-                    sparse.coo_matrix((vals_ub, (rows_ub, cols_ub)), shape=(len(b_ub), n)).tocsr(),
-                    -np.inf, np.array(b_ub)),
-                LinearConstraint(
-                    sparse.coo_matrix((vals_eq, (rows_eq, cols_eq)), shape=(len(b_eq), n)).tocsr(),
-                    np.array(b_eq), np.array(b_eq)),
+                LinearConstraint(A_ub, -np.inf, b_ub),
+                LinearConstraint(A_eq, b_eq, b_eq),
             ],
             integrality=np.ones(n),
             bounds=Bounds(np.zeros(n), np.ones(n)),

@@ -3,11 +3,30 @@
 Wraps the EG MILP (milp.py) with: uniform-share finish-time estimation,
 schedule caching between re-solves, and work-conserving backfill of idle
 chips (reference: scheduler/shockwave.py:20-285).
+
+The solve is split into three phases so the physical scheduler can
+pipeline it off the round-loop critical path (the same pattern as its
+`_allocation_thread`):
+
+- `prepare_solve()` — under the scheduler lock: refresh estimates and
+  snapshot every solve input into an immutable PlanRequest (per-job
+  `_JobView`s, copied share series).
+- `solve_prepared(request)` — lock-free: the MILP + schedule
+  construction, a pure function of the request.
+- `commit_result(result)` — under the lock: install the schedules,
+  record telemetry, journal the solve outcome.
+
+The simulator runs all three inline inside `round_schedule()` (single
+thread, bit-identical to the historical monolithic path); the physical
+scheduler runs the middle phase on a background thread and falls back
+to the cached schedule / work-conserving backfill when the solve is not
+done at the re-solve round (`_fallback_round_schedule`).
 """
 from __future__ import annotations
 
 import logging
 from collections import OrderedDict
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..obs import names as obs_names
@@ -15,6 +34,55 @@ from .metadata import JobMetadata
 from .milp import MilpOptions, plan_schedule
 
 logger = logging.getLogger("shockwave_tpu.shockwave")
+
+
+class _JobView:
+    """Immutable per-job snapshot of the MILP's inputs, captured under
+    the scheduler lock so `solve_prepared` can run without it. Exposes
+    the same accessors plan_schedule / _relaxation_priorities /
+    _greedy_fallback call on live JobMetadata; the values are identical
+    because metadata memoizes them (calibration is fingerprint-cached)
+    and no new measurements land between snapshot and solve in the
+    single-threaded simulator."""
+
+    __slots__ = ("nworkers", "epochs", "epoch_progress",
+                 "_epoch_duration", "_remaining")
+
+    def __init__(self, meta: JobMetadata):
+        meta.calibrate_profiled_epoch_duration()
+        self.nworkers = meta.nworkers
+        self.epochs = meta.epochs
+        self.epoch_progress = meta.epoch_progress
+        self._epoch_duration = meta.interpolated_epoch_duration()
+        self._remaining = meta.dirichlet_posterior_remaining_runtime()
+
+    def interpolated_epoch_duration(self) -> float:
+        return self._epoch_duration
+
+    def dirichlet_posterior_remaining_runtime(self, progress=None) -> float:
+        return self._remaining
+
+    def calibrate_profiled_epoch_duration(self) -> None:
+        pass  # snapshot is already calibrated
+
+
+@dataclass
+class PlanRequest:
+    """Everything one MILP solve reads, snapshotted under the lock."""
+    round_ptr: int
+    job_ids: List[int]
+    jobs: List[_JobView]
+    share_series: List[list]
+    generation: int
+
+
+@dataclass
+class PlanResult:
+    """One finished solve, ready to commit under the lock."""
+    round_ptr: int
+    schedules: "OrderedDict[int, List[int]]"
+    stats: list = field(default_factory=list)
+    generation: int = 0
 
 
 class ShockwavePlanner:
@@ -32,6 +100,17 @@ class ShockwavePlanner:
         self.round_ptr = 0
         self._resolve = True
         self._reestimate_share = True
+        # Monotone re-solve request counter: a commit only clears
+        # `_resolve` when no new request (job add/remove, reopt cadence)
+        # arrived after its inputs were snapshotted — a stale pipelined
+        # result is still installed (fresher than nothing) but the next
+        # re-solve round solves again.
+        self._resolve_gen = 0
+        # Physical pipelined mode (set by the owning PhysicalScheduler):
+        # round_schedule never solves inline; it serves committed
+        # results or the deadline fallback. Simulation keeps this False
+        # so the canonical replay stays bit-identical.
+        self.pipelined = False
         self.share_series: Dict[int, list] = {}
         # Per-solve quality telemetry (milp.SolveStats), appended by
         # every plan_schedule call; drivers persist it so scale runs
@@ -123,6 +202,7 @@ class ShockwavePlanner:
 
     def request_resolve(self) -> None:
         self._resolve = True
+        self._resolve_gen += 1
 
     # -- share estimation --------------------------------------------------
 
@@ -149,52 +229,137 @@ class ShockwavePlanner:
 
     # -- scheduling --------------------------------------------------------
 
-    def round_schedule(self) -> List[int]:
-        """Job ids to run this round, re-solving the MILP if requested."""
-        if not self._resolve and self.round_ptr in self.schedules:
-            return self.schedules[self.round_ptr]
+    def needs_resolve(self) -> bool:
+        """Whether serving the current round requires a fresh solve."""
+        return self._resolve or self.round_ptr not in self.schedules
 
-        job_ids = list(self.metadata.keys())
-        jobs = list(self.metadata.values())
-        if not jobs:
-            return []
-
+    def prepare_solve(self) -> Optional[PlanRequest]:
+        """Phase 1 (under the scheduler lock): refresh the uniform-share
+        estimates and snapshot the solve inputs. None when idle."""
+        if not self.metadata:
+            return None
         self._estimate_uniform_share_finish_times()
-        share_series = [self.share_series[j] for j in job_ids]
+        job_ids = list(self.metadata.keys())
+        return PlanRequest(
+            round_ptr=self.round_ptr,
+            job_ids=job_ids,
+            jobs=[_JobView(m) for m in self.metadata.values()],
+            share_series=[list(self.share_series[j]) for j in job_ids],
+            generation=self._resolve_gen)
 
+    def solve_prepared(self, request: PlanRequest,
+                       pipelined: bool = False) -> PlanResult:
+        """Phase 2 (no lock required): the MILP + schedule construction,
+        a pure function of the request snapshot."""
+        stats: list = []
         obs = self._obs_handle()
-        with obs.span(obs_names.SPAN_PLANNER_SOLVE, njobs=len(jobs),
-                      round=self.round_ptr):
-            x = plan_schedule(jobs, self.round_ptr, self.future_nrounds,
-                              self.round_duration, self.ngpus, share_series,
-                              self.opts, stats_out=self.solve_stats)
-        if self.solve_stats:
-            from dataclasses import asdict
-            stats = self.solve_stats[-1]
+        with obs.span(obs_names.SPAN_PLANNER_SOLVE, njobs=len(request.jobs),
+                      round=request.round_ptr):
+            x = plan_schedule(request.jobs, request.round_ptr,
+                              self.future_nrounds, self.round_duration,
+                              self.ngpus, request.share_series, self.opts,
+                              stats_out=stats, pipelined=pipelined)
+        schedules = self._construct_schedules(x, request.job_ids,
+                                              request.jobs,
+                                              request.round_ptr)
+        return PlanResult(round_ptr=request.round_ptr, schedules=schedules,
+                          stats=stats, generation=request.generation)
+
+    def commit_result(self, result: PlanResult) -> None:
+        """Phase 3 (under the scheduler lock): install the schedules and
+        record the solve's telemetry + journal entry."""
+        from dataclasses import asdict
+        self.schedules = result.schedules
+        if result.generation == self._resolve_gen:
+            self._resolve = False
+        obs = self._obs_handle()
+        for stats in result.stats:
+            self.solve_stats.append(stats)
             # The MILP's own wall time is already measured inside
             # plan_schedule (SolveStats.wall_s, journaled with the
             # outcome) — observe that rather than re-timing, so replay
             # and live runs histogram the same number.
             obs.observe(obs_names.MILP_SOLVE_SECONDS, stats.wall_s,
                         path=stats.path)
+            obs.observe(obs_names.MILP_ASSEMBLY_SECONDS, stats.assembly_s,
+                        path=stats.path)
             if stats.path != "ftf":
                 obs.inc(obs_names.SOLVER_FALLBACKS_TOTAL, path=stats.path)
             self._journal_event("solve_outcome", asdict(stats))
-        self.schedules = self._construct_schedules(x, job_ids, jobs)
-        self._resolve = False
+            if self.pipelined:
+                if not stats.pipelined:
+                    outcome = "inline"
+                elif result.round_ptr == self.round_ptr:
+                    # Committed before the round it was solved for was
+                    # served: the background solve beat its deadline.
+                    outcome = "hit"
+                else:
+                    # The target round already ran on the fallback
+                    # (counted there as a miss); this result still
+                    # covers the rest of its horizon.
+                    outcome = "late"
+                obs.inc(obs_names.PIPELINED_SOLVES_TOTAL, outcome=outcome)
+
+    def round_schedule(self) -> List[int]:
+        """Job ids to run this round, re-solving the MILP if requested."""
+        if not self._resolve and self.round_ptr in self.schedules:
+            return self.schedules[self.round_ptr]
+        if not self.metadata:
+            return []
+        if self.pipelined:
+            # Physical pipelined mode: the background thread owns the
+            # solve; a re-solve round reaching here means the result was
+            # not committed in time — serve the deadline fallback, never
+            # stall the round loop on the solver.
+            return self._fallback_round_schedule()
+        request = self.prepare_solve()
+        self.commit_result(self.solve_prepared(request))
         return self.schedules[self.round_ptr]
 
-    def _construct_schedules(self, x, job_ids, jobs) -> "OrderedDict[int, List[int]]":
+    def _fallback_round_schedule(self) -> List[int]:
+        """Deadline fallback: the cached horizon entry when the last
+        committed solve still covers this round, else a work-conserving
+        backfill-only schedule (longest remaining runtime first) over
+        the live job set."""
+        self._obs_handle().inc(obs_names.PIPELINED_SOLVES_TOTAL,
+                               outcome="miss")
+        cached = self.schedules.get(self.round_ptr)
+        if cached is not None:
+            return cached
+        logger.warning("pipelined solve not ready at round %d and no "
+                       "cached schedule covers it; serving backfill-only "
+                       "schedule", self.round_ptr)
+        selected: List[int] = []
+        idle = self.ngpus
+        by_remaining = sorted(
+            self.metadata.items(),
+            key=lambda kv: kv[1].dirichlet_posterior_remaining_runtime(),
+            reverse=True)
+        for job_id, meta in by_remaining:
+            if meta.nworkers <= idle:
+                selected.append(job_id)
+                idle -= meta.nworkers
+            if idle <= 0:
+                break
+        # Pin the fallback for the round so repeated queries within the
+        # same round stay consistent.
+        self.schedules[self.round_ptr] = selected
+        return selected
+
+    def _construct_schedules(self, x, job_ids, jobs,
+                             base_round: int) -> "OrderedDict[int, List[int]]":
         """Solution matrix -> per-round job lists, with work-conserving
         backfill of idle chips by longest remaining runtime
-        (reference: shockwave.py:213-285)."""
+        (reference: shockwave.py:213-285). Operates purely on the
+        request snapshot (job_ids + views) so it can run off-lock."""
         schedules: "OrderedDict[int, List[int]]" = OrderedDict()
         for r in range(self.future_nrounds):
-            round_index = self.round_ptr + r
-            selected = [job_ids[j] for j in range(len(job_ids)) if x[j, r]]
+            round_index = base_round + r
+            sel = [j for j in range(len(job_ids)) if x[j, r]]
+            selected = [job_ids[j] for j in sel]
             if not selected:
                 logger.warning("no jobs scheduled in round %d", round_index)
-            used = sum(self.metadata[j].nworkers for j in selected)
+            used = sum(jobs[j].nworkers for j in sel)
             idle = self.ngpus - used
             if idle > 0:
                 others = [j for j in range(len(job_ids))

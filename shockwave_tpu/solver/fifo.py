@@ -8,7 +8,6 @@ running ones when the combined normalized throughput clears a threshold
 """
 from __future__ import annotations
 
-import copy
 import random
 from typing import Dict, Optional
 
@@ -54,7 +53,9 @@ class FIFOPolicy(Policy):
             self._allocation[JobIdPair(partner[0], candidate[0])] = worker_type
 
     def get_allocation(self, throughputs, scale_factors, cluster_spec):
-        available = copy.deepcopy(cluster_spec)
+        # Flat {worker_type: int} — a dict copy fully isolates it;
+        # deepcopy ran once per allocation solve for nothing.
+        available = dict(cluster_spec)
         if self._mode != "base":
             self._allocation = {}
 

@@ -1,0 +1,226 @@
+"""Pipelined-planner loopback tests: the Shockwave MILP runs on a
+background solve thread that overlaps round execution, and a slow solve
+degrades to the deadline fallback (cached schedule / backfill) instead
+of stalling the round pipeline. Runtime-marked classes run under the
+concurrency sanitizer (tests/conftest.py)."""
+import os
+import threading
+import time
+
+import pytest
+
+from shockwave_tpu.core.job import Job
+from shockwave_tpu.core.oracle import read_throughputs
+from shockwave_tpu.core.profiles import build_profiles
+from shockwave_tpu.obs import names as obs_names
+from shockwave_tpu.sched.physical import PhysicalScheduler
+from shockwave_tpu.sched.scheduler import SchedulerConfig
+from shockwave_tpu.solver import get_policy
+
+from test_runtime import StubWorkerDaemon, free_port
+
+DATA = os.path.join(os.path.dirname(__file__), "..", "data")
+
+
+def _shockwave_jobs(total_steps_list):
+    return [Job(None, "ResNet-18 (batch size 32)",
+                "python3 main.py --batch_size 32",
+                "image_classification/cifar10", "--num_steps",
+                total_steps=steps, duration=10000)
+            for steps in total_steps_list]
+
+
+def _shockwave_scheduler(port, total_steps_list, max_rounds=8,
+                         round_duration=2.0, num_chips=2):
+    jobs = _shockwave_jobs(total_steps_list)
+    throughputs = read_throughputs(
+        os.path.join(DATA, "tacc_throughputs.json"))
+    sched = PhysicalScheduler(
+        get_policy("shockwave", seed=0),
+        throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+        profiles=build_profiles(jobs, throughputs),
+        config=SchedulerConfig(
+            time_per_iteration=round_duration, max_rounds=max_rounds,
+            shockwave={"num_gpus": num_chips}),
+        expected_num_workers=num_chips, port=port)
+    return sched, jobs
+
+
+def _drive(sched, jobs, worker, deadline_s, done):
+    for job in jobs:
+        sched.add_job(job)
+    runner = threading.Thread(target=sched.run, daemon=True)
+    runner.start()
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if done():
+            break
+        time.sleep(0.2)
+
+
+@pytest.mark.runtime
+@pytest.mark.timeout(120)
+class TestPipelinedPlanning:
+    def test_background_solve_overlaps_round(self):
+        """End-to-end shockwave loopback with pipelining on (default):
+        jobs complete, re-solves run on the background thread
+        (SolveStats.pipelined / hit counter), and no solve phase span
+        ever approaches the round duration — the round loop never waits
+        on the MILP."""
+        sched_port, worker_port = free_port(), free_port()
+        round_duration = 2.0
+        sched, jobs = _shockwave_scheduler(
+            sched_port, [150, 800], round_duration=round_duration)
+        assert sched._shockwave_planner.pipelined
+        worker = StubWorkerDaemon(sched_port, worker_port, num_chips=2,
+                                  throughput=100.0)
+        try:
+            _drive(sched, jobs, worker, deadline_s=40,
+                   done=lambda: len(sched._completed_jobs) == 2)
+            assert len(sched._completed_jobs) == 2, "jobs did not complete"
+
+            stats = sched._shockwave_planner.solve_stats
+            assert stats, "no solve telemetry"
+            # Startup solve is inline; the re-solve triggered by the
+            # first completion must have run on the solve thread.
+            assert stats[0].pipelined is False
+            assert any(s.pipelined for s in stats), (
+                f"no pipelined solve in {[s.path for s in stats]}")
+            assert all(s.assembly_s <= s.wall_s for s in stats)
+
+            reg = sched.obs.registry
+            assert reg.value(obs_names.PIPELINED_SOLVES_TOTAL,
+                             outcome="inline") >= 1
+            assert reg.value(obs_names.PIPELINED_SOLVES_TOTAL,
+                             outcome="hit") >= 1
+
+            # Phase-span evidence: the mid-round solve phase (selection
+            # + assignment; the MILP itself overlapped the round) never
+            # ate a meaningful fraction of the round.
+            solve_spans = [e for e in sched.obs.tracer.events()
+                           if e["name"] == obs_names.SPAN_SOLVE]
+            assert solve_spans
+            assert all(e["dur"] < 0.5 * round_duration
+                       for e in solve_spans), solve_spans
+        finally:
+            sched._done_event.set()
+            worker.stop()
+            sched._server.stop(grace=0)
+
+    def test_slow_solve_hits_deadline_fallback(self, monkeypatch):
+        """A background solve slower than the re-solve deadline must NOT
+        stall the round: the planner serves the cached schedule /
+        backfill (miss counter), rounds keep rolling on time, and the
+        late result still commits at a later re-solve point."""
+        from shockwave_tpu.shockwave import planner as planner_mod
+        real_plan = planner_mod.plan_schedule
+        round_duration = 2.0
+
+        def slow_plan(*args, **kwargs):
+            if kwargs.get("pipelined"):
+                # Past this round's commit point AND the next round's
+                # (kick is skipped while busy), then finish.
+                time.sleep(2.2 * round_duration)
+            return real_plan(*args, **kwargs)
+
+        monkeypatch.setattr(planner_mod, "plan_schedule", slow_plan)
+
+        sched_port, worker_port = free_port(), free_port()
+        sched, jobs = _shockwave_scheduler(
+            sched_port, [150, 2000], max_rounds=10,
+            round_duration=round_duration)
+        worker = StubWorkerDaemon(sched_port, worker_port, num_chips=2,
+                                  throughput=100.0)
+        try:
+            _drive(sched, jobs, worker, deadline_s=60,
+                   done=lambda: len(sched._completed_jobs) == 2)
+            assert len(sched._completed_jobs) == 2, "jobs did not complete"
+
+            reg = sched.obs.registry
+            assert reg.value(obs_names.PIPELINED_SOLVES_TOTAL,
+                             outcome="miss") >= 1, \
+                "slow solve never exercised the deadline fallback"
+            # The late result must eventually have been committed — and
+            # counted `late`, never `hit` (its target round already ran
+            # on the fallback).
+            assert any(s.pipelined
+                       for s in sched._shockwave_planner.solve_stats)
+            assert reg.value(obs_names.PIPELINED_SOLVES_TOTAL,
+                             outcome="late") >= 1
+            # Liveness: rounds kept rolling while the solver slept.
+            assert sched.rounds.num_completed_rounds >= 3
+            solve_spans = [e for e in sched.obs.tracer.events()
+                           if e["name"] == obs_names.SPAN_SOLVE]
+            assert all(e["dur"] < 0.5 * round_duration
+                       for e in solve_spans), solve_spans
+        finally:
+            sched._done_event.set()
+            worker.stop()
+            sched._server.stop(grace=0)
+
+
+class TestPlannerSolvePhases:
+    """Unit semantics of the prepare/solve/commit split (no loopback)."""
+
+    def _planner(self, pipelined=False):
+        from shockwave_tpu.shockwave.metadata import JobMetadata
+        from shockwave_tpu.shockwave.planner import ShockwavePlanner
+        planner = ShockwavePlanner(ngpus=2, future_nrounds=4,
+                                   round_duration=60.0)
+        planner.pipelined = pipelined
+        profile = {
+            "model": "ResNet-18", "dataset": "cifar10", "scale_factor": 1,
+            "num_epochs": 4, "num_samples_per_epoch": 100,
+            "util_every_epoch": [50] * 4, "mem_every_epoch": [1024] * 4,
+            "duration_every_epoch": [60.0] * 4,
+            "bs_every_epoch": [32] * 4,
+        }
+        for i in range(2):
+            meta = JobMetadata(i, dict(profile))
+            meta.register_submit(0.0)
+            planner.add_job(i, meta)
+        return planner
+
+    def test_inline_three_phase_matches_round_schedule(self):
+        a = self._planner()
+        b = self._planner()
+        sched_a = a.round_schedule()
+        request = b.prepare_solve()
+        b.commit_result(b.solve_prepared(request))
+        assert sched_a == b.schedules[b.round_ptr]
+        assert a.schedules == b.schedules
+        assert not b.needs_resolve()
+
+    def test_stale_generation_keeps_resolve_pending(self):
+        planner = self._planner()
+        request = planner.prepare_solve()
+        result = planner.solve_prepared(request)
+        # A new resolve request lands after the snapshot (job event).
+        planner.request_resolve()
+        planner.commit_result(result)
+        # Schedules installed (fresher than nothing)...
+        assert planner.schedules
+        # ...but the newer request still forces the next re-solve.
+        assert planner._resolve is True
+
+    def test_fallback_serves_cache_then_backfill(self):
+        planner = self._planner(pipelined=True)
+        # No committed solve yet: backfill-only fallback, capacity-safe.
+        selected = planner.round_schedule()
+        assert selected, "backfill fallback scheduled nothing"
+        used = sum(planner.metadata[j].nworkers for j in selected)
+        assert used <= planner.ngpus
+        # Commit a real solve; the cache then serves without solving.
+        request = planner.prepare_solve()
+        planner.commit_result(planner.solve_prepared(request))
+        assert planner.round_schedule() == planner.schedules[planner.round_ptr]
+
+    def test_pipelined_never_solves_inline(self, monkeypatch):
+        from shockwave_tpu.shockwave import planner as planner_mod
+        planner = self._planner(pipelined=True)
+
+        def boom(*args, **kwargs):
+            raise AssertionError("pipelined round_schedule solved inline")
+
+        monkeypatch.setattr(planner_mod, "plan_schedule", boom)
+        assert planner.round_schedule() is not None

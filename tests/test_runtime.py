@@ -1680,14 +1680,20 @@ class TestSolverBudgetCapCoercion:
     """Satellite: solver_budget_cap_rounds must be coerced with a clear
     config error — not a bare TypeError out of the clamp comparison."""
 
-    def _config(self, cap):
+    def _config(self, cap, pipelined=True):
         sw = {"num_gpus": 2, "solver_budget_cap_rounds": cap}
-        return SchedulerConfig(time_per_iteration=10.0, shockwave=sw)
+        return SchedulerConfig(time_per_iteration=10.0, shockwave=sw,
+                               pipelined_planning=pipelined)
 
-    def test_null_means_default(self):
+    def test_null_means_mode_default(self):
         from shockwave_tpu.sched.scheduler import Scheduler
+        # Pipelined physical (default): full-budget default, 2.0 rounds.
         sched = Scheduler(get_policy("shockwave"), simulate=False,
                           config=self._config(None))
+        assert sched._shockwave_planner.opts.budget_cap_rounds == 2.0
+        # Inline physical: the historical half-round default.
+        sched = Scheduler(get_policy("shockwave"), simulate=False,
+                          config=self._config(None, pipelined=False))
         assert sched._shockwave_planner.opts.budget_cap_rounds == 0.5
 
     def test_numeric_string_is_coerced(self):
@@ -1702,11 +1708,16 @@ class TestSolverBudgetCapCoercion:
             Scheduler(get_policy("shockwave"), simulate=False,
                       config=self._config("half a round"))
 
-    def test_overlarge_cap_still_clamped(self):
+    def test_overlarge_cap_clamped_only_without_pipelining(self):
         from shockwave_tpu.sched.scheduler import Scheduler
+        # Inline solve blocks the round loop -> clamp stands.
+        sched = Scheduler(get_policy("shockwave"), simulate=False,
+                          config=self._config(2.0, pipelined=False))
+        assert sched._shockwave_planner.opts.budget_cap_rounds == 0.5
+        # Pipelined solve runs off the round loop -> config cap honored.
         sched = Scheduler(get_policy("shockwave"), simulate=False,
                           config=self._config(2.0))
-        assert sched._shockwave_planner.opts.budget_cap_rounds == 0.5
+        assert sched._shockwave_planner.opts.budget_cap_rounds == 2.0
 
 
 class TestCheckpointAheadReconcile:

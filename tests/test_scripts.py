@@ -76,6 +76,25 @@ class TestPolicyRuntimeSweep:
         assert "best_s" in out
 
 
+class TestMilpAssemblyBench:
+    def test_smoke_both_assemblers(self, tmp_path):
+        """The assembly/solve-split bench runs for both assembler arms,
+        honors --smoke, and dumps the obs histograms."""
+        metrics = tmp_path / "assembly.prom"
+        out = run_script(["scripts/microbenchmarks/bench_milp_assembly.py",
+                          "--num_jobs", "24", "--trials", "1",
+                          "--skip_solve", "--smoke",
+                          "--metrics_out", str(metrics)])
+        row = json.loads(out.strip().splitlines()[-1])
+        assert row["assembler"] == "vectorized"
+        assert row["assembly_best_s"] < row["solve_budget_floor_s"]
+        assert "swtpu_milp_assembly_seconds" in metrics.read_text()
+        out = run_script(["scripts/microbenchmarks/bench_milp_assembly.py",
+                          "--num_jobs", "24", "--trials", "1",
+                          "--skip_solve", "--assembler", "loop"])
+        assert json.loads(out.strip().splitlines()[-1])["assembler"] == "loop"
+
+
 class TestPlotting:
     def test_all_plot_kinds(self, tmp_path):
         from shockwave_tpu import plotting

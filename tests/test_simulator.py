@@ -453,12 +453,12 @@ class TestMultiChipJobs:
 
 
 class TestSolverBudgetCap:
-    def test_cap_clamped_in_physical_mode(self):
-        """solver_budget_cap_rounds is simulation-only: a physical round
-        loop must never stall on a hard MILP instance, so the scheduler
-        clamps any larger configured cap back to the 0.5 default."""
+    def test_cap_clamped_without_pipelining(self):
+        """Without pipelined planning the MILP blocks the physical round
+        loop at mid-round, so the scheduler clamps any larger configured
+        cap back to the 0.5 default. Simulation never clamps."""
         cfg = SchedulerConfig(
-            time_per_iteration=120.0,
+            time_per_iteration=120.0, pipelined_planning=False,
             shockwave={"num_gpus": 4, "solver_budget_cap_rounds": 2.0})
         sim = Scheduler(get_policy("shockwave", seed=0), simulate=True,
                         throughputs_file=os.path.join(
@@ -468,6 +468,27 @@ class TestSolverBudgetCap:
                          throughputs_file=os.path.join(
                              DATA, "tacc_throughputs.json"), config=cfg)
         assert phys._shockwave_planner.opts.budget_cap_rounds == 0.5
+
+    def test_pipelined_physical_keeps_full_budget(self):
+        """With pipelined planning (default) the solve runs off the
+        round loop, so physical mode keeps the configured cap — and
+        defaults to 2.0 rounds (the EXPERIMENTS.md 256-chip setting)
+        when the config ships none."""
+        cfg = SchedulerConfig(
+            time_per_iteration=120.0,
+            shockwave={"num_gpus": 4, "solver_budget_cap_rounds": 3.0})
+        phys = Scheduler(get_policy("shockwave", seed=0), simulate=False,
+                         throughputs_file=os.path.join(
+                             DATA, "tacc_throughputs.json"), config=cfg)
+        assert phys._shockwave_planner.opts.budget_cap_rounds == 3.0
+        cfg_default = SchedulerConfig(
+            time_per_iteration=120.0, shockwave={"num_gpus": 4})
+        phys_default = Scheduler(
+            get_policy("shockwave", seed=0), simulate=False,
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=cfg_default)
+        assert (phys_default._shockwave_planner.opts.budget_cap_rounds
+                == 2.0)
 
 
 class TestPackedScheduleRecording:
