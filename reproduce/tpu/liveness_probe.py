@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Bounded-retry, subprocess-isolated accelerator liveness probe.
+
+A wedged accelerator tunnel blocks `jax.devices()` **forever** inside
+whatever process touches the backend — so the probe always runs in a
+child process with a hard timeout, and the parent can only ever lose
+`attempts x (timeout + backoff)` seconds, never hang. BENCH_r05
+recorded exactly this failure (`tpu_error: backend liveness probe timed
+out (wedged accelerator tunnel?)`); every evidence-capture entry point
+now goes through this one probe so a wedged tunnel degrades to the
+last-good committed evidence files instead of poisoning the bench row
+or hanging `capture_tpu_evidence.sh` at step 1.
+
+Used as a library by bench.py (`probe_backend()`) and as a CLI by
+reproduce/tpu/capture_tpu_evidence.sh:
+
+    python reproduce/tpu/liveness_probe.py && <capture steps>
+
+Exit codes: 0 = backend live, 3 = unreachable/wedged (reason on stdout).
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Optional
+
+#: Child command: touching jax.devices() forces full backend init.
+PROBE_SNIPPET = "import jax; jax.devices()"
+DEFAULT_ATTEMPTS = 2
+DEFAULT_TIMEOUT_S = 120.0
+DEFAULT_BACKOFF_S = 45.0
+
+
+def probe_backend(attempts: int = DEFAULT_ATTEMPTS,
+                  timeout_s: float = DEFAULT_TIMEOUT_S,
+                  backoff_s: float = DEFAULT_BACKOFF_S,
+                  cwd: Optional[str] = None,
+                  python: Optional[str] = None,
+                  snippet: str = PROBE_SNIPPET,
+                  sleep=time.sleep) -> Optional[str]:
+    """Probe backend liveness in an isolated child with bounded retry.
+
+    Returns None when the backend answered, else a one-line reason
+    (timeout = wedged tunnel, nonzero exit = init failure). Transient
+    relay hiccups often clear within a minute, hence the backoff'd
+    retries; the budget is hard-bounded either way."""
+    err: Optional[str] = None
+    for attempt in range(max(attempts, 1)):
+        if attempt:
+            sleep(backoff_s)
+        try:
+            probe = subprocess.run(
+                [python or sys.executable, "-c", snippet],
+                capture_output=True, text=True, timeout=timeout_s, cwd=cwd)
+        except subprocess.TimeoutExpired:
+            err = ("backend liveness probe timed out "
+                   "(wedged accelerator tunnel?)")
+            continue
+        if probe.returncode != 0:
+            err = "backend init failed: " + probe.stderr[-300:]
+            continue
+        return None
+    return err
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--attempts", type=int, default=DEFAULT_ATTEMPTS)
+    p.add_argument("--timeout", type=float, default=DEFAULT_TIMEOUT_S)
+    p.add_argument("--backoff", type=float, default=DEFAULT_BACKOFF_S)
+    args = p.parse_args(argv)
+    err = probe_backend(attempts=args.attempts, timeout_s=args.timeout,
+                        backoff_s=args.backoff)
+    if err is None:
+        print("backend live")
+        return 0
+    print(err)
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -140,10 +140,14 @@ def main():
     profiles = build_profiles(jobs, throughputs)
 
     shockwave_config = None
+    serving_config = None
     if args.config:
         with open(args.config) as f:
             shockwave_config = json.load(f)
-    elif args.policy == "shockwave":
+        # Serving-tier autoscaler block (policy-agnostic; same file
+        # convention as simulate.py).
+        serving_config = shockwave_config.pop("serving", None)
+    if shockwave_config is None and args.policy == "shockwave":
         shockwave_config = {}
     if shockwave_config is not None:
         if args.expected_num_workers:
@@ -167,7 +171,8 @@ def main():
             state_dir=args.state_dir, resume=args.resume,
             snapshot_interval_rounds=args.snapshot_interval,
             pipelined_planning=not args.no_pipelined_solve,
-            obs_port=args.obs_port, obs_trace_path=args.obs_trace))
+            obs_port=args.obs_port, obs_trace_path=args.obs_trace,
+            serving=serving_config))
     if sched.obs_port is not None:
         # stderr, unconditionally: with --obs_port 0 this line is the
         # ONLY place the resolved ephemeral port appears, and the
@@ -243,11 +248,13 @@ def main():
     util, util_list = sched.get_cluster_utilization()
     ext_pct, ext, opp = sched.get_num_lease_extensions()
 
+    serving_summary = sched.serving_summary()
     metrics = {
         "trace_file": args.trace,
         "policy": args.policy,
         "makespan": makespan,
         "all_jobs_completed": all_done,
+        **({"serving": serving_summary} if serving_summary else {}),
         "avg_jct": jct[0] if jct else None,
         "geometric_mean_jct": jct[1] if jct else None,
         "harmonic_mean_jct": jct[2] if jct else None,

@@ -44,8 +44,13 @@ def main():
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--max_rounds", type=int, default=None)
     p.add_argument("--config", default=None,
-                   help="JSON file of shockwave hyperparameters")
+                   help="JSON file of shockwave hyperparameters (a "
+                        "'serving' block inside configures the serving "
+                        "tier's autoscaler for any policy)")
     p.add_argument("--output", default=None, help="metrics pickle path")
+    p.add_argument("--json_out", default=None,
+                   help="also write the summary JSON line to this file "
+                        "(CI artifact for the mixed serving smoke)")
     p.add_argument("--replay_schedule", default=None, metavar="PHYSICAL_PKL",
                    help="fidelity analysis: execute this physical metric "
                         "pickle's per_round_schedule verbatim instead of "
@@ -81,10 +86,15 @@ def main():
                 f"--chips_per_server {args.chips_per_server}")
 
     shockwave_config = None
+    serving_config = None
     if args.config:
         with open(args.config) as f:
             shockwave_config = json.load(f)
-    elif args.policy == "shockwave":
+        # The serving tier is policy-agnostic; its autoscaler block
+        # rides the same config file but a separate SchedulerConfig
+        # field (the planner would reject the unknown keys).
+        serving_config = shockwave_config.pop("serving", None)
+    if shockwave_config is None and args.policy == "shockwave":
         shockwave_config = {}  # planner defaults
     if shockwave_config is not None:
         shockwave_config["num_gpus"] = sum(cluster_spec.values())
@@ -114,7 +124,7 @@ def main():
         config=SchedulerConfig(
             time_per_iteration=args.round_duration, seed=args.seed,
             max_rounds=args.max_rounds, shockwave=shockwave_config,
-            rate_override=rate_override))
+            rate_override=rate_override, serving=serving_config))
 
     makespan = sched.simulate(
         cluster_spec, arrival_times, jobs,
@@ -150,9 +160,12 @@ def main():
         "throughput_timeline": sched.get_throughput_timeline(),
         "milp_solve_stats": sched.get_solve_stats(),
     }
+    serving = sched.serving_summary()
+    if serving is not None:
+        metrics["serving"] = serving
 
     unfair = unfair_fraction(ftf_static)
-    print(json.dumps({
+    summary = {
         "policy": args.policy,
         "makespan": round(makespan, 2),
         "avg_jct": round(metrics["avg_jct"], 2) if metrics["avg_jct"] else None,
@@ -160,7 +173,16 @@ def main():
         "cluster_util": round(util, 4),
         "lease_extension_pct": round(ext_pct, 2),
         "rounds": sched.rounds.num_completed_rounds,
-    }))
+    }
+    if serving is not None:
+        summary["serving_slo_attainment"] = serving["slo_attainment"]
+        summary["serving_requests_offered"] = serving["requests_offered"]
+        summary["serving_services"] = serving["services"]
+    print(json.dumps(summary))
+    if args.json_out:
+        # CI artifact, not durable state: a torn file just re-runs.
+        with open(args.json_out, "w") as f:  # swtpu-check: ignore[durability]
+            json.dump(summary, f, indent=2)
 
     if args.output:
         with open(args.output, "wb") as f:

@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Generate the committed mixed serving+training trace.
+
+Takes the first N training jobs of the canonical 120-job trace verbatim
+(arrivals kept) and appends latency-SLO serving services:
+
+- service A (arrives at t=0, 4 h lifetime): diurnal 8->16 req/s with a
+  seeded 10x spike — the SLO-attainment-under-burst scenario of
+  EXPERIMENTS.md "Serving tier".
+- service B (arrives at t=1800, 3 h lifetime): trough-starting 0->6
+  req/s curve — exercises scale-to-zero.
+
+Deterministic; rerun after changing parameters and commit the result:
+
+    python scripts/utils/make_serving_trace.py > data/serving_mixed.trace
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.core.trace import job_to_trace_line, make_serving_job
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+CANONICAL = os.path.join(REPO, "data", "canonical_120job.trace")
+NUM_TRAINING_JOBS = 10
+
+
+def main():
+    with open(CANONICAL) as f:
+        lines = [next(f).rstrip("\n") for _ in range(NUM_TRAINING_JOBS)]
+
+    service_a = make_serving_job(
+        base_rps=8.0, peak_rps=16.0, period_s=14400.0, lifetime_s=14400.0,
+        slo_p99_s=0.5, tokens_per_request=64, decode_tokens_per_s=1600.0,
+        max_replicas=12, spike_seed=7, num_spikes=1, spike_mult=10.0,
+        spike_duration_s=1800.0)
+    # Period = 2x lifetime: the service lives through the curve's rise
+    # from a true trough (several rounds under the scale-to-zero
+    # threshold) to its peak.
+    service_b = make_serving_job(
+        base_rps=0.0, peak_rps=6.0, period_s=21600.0, lifetime_s=10800.0,
+        slo_p99_s=1.0, tokens_per_request=64, decode_tokens_per_s=1600.0,
+        max_replicas=4)
+    lines.append(job_to_trace_line(service_a, 0.0))
+    lines.append(job_to_trace_line(service_b, 1800.0))
+    # simulate() admits in file order gated on the head arrival, and
+    # job ids / the positional profiles list follow file order — the
+    # trace MUST be arrival-sorted or late lines are admitted late.
+    lines.sort(key=lambda line: float(line.rsplit("\t", 1)[1]))
+    print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()

@@ -86,7 +86,13 @@ def build_job_profile(job: Job, throughputs: dict, worker_type: str = "v100") ->
 
 def build_profiles(jobs: Sequence[Job], throughputs: dict,
                    worker_type: str = "v100") -> List[dict]:
-    return [build_job_profile(job, throughputs, worker_type) for job in jobs]
+    """Profiles positionally aligned with the trace's job ids. Serving
+    jobs (mode ``serving``) have no epoch structure — their slot is None
+    (the scheduler never reads a profile for them)."""
+    from .trace import is_serving_job
+    return [None if is_serving_job(job)
+            else build_job_profile(job, throughputs, worker_type)
+            for job in jobs]
 
 
 def save_profiles(profiles: List[dict], path: str) -> None:

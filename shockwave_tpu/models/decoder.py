@@ -1,0 +1,207 @@
+"""Decoder-only LM with an explicit KV cache for autoregressive serving.
+
+The serving tier's replica workload (workloads/serving/serve.py) decodes
+tokens one at a time; recomputing attention over the whole prefix every
+step would make per-token cost quadratic in position. The standard fix —
+cache each layer's projected K/V and attend the new token's query
+against the cache — makes decode O(1) per token in recompute (cf. the
+autoregressive-caching compiler line of work, PAPERS.md 2603.09555).
+
+Built on the existing stack: the full-sequence path reuses the same
+head/projection shapes as `models/transformer.py` and lowers to the
+Pallas flash-attention kernel (`ops/flash_attention.py`) when shapes
+allow, exactly like `MultiHeadAttention`; the decode path shares the
+same parameters (flax setup-defined submodules) and attends against the
+cache with masked einsum — a 1-token query has no flash-block shape.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .transformer import sinusoidal_positions
+
+
+class CachedSelfAttention(nn.Module):
+    """Causal self-attention whose parameters serve both the
+    full-sequence (prefill / parity) path and the single-token cached
+    decode path."""
+    num_heads: int
+    dim: int
+    dtype: Any = jnp.float32
+    use_flash: bool = False
+
+    def setup(self):
+        head_dim = self.dim // self.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.num_heads, head_dim), axis=-1, dtype=self.dtype, name=name)
+        self.query = dense("query")
+        self.key = dense("key")
+        self.value = dense("value")
+        self.out = nn.DenseGeneral(self.dim, axis=(-2, -1),
+                                   dtype=self.dtype, name="out")
+
+    def __call__(self, x):
+        """Full-sequence causal attention (flash-capable, same shape
+        gate as transformer.MultiHeadAttention)."""
+        q, k, v = self.query(x), self.key(x), self.value(x)
+        t = q.shape[1]
+        head_dim = self.dim // self.num_heads
+        align = 16 if self.dtype == jnp.bfloat16 else 8
+        blockable = t % 1024 == 0 if t > 1024 else t % align == 0
+        if self.use_flash and blockable:
+            from ..ops import flash_attention
+            attended = flash_attention(q, k, v, causal=True)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim)
+            mask = jnp.tril(jnp.ones((t, t), bool))[None, None]
+            scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+            weights = nn.softmax(
+                scores.astype(jnp.float32)).astype(self.dtype)
+            attended = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        return self.out(attended)
+
+    def decode(self, x, k_cache, v_cache, pos):
+        """One-token step: write this position's K/V into the cache and
+        attend the query over every cached position <= pos.
+
+        x: (B, 1, D); caches: (B, T, H, Dh); pos: scalar int32."""
+        q = self.query(x)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, self.key(x).astype(k_cache.dtype), pos, axis=1)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, self.value(x).astype(v_cache.dtype), pos, axis=1)
+        head_dim = self.dim // self.num_heads
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) / jnp.sqrt(head_dim)
+        valid = (jnp.arange(k_cache.shape[1]) <= pos)[None, None, None, :]
+        scores = jnp.where(valid, scores, jnp.finfo(jnp.float32).min)
+        weights = nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
+        attended = jnp.einsum("bhqk,bkhd->bqhd", weights, v_cache)
+        return self.out(attended), k_cache, v_cache
+
+
+class DecoderBlock(nn.Module):
+    """Pre-LN block, same composition as transformer.TransformerLayer."""
+    num_heads: int
+    dim: int
+    mlp_dim: int
+    dtype: Any = jnp.float32
+    use_flash: bool = False
+
+    def setup(self):
+        self.attn = CachedSelfAttention(self.num_heads, self.dim,
+                                        self.dtype, self.use_flash,
+                                        name="self_attn")
+        self.norm1 = nn.LayerNorm(dtype=jnp.float32)
+        self.norm2 = nn.LayerNorm(dtype=jnp.float32)
+        self.mlp_in = nn.Dense(self.mlp_dim, dtype=self.dtype)
+        self.mlp_out = nn.Dense(self.dim, dtype=self.dtype)
+
+    def _mlp(self, x):
+        return self.mlp_out(nn.gelu(self.mlp_in(x)))
+
+    def __call__(self, x):
+        x = x + self.attn(self.norm1(x))
+        return x + self._mlp(self.norm2(x))
+
+    def decode(self, x, k_cache, v_cache, pos):
+        attended, k_cache, v_cache = self.attn.decode(
+            self.norm1(x), k_cache, v_cache, pos)
+        x = x + attended
+        return x + self._mlp(self.norm2(x)), k_cache, v_cache
+
+
+class DecoderLM(nn.Module):
+    """Small decoder-only LM for token serving (sized for one chip; the
+    serving workload scales by replica count, not model size)."""
+    vocab_size: int = 256
+    dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    mlp_dim: int = 256
+    max_len: int = 128
+    dtype: Any = jnp.float32
+    use_flash: bool = False
+
+    def setup(self):
+        self.embed = nn.Embed(self.vocab_size, self.dim,
+                              embedding_init=nn.initializers.normal(0.02),
+                              name="embed")
+        self.blocks = [DecoderBlock(self.num_heads, self.dim, self.mlp_dim,
+                                    self.dtype, self.use_flash,
+                                    name=f"block_{i}")
+                       for i in range(self.num_layers)]
+        self.final_norm = nn.LayerNorm(dtype=jnp.float32)
+
+    def _positions(self):
+        return jnp.asarray(sinusoidal_positions(self.max_len, self.dim))
+
+    def _logits(self, x):
+        # Tied output projection, like Seq2SeqTransformer.
+        return jnp.einsum("bld,vd->blv", x.astype(jnp.float32),
+                          self.embed.embedding.astype(jnp.float32))
+
+    def __call__(self, tokens):
+        """Full-sequence causal logits (prefill and the decode-parity
+        oracle in tests)."""
+        x = self.embed(tokens).astype(self.dtype)
+        x = x + self._positions()[: tokens.shape[1]]
+        for block in self.blocks:
+            x = block(x)
+        return self._logits(self.final_norm(x))
+
+    def decode_step(self, token, caches, pos):
+        """One autoregressive step. token: (B, 1) int32; caches: pytree
+        from `init_cache`; pos: scalar position of `token`. Returns
+        (logits (B, 1, V), updated caches)."""
+        x = self.embed(token).astype(self.dtype)
+        x = x + jax.lax.dynamic_slice_in_dim(self._positions(), pos, 1,
+                                             axis=0)
+        new_caches = []
+        for block, (k_cache, v_cache) in zip(self.blocks, caches):
+            x, k_cache, v_cache = block.decode(x, k_cache, v_cache, pos)
+            new_caches.append((k_cache, v_cache))
+        return self._logits(self.final_norm(x)), new_caches
+
+    def init_cache(self, batch: int) -> Tuple:
+        head_dim = self.dim // self.num_heads
+        shape = (batch, self.max_len, self.num_heads, head_dim)
+        return tuple((jnp.zeros(shape, self.dtype),
+                      jnp.zeros(shape, self.dtype))
+                     for _ in range(self.num_layers))
+
+
+def greedy_decode(model: DecoderLM, params: Dict, prompt: jnp.ndarray,
+                  num_tokens: int):
+    """Greedy autoregressive generation: prefill the prompt through the
+    cache token-by-token, then extend `num_tokens` — the serving
+    replica's unit of work. Returns (B, num_tokens) generated ids.
+    jit-friendly: fixed trip counts, carries only (token, caches, pos)."""
+    batch, prompt_len = prompt.shape
+    caches = model.init_cache(batch)
+
+    def step(carry, token_in):
+        caches, pos = carry
+        logits, caches = model.apply(params, token_in, caches, pos,
+                                     method=DecoderLM.decode_step)
+        next_token = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return (caches, pos + 1), next_token[:, None]
+
+    carry = (caches, jnp.int32(0))
+    token = prompt[:, :1]
+    # Prefill: feed prompt tokens through the cached path.
+    for i in range(prompt_len):
+        carry, next_token = step(carry, prompt[:, i:i + 1])
+    generated = []
+    token = next_token
+    for _ in range(num_tokens):
+        generated.append(token)
+        carry, token = step(carry, token)
+    return jnp.concatenate(generated, axis=1)
+
+
+__all__ = ["CachedSelfAttention", "DecoderBlock", "DecoderLM",
+           "greedy_decode"]

@@ -189,6 +189,39 @@ WORKER_LAST_DISPATCH_TIMESTAMP = _gauge(
     "Wall-clock time of the last RunJob this daemon received")
 
 # ----------------------------------------------------------------------
+# Serving tier (shockwave_tpu/serving/; virtual-clock in simulation)
+# ----------------------------------------------------------------------
+
+SERVING_SERVICES = _gauge(
+    "swtpu_serving_services", "Live (non-retired) serving services")
+SERVING_REPLICAS = _gauge(
+    "swtpu_serving_replicas",
+    "Replica chips assigned to each service this round", ("service",))
+SERVING_TARGET_REPLICAS = _gauge(
+    "swtpu_serving_target_replicas",
+    "Autoscaler replica target for each service this round", ("service",))
+SERVING_P99_SECONDS = _gauge(
+    "swtpu_serving_p99_seconds",
+    "Worst modeled p99 request latency across the round's load window "
+    "(M/M/c analytic; omitted while saturated)", ("service",))
+SERVING_SLO_ATTAINMENT = _gauge(
+    "swtpu_serving_slo_attainment",
+    "Cumulative requests-weighted fraction of each service's load "
+    "served within its p99 SLO", ("service",))
+SERVING_REQUESTS_TOTAL = _counter(
+    "swtpu_serving_requests_total",
+    "Modeled requests offered to each service, split by whether the "
+    "round's p99 met the SLO (slo=ok|violated)", ("service", "slo"))
+SERVING_RESERVED_CHIPS = _gauge(
+    "swtpu_serving_reserved_chips",
+    "Chips reserved for serving replicas ahead of the training "
+    "planner this round")
+SERVING_SCALE_EVENTS_TOTAL = _counter(
+    "swtpu_serving_scale_events_total",
+    "Replica scale events, by direction (up / down); each unit is one "
+    "replica spawned or drained", ("direction",))
+
+# ----------------------------------------------------------------------
 # Offline harnesses (scripts/microbenchmarks, scripts/profiling)
 # ----------------------------------------------------------------------
 
@@ -214,6 +247,7 @@ SPAN_END_ROUND = "end_round"
 SPAN_JOURNAL_FSYNC = "journal-fsync"
 SPAN_SNAPSHOT = "snapshot"
 SPAN_ESTIMATE_REFRESH = "estimate-refresh"
+SPAN_SERVING_PLAN = "serving-plan"
 SPAN_PLANNER_SOLVE = "planner-solve"
 SPAN_POLICY_SOLVE = "policy-solve"
 SPAN_PROFILE_MEASURE = "profile-measure"

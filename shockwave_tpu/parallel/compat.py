@@ -1,0 +1,40 @@
+"""Version compatibility shims for shard_map collective typing.
+
+Newer jax tracks device-variance types through `shard_map`: values that
+differ per-device along a mesh axis must be marked so scan carries and
+collective operands type-check. The marker has been spelled three ways
+across releases:
+
+- jax >= 0.7:  ``lax.pcast(x, axes, to="varying")``
+- jax ~ 0.5-0.6: ``lax.pvary(x, axes)``
+- older jax (e.g. the 0.4.x line this image ships): neither exists —
+  shard_map is untyped there, so no annotation is needed at all and the
+  marker degrades to the identity. ``pvary`` is purely a type-system
+  hint; on a single-host CPU mesh it lowers to a no-op either way, so
+  the identity fallback is a correctness no-op, not an approximation.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+from jax import lax
+
+if hasattr(lax, "pcast"):
+    def _mark_varying(x, axes):
+        return lax.pcast(x, axes, to="varying")
+elif hasattr(lax, "pvary"):
+    def _mark_varying(x, axes):
+        return lax.pvary(x, axes)
+else:  # pre-varying-types jax: untyped shard_map needs no marker
+    def _mark_varying(x, axes):
+        return x
+
+
+def to_varying(x, axes: Union[str, Sequence[str]]):
+    """Mark `x` device-varying over mesh `axes` (string or sequence),
+    degrading to the identity on jax versions whose shard_map has no
+    variance typing (see module docstring)."""
+    return _mark_varying(x, axes)
+
+
+__all__ = ["to_varying"]

@@ -26,6 +26,8 @@ try:
 except ImportError:  # older jax spells it jax.experimental.shard_map
     from jax.experimental.shard_map import shard_map
 
+from .compat import to_varying
+
 
 def _pipeline_local(stage_params, microbatches, *, stage_fn: Callable,
                     axis_name: str, varying_axes=()):
@@ -62,15 +64,9 @@ def _pipeline_local(stage_params, microbatches, *, stage_fn: Callable,
         return (buf, outs), None
 
     axes = (axis_name,) + tuple(varying_axes)
-
-    def to_varying(x):
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, axes, to="varying")
-        return lax.pvary(x, axes)
-
-    buf0 = to_varying(jnp.zeros(mb_shape, microbatches.dtype))
+    buf0 = to_varying(jnp.zeros(mb_shape, microbatches.dtype), axes)
     outs0 = to_varying(jnp.zeros((n_micro,) + mb_shape,
-                                 microbatches.dtype))
+                                 microbatches.dtype), axes)
     (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(ticks))
     # Only the last stage holds real outputs; broadcast over the ring.
     outs = jnp.where(stage == n_stages - 1, outs, 0)

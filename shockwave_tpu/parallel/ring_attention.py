@@ -25,6 +25,8 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
+from .compat import to_varying
+
 NEG_INF = -1e30
 
 
@@ -58,12 +60,9 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     l = jnp.zeros((batch, num_heads, q_len), jnp.float32)
     o = jnp.zeros(q.shape, jnp.float32)
     # Mark the accumulators as device-varying along the ring axis so the
-    # scan carry types line up with the shard-resident outputs.
-    if hasattr(lax, "pcast"):
-        to_varying = lambda x: lax.pcast(x, axis_name, to="varying")  # noqa: E731
-    else:  # older jax spells it pvary
-        to_varying = lambda x: lax.pvary(x, axis_name)  # noqa: E731
-    m, l, o = jax.tree.map(to_varying, (m, l, o))
+    # scan carry types line up with the shard-resident outputs
+    # (identity on jax versions without shard_map variance typing).
+    m, l, o = jax.tree.map(lambda x: to_varying(x, axis_name), (m, l, o))
 
     def make_mask(step):
         if not causal:

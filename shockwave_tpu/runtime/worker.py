@@ -170,7 +170,10 @@ def main(argv=None):
         num_chips=num_chips,
         run_dirs={"static": args.static_run_dir,
                   "accordion": args.accordion_run_dir,
-                  "gns": args.gns_run_dir},
+                  "gns": args.gns_run_dir,
+                  # Serving replicas (workloads/serving/serve.py) live
+                  # in the same tree as the static training scripts.
+                  "serving": args.static_run_dir},
         data_dir=args.data_dir, checkpoint_dir=args.checkpoint_dir,
         obs_port=args.obs_port)
     signal.signal(signal.SIGINT, lambda s, f: daemon._shutdown())

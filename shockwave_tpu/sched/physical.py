@@ -86,6 +86,9 @@ class PhysicalScheduler(Scheduler):
         "_port_offset",
         # pipelined-planning handoff (round loop <-> solve thread)
         "_planner_request", "_planner_result", "_planner_busy",
+        # serving tier (mutated by plan_round inside the locked round
+        # pipeline and by add_job; read by _serving_live)
+        "_serving_tier", "_serving_job_ids",
     })
 
     def __init__(self, policy, throughputs_file=None, profiles=None,
@@ -1593,7 +1596,7 @@ class PhysicalScheduler(Scheduler):
     def run(self):
         """Drive the round mechanism until max_rounds (or forever)."""
         with self._cv:
-            while not self.acct.jobs or (
+            while not (self.acct.jobs or self._serving_live()) or (
                     self._expected_num_workers is not None
                     and len(self.workers.worker_ids) < self._expected_num_workers):
                 self._cv.wait()
@@ -1634,7 +1637,7 @@ class PhysicalScheduler(Scheduler):
                 self._end_round()
                 if self._shockwave_planner is not None:
                     self._update_shockwave_planner_physical(extended)
-                idle = not self.acct.jobs
+                idle = not self.acct.jobs and not self._serving_live()
             if final or idle and self._config.max_rounds is None:
                 if final or self._all_done():
                     break
@@ -1642,7 +1645,7 @@ class PhysicalScheduler(Scheduler):
 
     def _all_done(self):
         with self._lock:
-            return not self.acct.jobs
+            return not self.acct.jobs and not self._serving_live()
 
     @requires_lock
     def _update_shockwave_planner_physical(self, extended_leases):

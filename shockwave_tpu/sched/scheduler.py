@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from ..core import constants
+from ..core import trace as trace_mod
 from ..core.job import Job, JobIdPair
 from ..core.oracle import read_oracle
 from ..obs import Observability
@@ -53,6 +54,9 @@ class SchedulerClockAdapter(logging.LoggerAdapter):
 
 
 INFINITY = int(1e9)
+#: First integer job id of the serving-replica id space (disjoint from
+#: trace-job ids, which count up from 0 in trace position).
+SERVING_REPLICA_ID_BASE = 1_000_000_000
 DEFAULT_THROUGHPUT = 1.0
 EMA_ALPHA = 0.5
 MAX_FAILED_ATTEMPTS = 5
@@ -167,6 +171,14 @@ class SchedulerConfig:
     # (view in Perfetto, or summarize with
     # `python -m shockwave_tpu.obs.report`). None skips the export.
     obs_trace_path: Optional[str] = None
+    # ---- serving tier (both modes; see README "Serving tier" and
+    # configs/serving_mixed.json) ----
+    # Autoscaler options for latency-SLO serving jobs
+    # (serving.AutoscalerConfig fields: headroom, scale_down_patience,
+    # min_requests_per_round, max_cluster_fraction). None uses the
+    # defaults; the tier itself only exists once a serving job arrives,
+    # so training-only traces never touch this path.
+    serving: Optional[dict] = None
 
 
 class Scheduler:
@@ -287,6 +299,20 @@ class Scheduler:
         # Dynamic adaptation (accordion/GNS) request flags.
         self._bs_flags: Dict[JobIdPair, Dict[str, bool]] = {}
 
+        # Serving tier (shockwave_tpu/serving/): constructed lazily on
+        # the first serving job, None for training-only traces — every
+        # serving hook below is guarded on it, so the canonical replay
+        # never executes serving code. _serving_job_ids holds every
+        # REPLICA job id ever admitted (kept after removal: metrics
+        # filters read it), never service anchors. Replicas draw ids
+        # from their OWN counter so trace-position invariants survive:
+        # profiles stay positionally indexable by int_id for training
+        # jobs arriving after a scale-up, and num_jobs_submitted stays
+        # a valid trace-resume cursor.
+        self._serving_tier = None
+        self._serving_job_ids: Set[JobIdPair] = set()
+        self._serving_replica_id_counter = SERVING_REPLICA_ID_BASE
+
         # Profiles indexed by integer job id (Shockwave solver input).
         self._profiles = profiles
 
@@ -401,6 +427,7 @@ class Scheduler:
         "_scheduled_jobs_in_current_round", "_scheduled_jobs_in_prev_round",
         "_shockwave_job_completed", "_rounds_since_reopt", "_rng",
         "_worker_type_shuffler", "_run_meta",
+        "_serving_tier", "_serving_job_ids", "_serving_replica_id_counter",
     )
     _PLANNER_SNAPSHOT_FIELDS = (
         "metadata", "completed", "schedules", "round_ptr", "share_series",
@@ -458,6 +485,9 @@ class Scheduler:
         for f in self._SNAPSHOT_FIELDS:
             if f in state:
                 setattr(self, f, state[f])
+        if self._serving_tier is not None:
+            # The tier pickles without its scheduler reference.
+            self._serving_tier.bind(self)
         planner_state = state.get("planner")
         if planner_state is not None:
             if self._shockwave_planner is None:
@@ -638,13 +668,91 @@ class Scheduler:
             self._shockwave_planner.solve_stats.append(
                 SolveStats(**{k: v for k, v in data.items() if k in known}))
 
+    def _replay_serving_retired(self, data: dict) -> None:
+        if self._serving_tier is not None:
+            self._serving_tier.force_retire(int(data["int_id"]),
+                                            float(data["ts"]))
+
+    def _emit_serving_retired(self, int_id: int, ts: float) -> None:
+        """Journal a service retirement (called by the serving tier; the
+        emit lives here so the journal-coverage invariant sees the
+        emit/replay pair side by side)."""
+        self._emit("serving_retired", int_id=int_id, ts=ts)
+
+    # ------------------------------------------------------------------
+    # Serving tier
+    # ------------------------------------------------------------------
+
+    def _ensure_serving_tier(self):
+        if self._serving_tier is None:
+            from ..serving.tier import ServingTier
+            self._serving_tier = ServingTier(self, self._config.serving)
+        return self._serving_tier
+
+    def _serving_live(self) -> bool:
+        """Whether any serving service is still within its lifetime —
+        the scheduler must keep rolling rounds for it even with no
+        training jobs (and no replicas: scale-to-zero troughs still
+        need the autoscaler consulted every round)."""
+        return (self._serving_tier is not None
+                and self._serving_tier.has_live_services())
+
+    def serving_summary(self) -> Optional[dict]:
+        """SLO-attainment summary across all serving services, or None
+        for training-only traces (drivers put this in their metrics)."""
+        if self._serving_tier is None:
+            return None
+        return self._serving_tier.summary()
+
+    def _admit_serving_service(self, job: Job, timestamp: Optional[float],
+                               params: dict) -> JobIdPair:
+        """Admit a serving SERVICE (the trace anchor). The service never
+        enters the training books (acct.jobs / priorities / planner) —
+        the tier expands it into autoscaled replica jobs, which do."""
+        job_id = JobIdPair(self._job_id_counter)
+        self._job_id_counter += 1
+        job.job_id = job_id
+        int_id = job_id.integer_job_id()
+        self._num_jobs_in_trace += 1
+        ts = (timestamp if timestamp is not None
+              else self.get_current_timestamp())
+        self._ensure_serving_tier().register_service(int_id, job, params, ts)
+        self._job_timelines[int_id] = [
+            f"t={ts:.1f} SUBMITTED {job.job_type} serving service "
+            f"slo_p99={job.SLO}s lifetime={float(job._duration):.0f}s"]
+        self._obs.inc(obs_names.JOBS_SUBMITTED_TOTAL)
+        self._emit("job_added", int_id=int_id, ts=ts, job=dict(
+            job_type=job.job_type, command=job.command,
+            working_directory=job.working_directory,
+            num_steps_arg=job.num_steps_arg, total_steps=job.total_steps,
+            duration=float(job._duration), scale_factor=job.scale_factor,
+            mode=job.mode, priority_weight=job.priority_weight,
+            SLO=job.SLO, needs_data_dir=job.needs_data_dir))
+        self.log.info("[Serving service admitted] job %s (%s, slo_p99=%ss)",
+                      job_id, job.job_type, job.SLO)
+        return job_id
+
     # ------------------------------------------------------------------
     # Job lifecycle
     # ------------------------------------------------------------------
 
     def add_job(self, job: Job, timestamp: Optional[float] = None) -> JobIdPair:
-        job_id = JobIdPair(self._job_id_counter)
-        self._job_id_counter += 1
+        serving_params = None
+        if trace_mod.is_serving_job(job):
+            serving_params = trace_mod.parse_serving_command(job.command)
+            if "replica_of" not in serving_params:
+                # A serving SERVICE (trace anchor): tier-owned, not a
+                # schedulable job. Replicas (--replica_of) fall through
+                # to the normal path below with serving-aware guards.
+                return self._admit_serving_service(job, timestamp,
+                                                   serving_params)
+        if serving_params is not None:
+            # Replica ids come from their own space (see __init__).
+            job_id = JobIdPair(self._serving_replica_id_counter)
+            self._serving_replica_id_counter += 1
+        else:
+            job_id = JobIdPair(self._job_id_counter)
+            self._job_id_counter += 1
         job.job_id = job_id
         a = self.acct
         a.jobs[job_id] = job
@@ -657,7 +765,10 @@ class Scheduler:
         a.original_bs[job_id] = job.batch_size
         a.original_num_steps[job_id] = job.total_steps
         a.original_job_type[job_id] = job.job_type
-        self._num_jobs_in_trace += 1
+        if serving_params is None:
+            # Replicas are autoscaling artifacts, not trace jobs: they
+            # must not inflate the FTF static contention factor.
+            self._num_jobs_in_trace += 1
 
         self._throughputs[job_id] = {}
         for wt in self.workers.worker_types:
@@ -670,21 +781,26 @@ class Scheduler:
             # _throughputs, so the measured rate drives everything.
             for wt in self.workers.worker_types:
                 self._throughputs[job_id][wt] = override
-        if self._job_packing:
+        if self._job_packing and serving_params is None:
             self._populate_pair_throughputs(job_id)
 
         ts = timestamp if timestamp is not None else self.get_current_timestamp()
         a.start_timestamps[job_id] = ts
         a.latest_timestamps[job_id] = None
-        self._add_to_priorities(job_id)
+        if serving_params is None:
+            # Serving replicas are scheduled by reservation (tier.
+            # plan_round), never by policy priority.
+            self._add_to_priorities(job_id)
         self._need_to_update_allocation = True
         self._bs_flags[job_id] = {"big_bs": False, "small_bs": False}
         self._steps_run_in_current_lease[job_id] = 0
 
         self._job_cost_so_far[job_id] = 0.0
-        if job.SLO is not None and job.duration:
+        if job.SLO is not None and job.duration and serving_params is None:
             # SLO is a multiplier on the job's isolated duration; the
             # deadline is an absolute timestamp (reference: scheduler.py:724-730).
+            # Serving reinterprets SLO as a p99 latency target — the
+            # completion-deadline machinery does not apply.
             self._slo_deadlines[job_id] = job.SLO * job.duration + ts
 
         int_id = job_id.integer_job_id()
@@ -695,7 +811,7 @@ class Scheduler:
         self.rounds.num_queued_rounds[int_id] = 0
         self.rounds.job_start_round[int_id] = self.rounds.num_completed_rounds
 
-        if self._shockwave_planner is not None:
+        if self._shockwave_planner is not None and serving_params is None:
             from ..shockwave.metadata import JobMetadata
             profile = self._profiles[int_id]
             meta = JobMetadata(int_id, profile)
@@ -705,7 +821,14 @@ class Scheduler:
                 self._throughput_timeline[int_id], self._time_per_iteration)
             self._shockwave_planner.add_job(int_id, meta)
         else:
+            # LP policies, and serving replicas under any policy (the
+            # planner never sees them; there is no epoch profile).
             self._throughput_timeline[job_id.integer_job_id()] = collections.OrderedDict()
+
+        if serving_params is not None:
+            self._serving_job_ids.add(job_id)
+            self._ensure_serving_tier().adopt_replica(job_id, job,
+                                                      serving_params)
 
         self._obs.inc(obs_names.JOBS_SUBMITTED_TOTAL)
         self._emit("job_added", int_id=int_id, ts=ts, job=dict(
@@ -751,6 +874,8 @@ class Scheduler:
                 planner.mark_progress(int_id, planner.metadata[int_id].epochs)
                 planner.remove_job(int_id)
             self._shockwave_job_completed = True
+        if self._serving_tier is not None and job_id in self._serving_job_ids:
+            self._serving_tier.on_replica_removed(job_id)
         self._remove_from_priorities(job_id)
         self._need_to_update_allocation = True
         self._obs.inc(obs_names.JOBS_COMPLETED_TOTAL)
@@ -775,6 +900,8 @@ class Scheduler:
                 self.acct.steps_run[job_id][worker_type] = 0
                 self.acct.job_time[job_id][worker_type] = self._time_per_iteration / 2.0
                 self._set_initial_throughput(job_id, worker_type)
+                if job_id in self._serving_job_ids:
+                    continue  # replicas stay out of priorities/packing
                 if self._job_packing:
                     # Extend existing pair entries with the new worker type.
                     self._populate_pair_throughputs(job_id)
@@ -867,6 +994,13 @@ class Scheduler:
 
     def _set_initial_throughput(self, job_id: JobIdPair, worker_type: str):
         job = self.acct.jobs[job_id]
+        if trace_mod.is_serving_job(job):
+            # A serving replica's "steps" are requests served: seed from
+            # the command's decode-rate parameters (the same mu the
+            # latency model plans with); physical mode EMA-refines it.
+            self._throughputs[job_id][worker_type] = (
+                trace_mod.serving_service_rate(job.command))
+            return
         key = (job.job_type, job.scale_factor)
         oracle = (self._oracle_throughputs or {}).get(worker_type)
         if (oracle is not None and key in oracle
@@ -971,6 +1105,11 @@ class Scheduler:
         for wt in self.workers.worker_types:
             self.acct.worker_type_time[wt] = 0.0
             for job_id in self.acct.job_time:
+                if job_id in self._serving_job_ids:
+                    # Serving replicas run by reservation, outside the
+                    # fair-share books: their time must not dilute the
+                    # training jobs' received fractions.
+                    continue
                 received = self.acct.job_time[job_id].get(wt, 0.0) - (
                     self._time_per_iteration / 2.0)
                 if job_id in self._allocation:
@@ -1039,17 +1178,28 @@ class Scheduler:
     def _allocation_state(self) -> dict:
         a = self.acct
         now = self.get_current_timestamp()
+        # Serving replicas are scheduled by reservation ahead of the
+        # policy — exclude them from the LP's job set, and shrink the
+        # cluster it divides by the chips serving currently holds.
+        # (Both filters are identity for training-only traces.)
+        serving = self._serving_job_ids
+        job_ids = [j for j in a.jobs if j not in serving]
+        cluster_spec = dict(self.workers.cluster_spec)
+        if self._serving_tier is not None:
+            for wt, n in self._serving_tier.last_reserved.items():
+                cluster_spec[wt] = max(cluster_spec.get(wt, 0) - n, 0)
         num_steps_remaining = {}
-        for job_id in a.jobs:
+        for job_id in job_ids:
             remaining = self._get_remaining_steps(job_id)
             remaining -= self._steps_run_in_current_lease[job_id]
             num_steps_remaining[job_id] = remaining
         return {
-            "scale_factors": {j: a.jobs[j].scale_factor for j in a.jobs},
-            "priority_weights": {j: a.jobs[j].priority_weight for j in a.jobs},
+            "scale_factors": {j: a.jobs[j].scale_factor for j in job_ids},
+            "priority_weights": {j: a.jobs[j].priority_weight
+                                 for j in job_ids},
             "num_steps_remaining": num_steps_remaining,
             "times_since_start": {
-                j: now - a.start_timestamps[j] for j in a.jobs},
+                j: now - a.start_timestamps[j] for j in job_ids},
             # Explicit two-level copy (pair entries hold [a, b] lists the
             # EMA mutates in place) instead of deepcopy: this snapshot is
             # rebuilt every allocation solve and deepcopy's memo
@@ -1058,9 +1208,10 @@ class Scheduler:
             "throughputs": {
                 job_id: {wt: (list(v) if isinstance(v, list) else v)
                          for wt, v in per_wt.items()}
-                for job_id, per_wt in self._throughputs.items()},
+                for job_id, per_wt in self._throughputs.items()
+                if job_id not in serving},
             "per_round_schedule": list(self.rounds.per_round_schedule),
-            "cluster_spec": dict(self.workers.cluster_spec),
+            "cluster_spec": cluster_spec,
             "instance_costs": self._config.per_worker_type_prices,
         }
 
@@ -1116,8 +1267,14 @@ class Scheduler:
     def _get_remaining_steps(self, job_id: JobIdPair) -> int:
         return self.acct.jobs[job_id].total_steps - self.acct.total_steps_run[job_id]
 
-    def _select_jobs_for_round(self, worker_types: List[str]) -> dict:
-        """Pick (job_id, scale_factor) lists per worker type for next round."""
+    def _select_jobs_for_round(self, worker_types: List[str],
+                               reserved: Optional[Dict[str, int]] = None
+                               ) -> dict:
+        """Pick (job_id, scale_factor) lists per worker type for next
+        round. `reserved` (worker_type -> chips) is what the serving
+        tier already claimed this round; training selection budgets over
+        the remainder."""
+        reserved = reserved or {}
         if self._policy.name == "shockwave":
             job_ids = self._shockwave_planner.round_schedule()
             self._scheduled_jobs_in_prev_round = self._scheduled_jobs_in_current_round
@@ -1125,7 +1282,8 @@ class Scheduler:
             scheduled = {wt: [] for wt in worker_types}
             # The planner budgets against total chips; spread the selected
             # jobs across worker types by remaining capacity.
-            capacity = {wt: self.workers.cluster_spec[wt] for wt in worker_types}
+            capacity = {wt: self.workers.cluster_spec[wt]
+                        - reserved.get(wt, 0) for wt in worker_types}
             for int_id in job_ids:
                 job_id = JobIdPair(int_id)
                 if job_id not in self.acct.jobs:
@@ -1143,7 +1301,8 @@ class Scheduler:
             return scheduled
 
         scheduled = {wt: [] for wt in worker_types}
-        workers_left = {wt: self.workers.cluster_spec[wt] for wt in worker_types}
+        workers_left = {wt: self.workers.cluster_spec[wt]
+                        - reserved.get(wt, 0) for wt in worker_types}
         already: Set[JobIdPair] = set()
 
         queue = []
@@ -1181,10 +1340,18 @@ class Scheduler:
             scheduled[wt].append((job_id, scale_factor))
         return scheduled
 
-    def _assign_workers(self, scheduled: dict, worker_types: List[str]) -> "collections.OrderedDict":
-        """Map selected jobs to concrete chip ids, sticky where possible."""
+    def _assign_workers(self, scheduled: dict, worker_types: List[str],
+                        serving_assignments: Optional[
+                            "collections.OrderedDict"] = None,
+                        ) -> "collections.OrderedDict":
+        """Map selected jobs to concrete chip ids, sticky where possible.
+        `serving_assignments` (replica -> chips, from tier.plan_round)
+        are merged in FIRST: their chips are excluded from the training
+        pools AND from sticky reuse, and the one-chip-one-job invariant
+        below covers both tiers."""
         new_assignments: "collections.OrderedDict[JobIdPair, Tuple[int, ...]]" = (
-            collections.OrderedDict())
+            collections.OrderedDict(serving_assignments or ()))
+        reserved_chips = {w for ids in new_assignments.values() for w in ids}
         prev_types = {
             job_id: self.workers.id_to_type[ids[0]]
             for job_id, ids in self.rounds.current_assignments.items()}
@@ -1195,9 +1362,11 @@ class Scheduler:
                 # _take_workers pops chips off the inner server lists, so
                 # copy both levels — but they are plain lists of ints, and
                 # deepcopy here ran every round on the hot path.
-                "servers": [list(s)
+                # Serving-reserved chips never enter the pools, and
+                # seeding `assigned` with them blocks sticky reuse too.
+                "servers": [[w for w in s if w not in reserved_chips]
                             for s in self.workers.type_to_server_ids[wt]],
-                "assigned": set(),
+                "assigned": set(reserved_chips),
                 "ptr": 0,
             }
             scale_factors = sorted({sf for _, sf in scheduled[wt]}, reverse=True)
@@ -1259,6 +1428,16 @@ class Scheduler:
         return taken if len(taken) == count else None
 
     def _schedule_jobs_on_workers(self) -> "collections.OrderedDict":
+        serving_assignments = None
+        reserved = None
+        if self._serving_tier is not None:
+            # Serving plans FIRST: the tier retires/spawns/drains
+            # replicas, reserves their chips, and shrinks the capacity
+            # row the MILP sees — training budgets over the remainder.
+            with self._obs.phase(obs_names.SPAN_SERVING_PLAN,
+                                 round=self.rounds.num_completed_rounds):
+                serving_assignments = self._serving_tier.plan_round()
+            reserved = dict(self._serving_tier.last_reserved)
         if self._policy.name != "shockwave":
             self._update_priorities()
         worker_types = [wt for wt in ("v100", "p100", "k80")
@@ -1268,8 +1447,9 @@ class Scheduler:
         if "Perf" not in self._policy.name and "Packing" not in self._policy.name:
             self._worker_type_shuffler.shuffle(worker_types)
 
-        scheduled = self._select_jobs_for_round(worker_types)
-        assignments = self._assign_workers(scheduled, worker_types)
+        scheduled = self._select_jobs_for_round(worker_types, reserved)
+        assignments = self._assign_workers(scheduled, worker_types,
+                                           serving_assignments)
 
         int_assignments = {}
         for job_id, ids in assignments.items():
@@ -1605,7 +1785,10 @@ class Scheduler:
             max_time = max(agg_times)
             if job_id in a.job_time:
                 a.job_time[job_id][worker_type] += max_time
-                a.worker_type_time[worker_type] += max_time
+                if job_id not in self._serving_job_ids:
+                    # Serving time stays out of the fair-share
+                    # denominator (replicas run by reservation).
+                    a.worker_type_time[worker_type] += max_time
             for w in all_worker_ids:
                 self.workers.cumulative_time[w] += max_time
 
@@ -1728,6 +1911,9 @@ class Scheduler:
         if self._shockwave_planner is not None:
             self._shockwave_planner.obs = self._obs
             self._shockwave_planner.journal = self._emit_event
+        if self._serving_tier is not None:
+            # The tier pickles without its scheduler reference.
+            self._serving_tier.bind(self)
         return (state["queued"], state["running"], state["remaining_jobs"],
                 state["current_round"])
 
@@ -1768,6 +1954,16 @@ class Scheduler:
                     self.register_worker(worker_type, num_chips=chips)
 
             queued = list(zip(arrival_times, jobs))
+            if any(b < a for (a, _), (b, _) in zip(queued, queued[1:])):
+                # Ids (and the positional profiles list) follow FILE
+                # order while admission is gated on the head's arrival:
+                # an out-of-order line is held back to its
+                # predecessor's arrival. Loud, because the fix belongs
+                # in the trace, not in a reordering here (which would
+                # desynchronize job ids from the profiles list).
+                self.log.warning(
+                    "trace arrivals are not sorted; out-of-order jobs "
+                    "will be admitted late (sort the trace by arrival)")
             remaining_jobs = len(jobs)
             self._current_timestamp = (arrival_times[0]
                                        if len(arrival_times) else 0.0)
@@ -1809,8 +2005,16 @@ class Scheduler:
             elif next_arrival is not None:
                 # max(): a burned replay round may already have pushed
                 # the clock past this arrival — never rewind it.
-                self._current_timestamp = max(self._current_timestamp,
-                                              next_arrival)
+                target = max(self._current_timestamp, next_arrival)
+                if self._serving_live():
+                    # A live service must be consulted every round even
+                    # while idle — jumping straight to a far-future
+                    # arrival would skip its load ramp (no scale-up, no
+                    # SLO accounting for the gap). Bound the jump to one
+                    # round; the loop walks the rest round by round.
+                    target = min(target, self._current_timestamp
+                                 + self._time_per_iteration)
+                self._current_timestamp = target
                 forced_resolve = False
             elif self.acct.jobs and not forced_resolve:
                 # Dead air: jobs are waiting but the allocation-reset
@@ -1823,6 +2027,13 @@ class Scheduler:
                     self._current_timestamp
                     - self._config.minimum_time_between_allocation_resets)
                 self._need_to_update_allocation = True
+            elif self._serving_live():
+                # Nothing running and no arrivals, but a serving service
+                # is within its lifetime (possibly at zero replicas):
+                # roll the clock one round so the autoscaler keeps being
+                # consulted and the service can scale back up / retire.
+                self._current_timestamp += self._time_per_iteration
+                forced_resolve = False
             else:
                 self.log.warning("no running jobs and no arrivals; stopping")
                 break
@@ -1898,7 +2109,7 @@ class Scheduler:
                 arrival_time, job = queued.pop(0)
                 self.add_job(job, timestamp=arrival_time)
 
-            if not self.acct.jobs:
+            if not self.acct.jobs and not self._serving_live():
                 if not queued:
                     break
                 continue
@@ -1927,6 +2138,10 @@ class Scheduler:
                 with self._obs.phase(obs_names.SPAN_SOLVE,
                                      round=current_round):
                     assignments = self._schedule_jobs_on_workers()
+            if self._serving_tier is not None:
+                # Services retired by this round's serving plan leave
+                # the trace's remaining-jobs budget.
+                remaining_jobs -= self._serving_tier.take_retired_count()
             for job_id in self.rounds.current_assignments:
                 if any(m in self.acct.jobs for m in job_id.singletons()):
                     self.rounds.num_lease_opportunities += 1
@@ -2104,7 +2319,12 @@ class Scheduler:
         ct = self.acct.completion_times
         if not ct:
             return None
-        job_ids = sorted(job_ids if job_ids is not None else ct.keys())
+        # Serving replicas "complete" at scale-down/retirement — a JCT
+        # is meaningless for them (serving quality lives in
+        # serving_summary()), so they stay out of training aggregates.
+        job_ids = sorted(j for j in (job_ids if job_ids is not None
+                                     else ct.keys())
+                         if j not in self._serving_job_ids)
         times = [ct[j] for j in job_ids if ct[j] is not None]
         if not times:
             return None
@@ -2122,7 +2342,9 @@ class Scheduler:
         if not ct:
             return [], []
         num_chips = len(self.workers.worker_ids)
-        job_ids = sorted(job_ids if job_ids is not None else ct.keys())
+        job_ids = sorted(j for j in (job_ids if job_ids is not None
+                                     else ct.keys())
+                         if j not in self._serving_job_ids)
         static_list, themis_list = [], []
         for job_id in job_ids:
             completion_time = ct[job_id]
@@ -2209,7 +2431,11 @@ class Scheduler:
         return self._last_completion_time
 
     def get_num_completed_jobs(self) -> int:
-        return len(self._completed_jobs)
+        """Completed TRACE jobs: training jobs plus retired serving
+        services. Serving replicas (internal autoscaling artifacts, not
+        trace jobs) are excluded."""
+        return len([j for j in self._completed_jobs
+                    if j not in self._serving_job_ids])
 
     def get_throughput_timeline(self):
         """Per-job {round: (throughput, batch_size)} measurement history."""

@@ -1,0 +1,23 @@
+"""Serving tier: latency-SLO inference jobs co-scheduled with training.
+
+- `load` — deterministic diurnal/bursty request-rate curves.
+- `latency_model` — analytic M/M/c (offered load, replicas) -> p50/p99.
+- `autoscaler` — round-by-round replica targets with hysteresis,
+  scale-to-zero, and a cluster-share cap.
+- `tier` — the coordinator wired into the scheduler's round loop:
+  replica lifecycle, chip reservation ahead of the training planner,
+  and requests-weighted SLO-attainment accounting.
+
+See README "Serving tier" and the trace-level job class in
+`core/trace.py` (mode ``serving``).
+"""
+from .autoscaler import Autoscaler, AutoscalerConfig
+from .latency_model import (p50_latency, p99_latency, replicas_for_slo)
+from .load import DiurnalLoad, Spike, seeded_spikes
+from .tier import ServingService, ServingTier
+
+__all__ = [
+    "Autoscaler", "AutoscalerConfig", "DiurnalLoad", "ServingService",
+    "ServingTier", "Spike", "p50_latency", "p99_latency",
+    "replicas_for_slo", "seeded_spikes",
+]

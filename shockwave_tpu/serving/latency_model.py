@@ -1,0 +1,92 @@
+"""Analytic per-service queueing model: (offered load, replicas) -> latency.
+
+Each replica is one autoregressive decode server working through a
+shared request queue, so a service with `c` replicas is modeled as an
+M/M/c queue: Poisson arrivals at rate ``lam`` (the diurnal/bursty curve
+from serving/load.py), exponential-ish service at rate ``mu`` per
+replica (``decode_tokens_per_s / tokens_per_request`` — the O(1)
+KV-cached decode cost model makes per-request service time essentially
+length-proportional, PAPERS.md 2603.09555). Latency quantiles come from
+Erlang-C:
+
+    P(wait > t) = C(c, lam/mu) * exp(-(c*mu - lam) * t)
+
+so the q-quantile of sojourn time is the service time plus
+``ln(C / (1-q)) / (c*mu - lam)`` when C > 1-q. Everything is a pure
+closed-form function of (lam, c, mu): the simulator, the autoscaler and
+the SLO-attainment accounting all evaluate the same deterministic
+numbers, which is what makes mixed-trace replays bit-identical.
+"""
+from __future__ import annotations
+
+import math
+
+#: Sentinel latency of a saturated (or empty) replica pool under load.
+SATURATED = float("inf")
+
+
+def erlang_c(c: int, offered: float) -> float:
+    """Probability an arrival must queue in an M/M/c with offered load
+    ``offered = lam/mu`` Erlangs. 1.0 at/over saturation, 0.0 with no
+    load. Computed with the standard iterative recurrence (numerically
+    stable for the replica counts a chip pool can hold)."""
+    if offered <= 0.0:
+        return 0.0
+    if c <= 0 or offered >= c:
+        return 1.0
+    # inv_b is 1/B(k, offered) of the Erlang-B recurrence.
+    inv_b = 1.0
+    for k in range(1, c + 1):
+        inv_b = 1.0 + inv_b * k / offered
+    blocking = 1.0 / inv_b
+    rho = offered / c
+    return blocking / (1.0 - rho + rho * blocking)
+
+
+def latency_quantile(lam: float, replicas: int, mu: float,
+                     q: float) -> float:
+    """q-quantile of request sojourn time (wait + service), seconds.
+
+    SATURATED when the pool cannot keep up (lam >= c*mu) — every queue
+    length diverges — and plain service time when there is no load."""
+    if mu <= 0.0:
+        raise ValueError(f"service rate mu must be positive, got {mu}")
+    if not 0.0 < q < 1.0:
+        raise ValueError(f"quantile must be in (0, 1), got {q}")
+    service = 1.0 / mu
+    if lam <= 0.0:
+        return service
+    if replicas <= 0 or lam >= replicas * mu:
+        return SATURATED
+    p_queue = erlang_c(replicas, lam / mu)
+    if p_queue <= (1.0 - q):
+        return service
+    wait = math.log(p_queue / (1.0 - q)) / (replicas * mu - lam)
+    return service + wait
+
+
+def p50_latency(lam: float, replicas: int, mu: float) -> float:
+    return latency_quantile(lam, replicas, mu, 0.5)
+
+
+def p99_latency(lam: float, replicas: int, mu: float) -> float:
+    return latency_quantile(lam, replicas, mu, 0.99)
+
+
+def replicas_for_slo(lam: float, mu: float, slo_p99_s: float,
+                     max_replicas: int) -> int:
+    """Smallest replica count whose p99 meets the SLO at arrival rate
+    ``lam``, capped at ``max_replicas`` (best effort when even the cap
+    cannot meet it). 0 when there is no load to serve."""
+    if lam <= 0.0:
+        return 0
+    if max_replicas <= 0:
+        return 0
+    for c in range(max(1, math.ceil(lam / mu)), max_replicas + 1):
+        if p99_latency(lam, c, mu) <= slo_p99_s:
+            return c
+    return max_replicas
+
+
+__all__ = ["SATURATED", "erlang_c", "latency_quantile", "p50_latency",
+           "p99_latency", "replicas_for_slo"]

@@ -1,0 +1,507 @@
+"""Serving tier: co-schedules latency-SLO inference services with training.
+
+One `ServingTier` hangs off a scheduler (simulated or physical) and owns
+every serving *service* in the trace. A service (the trace line, mode
+``serving``) is a descriptor — load curve, SLO, per-replica service
+rate, lifetime — that the tier expands into autoscaled *replica jobs*:
+gang-of-1 jobs (``mode="serving"``) that flow through the existing
+round-lease / dispatch / cooperative-preemption machinery unchanged,
+their "progress" being requests served.
+
+Integration contract (see Scheduler._schedule_jobs_on_workers):
+
+- `plan_round()` runs at every round-scheduling point, BEFORE training
+  selection: it retires expired services, reconciles replica counts to
+  the autoscaler's target, assigns chips to replicas (sticky where the
+  previous chip is alive), and returns the serving assignments. The
+  chips it reserves are subtracted from the capacity the training
+  selector AND the Shockwave MILP see — serving preempts training under
+  spikes and hands the chips back at troughs, by construction rather
+  than by priority fighting.
+- SLO attainment is accounted analytically per round from the same
+  deterministic load curve and M/M/c model the autoscaler planned with,
+  so the simulator evaluates serving quality bit-identically across
+  replays.
+- When a trace has no serving jobs the tier is never constructed and
+  every hook is a no-op — the canonical training-only replay is
+  untouched.
+
+Pickles with scheduler snapshots (the scheduler reference is dropped and
+re-bound on restore); replica add/remove rides the existing job journal
+events, service registration/retirement adds two small event types.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..core.job import Job, JobIdPair
+from ..core.trace import parse_serving_command, serving_service_rate
+from ..obs import names as obs_names
+from .autoscaler import Autoscaler, AutoscalerConfig
+from .latency_model import p99_latency
+from .load import DiurnalLoad, Spike, seeded_spikes
+
+logger = logging.getLogger("shockwave_tpu.serving")
+
+#: Samples per round window for load evaluation and SLO accounting.
+WINDOW_SAMPLES = 8
+#: Per-service round-history entries retained (physical services can
+#: run indefinitely; the full series lives in obs, not here).
+HISTORY_LIMIT = 10000
+
+
+def _load_from_params(params: dict, lifetime_s: float) -> DiurnalLoad:
+    spikes: Tuple[Spike, ...] = tuple(
+        Spike(s, d, m) for s, d, m in params.get("spikes", ()))
+    seed = params.get("spike_seed")
+    if seed is not None and params.get("num_spikes", 0) > 0:
+        spikes = spikes + seeded_spikes(
+            int(seed), lifetime_s, int(params["num_spikes"]),
+            float(params.get("spike_mult", 10.0)),
+            float(params.get("spike_duration_s", 1800.0)))
+    return DiurnalLoad(
+        base_rps=float(params.get("base_rps", 0.0)),
+        peak_rps=float(params.get("peak_rps", params.get("base_rps", 0.0))),
+        period_s=float(params.get("period_s", 0.0)),
+        phase_s=float(params.get("phase_s", 0.0)),
+        spikes=spikes)
+
+
+class ServingService:
+    """One registered serving service and its autoscaling state."""
+
+    def __init__(self, int_id: int, job: Job, params: dict,
+                 arrival_ts: float, autoscaler_config: AutoscalerConfig):
+        self.int_id = int_id
+        self.job = job                      # anchor (never in acct.jobs)
+        self.params = dict(params)
+        self.arrival_ts = float(arrival_ts)
+        self.lifetime_s = float(job._duration)
+        self.slo_p99_s = float(job.SLO) if job.SLO is not None else 1.0
+        self.mu = serving_service_rate(job.command)
+        self.max_replicas = int(params.get("max_replicas", 8))
+        self.load = _load_from_params(params, self.lifetime_s)
+        self.autoscaler = Autoscaler(autoscaler_config)
+        #: Active replicas: JobIdPair -> replica index.
+        self.replicas: "collections.OrderedDict[JobIdPair, int]" = (
+            collections.OrderedDict())
+        #: Replicas draining out (excluded from assignment; removed from
+        #: the scheduler once their in-flight round has completed).
+        self.draining: "collections.OrderedDict[JobIdPair, int]" = (
+            collections.OrderedDict())
+        self.next_replica_index = 0
+        self.retired = False
+        self.retired_ts: Optional[float] = None
+        # -- round accounting (requests-weighted SLO attainment) --------
+        self.target = 0
+        self.requests_offered = 0.0
+        self.requests_ok = 0.0
+        self.rounds_total = 0
+        self.rounds_at_zero = 0
+        self.rounds_violated = 0
+        self.peak_assigned = 0
+        self.history: List[dict] = []
+
+    @property
+    def label(self) -> str:
+        return str(self.int_id)
+
+    def attainment(self) -> float:
+        if self.requests_offered <= 0.0:
+            return 1.0
+        return self.requests_ok / self.requests_offered
+
+    def summary(self) -> dict:
+        return {
+            "service": self.int_id,
+            "slo_p99_s": self.slo_p99_s,
+            "mu_requests_per_s": self.mu,
+            "requests_offered": round(self.requests_offered, 2),
+            "requests_within_slo": round(self.requests_ok, 2),
+            "slo_attainment": round(self.attainment(), 6),
+            "rounds": self.rounds_total,
+            "rounds_at_zero_replicas": self.rounds_at_zero,
+            "rounds_with_violation": self.rounds_violated,
+            "peak_replicas": self.peak_assigned,
+            "retired": self.retired,
+        }
+
+
+class ServingTier:
+    """Coordinator for all serving services of one scheduler."""
+
+    def __init__(self, sched, config: Optional[dict] = None):
+        self._sched = sched
+        self.autoscaler_config = AutoscalerConfig.from_dict(config or {})
+        self.services: "collections.OrderedDict[int, ServingService]" = (
+            collections.OrderedDict())
+        #: int replica job id -> service int id (reverse index).
+        self._replica_service: Dict[int, int] = {}
+        self._retired_unreaped = 0
+        #: worker_type -> chips reserved by the LAST plan_round (what
+        #: _allocation_state subtracts from the cluster the LP sees).
+        self.last_reserved: Dict[str, int] = {}
+
+    # The scheduler reference must not ride into snapshots/checkpoints
+    # (it would drag a ghost scheduler copy along); restore re-binds.
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_sched"] = None
+        return state
+
+    def bind(self, sched) -> None:
+        self._sched = sched
+
+    # ------------------------------------------------------------------
+    # Registration / lifecycle hooks (called from Scheduler.add_job etc.)
+    # ------------------------------------------------------------------
+
+    def register_service(self, int_id: int, job: Job, params: dict,
+                         arrival_ts: float) -> ServingService:
+        svc = ServingService(int_id, job, params, arrival_ts,
+                             self.autoscaler_config)
+        self.services[int_id] = svc
+        self._obs().set_gauge(obs_names.SERVING_SERVICES,
+                              len(self._live_services()))
+        logger.info("[Serving] service %d registered: slo_p99=%.3fs "
+                    "mu=%.2f req/s max_replicas=%d lifetime=%.0fs",
+                    int_id, svc.slo_p99_s, svc.mu, svc.max_replicas,
+                    svc.lifetime_s)
+        return svc
+
+    def adopt_replica(self, job_id: JobIdPair, job: Job,
+                      params: Optional[dict] = None) -> None:
+        """Attach a replica job (just admitted through add_job — live
+        spawn or journal replay) to its service."""
+        params = params or parse_serving_command(job.command)
+        service_id = int(params["replica_of"])
+        index = int(params.get("replica_index", 0))
+        svc = self.services.get(service_id)
+        if svc is None:
+            logger.warning("replica %s names unknown service %d; dropping "
+                           "it from the serving books", job_id, service_id)
+            return
+        svc.replicas[job_id] = index
+        svc.next_replica_index = max(svc.next_replica_index, index + 1)
+        self._replica_service[job_id.integer_job_id()] = service_id
+
+    def on_replica_removed(self, job_id: JobIdPair) -> None:
+        """Scheduler hook: a replica job left the active set (drain
+        completed, journal replay, or deadline enforcement)."""
+        service_id = self._replica_service.pop(job_id.integer_job_id(), None)
+        if service_id is None:
+            return
+        svc = self.services.get(service_id)
+        if svc is not None:
+            svc.replicas.pop(job_id, None)
+            svc.draining.pop(job_id, None)
+
+    def force_retire(self, int_id: int, ts: float) -> None:
+        """Journal replay of a service retirement (no planning runs
+        during replay; replica removal rides its own journal events)."""
+        svc = self.services.get(int_id)
+        if svc is None or svc.retired:
+            return
+        for job_id, index in list(svc.replicas.items()):
+            svc.draining[job_id] = index
+        svc.replicas.clear()
+        svc.retired = True
+        svc.retired_ts = ts
+        self._retired_unreaped += 1
+
+    # ------------------------------------------------------------------
+    # Queries
+    # ------------------------------------------------------------------
+
+    def _live_services(self) -> List[ServingService]:
+        return [s for s in self.services.values() if not s.retired]
+
+    def has_live_services(self) -> bool:
+        return any(not s.retired for s in self.services.values())
+
+    def has_replicas_in_flight(self) -> bool:
+        return any(s.replicas or s.draining for s in self.services.values())
+
+    def take_retired_count(self) -> int:
+        """Services retired since the last call (the simulator's
+        remaining-jobs decrement)."""
+        n = self._retired_unreaped
+        self._retired_unreaped = 0
+        return n
+
+    def reserved_total(self) -> int:
+        return sum(self.last_reserved.values())
+
+    def summary(self) -> dict:
+        services = [s.summary() for s in self.services.values()]
+        offered = sum(s.requests_offered for s in self.services.values())
+        ok = sum(s.requests_ok for s in self.services.values())
+        return {
+            "services": services,
+            "requests_offered": round(offered, 2),
+            "slo_attainment": round(ok / offered, 6) if offered > 0 else 1.0,
+        }
+
+    def _obs(self):
+        return self._sched.obs
+
+    # ------------------------------------------------------------------
+    # Round planning
+    # ------------------------------------------------------------------
+
+    def plan_round(self) -> "collections.OrderedDict[JobIdPair, Tuple[int, ...]]":
+        """Serving half of the round schedule. Called at every
+        round-scheduling point, before training selection; physical
+        callers hold the scheduler lock."""
+        sched = self._sched
+        now = sched.get_current_timestamp()
+        round_s = sched._time_per_iteration
+
+        self._reap_drained()
+        # Aggregate cluster-share budget: max_cluster_fraction bounds
+        # what ALL services together may reserve ahead of the training
+        # planner; earlier-registered services draw first.
+        cluster_chips = sum(sched.workers.cluster_spec.values())
+        budget = int(self.autoscaler_config.max_cluster_fraction
+                     * cluster_chips)
+        for svc in self._live_services():
+            t_rel = now - svc.arrival_ts
+            if t_rel >= svc.lifetime_s - 1e-9:
+                self._retire_service(svc, now)
+                continue
+            budget -= self._scale_service(svc, t_rel, round_s, budget)
+        self._reap_drained()
+
+        assignments = self._assign_chips()
+        self._account_round(assignments, now, round_s)
+
+        if sched._shockwave_planner is not None:
+            # Shrink the capacity row the MILP sees: the planner budgets
+            # training over what serving has not reserved.
+            sched._shockwave_planner.reserved_gpus = self.reserved_total()
+        return assignments
+
+    def _scale_service(self, svc: ServingService, t_rel: float,
+                       round_s: float, budget: int) -> int:
+        """Reconcile one service to its target; returns the chips it
+        claims against the tier's aggregate budget."""
+        window_end = min(t_rel + round_s, svc.lifetime_s)
+        peak = svc.load.peak_rate(t_rel, window_end, samples=WINDOW_SAMPLES)
+        cap = min(svc.max_replicas, max(budget, 0))
+        # min(): the autoscaler's committed level may predate a budget
+        # shrink (another service scaled up, chips died) — the cap wins.
+        target = min(svc.autoscaler.target_replicas(
+            peak, svc.mu, svc.slo_p99_s, cap, round_s), cap)
+        svc.target = target
+        active = len(svc.replicas)
+        if target > active:
+            for _ in range(target - active):
+                self._spawn_replica(svc)
+            self._obs().inc(obs_names.SERVING_SCALE_EVENTS_TOTAL,
+                            amount=target - active, direction="up")
+        elif target < active:
+            # Drain the highest-index replicas first (deterministic, and
+            # sticky placement keeps the longest-lived replicas warm).
+            for job_id, _ in sorted(svc.replicas.items(),
+                                    key=lambda kv: kv[1],
+                                    reverse=True)[: active - target]:
+                self._drain_replica(svc, job_id)
+            self._obs().inc(obs_names.SERVING_SCALE_EVENTS_TOTAL,
+                            amount=active - target, direction="down")
+        return target
+
+    def _spawn_replica(self, svc: ServingService) -> None:
+        sched = self._sched
+        index = svc.next_replica_index
+        svc.next_replica_index += 1
+        anchor = svc.job
+        replica = Job(
+            job_id=None, job_type=anchor.job_type,
+            command=(f"{anchor.command} --replica_of {svc.int_id} "
+                     f"--replica_index {index}"),
+            working_directory=anchor.working_directory,
+            num_steps_arg=anchor.num_steps_arg,
+            # Effectively unbounded step budget: a replica retires by
+            # scale-down or service end, never by finishing its steps.
+            total_steps=int(1e9),
+            duration=svc.lifetime_s,
+            scale_factor=1, mode=anchor.mode,
+            priority_weight=anchor.priority_weight, SLO=anchor.SLO,
+            needs_data_dir=False)
+        # add_job routes mode="serving" + --replica_of back through
+        # adopt_replica (same path journal replay takes).
+        sched.add_job(replica)
+
+    def _drain_replica(self, svc: ServingService, job_id: JobIdPair) -> None:
+        index = svc.replicas.pop(job_id, None)
+        if index is None:
+            return
+        svc.draining[job_id] = index
+
+    def _reap_drained(self) -> None:
+        """Remove draining replicas whose in-flight round (if any) has
+        completed — physically their lease was simply not renewed, so
+        the process checkpoints out at expiry and its Done lands before
+        the round rolls."""
+        sched = self._sched
+        for svc in self.services.values():
+            for job_id in list(svc.draining):
+                if not any(m in sched.acct.jobs
+                           for m in job_id.singletons()):
+                    svc.draining.pop(job_id, None)
+                    continue
+                in_flight = (
+                    job_id in sched.rounds.current_assignments
+                    and job_id not in sched.rounds.completed_in_round)
+                if in_flight:
+                    continue
+                svc.draining.pop(job_id, None)
+                sched._remove_job(job_id)
+
+    def _retire_service(self, svc: ServingService, now: float) -> None:
+        for job_id in list(svc.replicas):
+            self._drain_replica(svc, job_id)
+        svc.retired = True
+        svc.retired_ts = now
+        self._retired_unreaped += 1
+        sched = self._sched
+        sched._last_completion_time = max(sched._last_completion_time, now)
+        sched._completed_jobs.add(JobIdPair(svc.int_id))
+        sched._job_timelines.setdefault(svc.int_id, []).append(
+            f"t={now:.1f} SERVICE_RETIRED offered="
+            f"{svc.requests_offered:.1f} attainment={svc.attainment():.4f}")
+        sched._emit_serving_retired(svc.int_id, now)
+        self._obs().set_gauge(obs_names.SERVING_SERVICES,
+                              len(self._live_services()))
+        logger.info("[Serving] service %d retired after %.0fs: "
+                    "attainment=%.4f peak_replicas=%d", svc.int_id,
+                    now - svc.arrival_ts, svc.attainment(),
+                    svc.peak_assigned)
+
+    # ------------------------------------------------------------------
+    # Chip reservation
+    # ------------------------------------------------------------------
+
+    def _assign_chips(self) -> "collections.OrderedDict[JobIdPair, Tuple[int, ...]]":
+        """Reserve one chip per active replica, sticky where the
+        previous chip is still alive and unclaimed. Deterministic order:
+        services by id, replicas by index."""
+        sched = self._sched
+        workers = sched.workers
+        assignments: "collections.OrderedDict[JobIdPair, Tuple[int, ...]]" = (
+            collections.OrderedDict())
+        assigned: set = set()
+        # Per-type strided pools, same walk as Scheduler._take_workers.
+        pools = {
+            wt: [[w for w in server if w not in workers.dead]
+                 for server in workers.type_to_server_ids.get(wt, [])]
+            for wt in sorted(workers.type_to_server_ids)}
+        reserved: Dict[str, int] = {}
+
+        def take_chip() -> Optional[int]:
+            for wt in sorted(pools):
+                for server in pools[wt]:
+                    while server:
+                        w = server.pop(0)
+                        if w not in assigned:
+                            reserved[wt] = reserved.get(wt, 0) + 1
+                            return w
+            return None
+
+        for svc in self._live_services():
+            for job_id, _index in sorted(svc.replicas.items(),
+                                         key=lambda kv: kv[1]):
+                if not any(m in sched.acct.jobs
+                           for m in job_id.singletons()):
+                    continue
+                prev = sched.rounds.current_assignments.get(job_id)
+                if (prev and len(prev) == 1 and prev[0] not in assigned
+                        and prev[0] not in workers.dead):
+                    chip = prev[0]
+                    wt = workers.id_to_type[chip]
+                    reserved[wt] = reserved.get(wt, 0) + 1
+                else:
+                    chip = take_chip()
+                    if chip is None:
+                        logger.warning(
+                            "[Serving] no chip available for replica %s "
+                            "of service %d (cluster exhausted)", job_id,
+                            svc.int_id)
+                        continue
+                assigned.add(chip)
+                assignments[job_id] = (chip,)
+        self.last_reserved = reserved
+        return assignments
+
+    # ------------------------------------------------------------------
+    # SLO accounting
+    # ------------------------------------------------------------------
+
+    def _account_round(self, assignments, now: float, round_s: float) -> None:
+        sched = self._sched
+        obs = self._obs()
+        per_service: Dict[int, int] = {}
+        for job_id in assignments:
+            service_id = self._replica_service.get(job_id.integer_job_id())
+            if service_id is not None:
+                per_service[service_id] = per_service.get(service_id, 0) + 1
+        for svc in self._live_services():
+            n = per_service.get(svc.int_id, 0)
+            svc.rounds_total += 1
+            svc.peak_assigned = max(svc.peak_assigned, n)
+            t_rel = now - svc.arrival_ts
+            window_end = min(t_rel + round_s, svc.lifetime_s)
+            width = max(window_end - t_rel, 0.0)
+            step = width / WINDOW_SAMPLES if width > 0 else 0.0
+            offered = ok = 0.0
+            worst_p99 = 1.0 / svc.mu
+            violated = False
+            for i in range(WINDOW_SAMPLES if step > 0 else 0):
+                t = t_rel + (i + 0.5) * step
+                rate = svc.load.rate(t)
+                weight = rate * step
+                if weight <= 0.0:
+                    continue
+                p99 = p99_latency(rate, n, svc.mu)
+                worst_p99 = max(worst_p99, p99)
+                offered += weight
+                if p99 <= svc.slo_p99_s:
+                    ok += weight
+                else:
+                    violated = True
+            svc.requests_offered += offered
+            svc.requests_ok += ok
+            if violated:
+                svc.rounds_violated += 1
+            if n == 0 and svc.target == 0:
+                svc.rounds_at_zero += 1
+            svc.history.append(dict(
+                round=sched.rounds.num_completed_rounds, t=round(now, 3),
+                target=svc.target, assigned=n, offered=round(offered, 3),
+                p99_s=(None if worst_p99 == float("inf")
+                       else round(worst_p99, 6)),
+                ok=not violated))
+            if len(svc.history) > HISTORY_LIMIT:
+                del svc.history[: len(svc.history) - HISTORY_LIMIT]
+            obs.set_gauge(obs_names.SERVING_REPLICAS, n, service=svc.label)
+            obs.set_gauge(obs_names.SERVING_TARGET_REPLICAS, svc.target,
+                          service=svc.label)
+            if worst_p99 != float("inf"):
+                obs.set_gauge(obs_names.SERVING_P99_SECONDS, worst_p99,
+                              service=svc.label)
+            obs.set_gauge(obs_names.SERVING_SLO_ATTAINMENT,
+                          svc.attainment(), service=svc.label)
+            if offered > 0:
+                obs.inc(obs_names.SERVING_REQUESTS_TOTAL, amount=ok,
+                        service=svc.label, slo="ok")
+                if offered - ok > 0:
+                    obs.inc(obs_names.SERVING_REQUESTS_TOTAL,
+                            amount=offered - ok, service=svc.label,
+                            slo="violated")
+        obs.set_gauge(obs_names.SERVING_RESERVED_CHIPS,
+                      self.reserved_total())
+
+
+__all__ = ["ServingTier", "ServingService", "WINDOW_SAMPLES"]

@@ -74,6 +74,11 @@ class PlanRequest:
     jobs: List[_JobView]
     share_series: List[list]
     generation: int
+    #: Capacity row for this solve: cluster chips minus whatever the
+    #: serving tier reserved ahead of the planner (== ngpus when no
+    #: serving jobs exist, keeping training-only replays bit-identical).
+    #: -1 = unset (hand-built request): solve with the full cluster.
+    ngpus: int = -1
 
 
 @dataclass
@@ -93,6 +98,12 @@ class ShockwavePlanner:
         self.future_nrounds = future_nrounds
         self.round_duration = round_duration
         self.opts = opts or MilpOptions()
+
+        # Chips the serving tier has reserved ahead of the planner this
+        # round (shockwave_tpu/serving/tier.py): the capacity row every
+        # solve and fallback sees is ngpus - reserved_gpus. Stays 0 for
+        # training-only traces.
+        self.reserved_gpus = 0
 
         self.metadata: "OrderedDict[int, JobMetadata]" = OrderedDict()
         self.completed: "OrderedDict[int, JobMetadata]" = OrderedDict()
@@ -245,7 +256,8 @@ class ShockwavePlanner:
             job_ids=job_ids,
             jobs=[_JobView(m) for m in self.metadata.values()],
             share_series=[list(self.share_series[j]) for j in job_ids],
-            generation=self._resolve_gen)
+            generation=self._resolve_gen,
+            ngpus=max(self.ngpus - self.reserved_gpus, 0))
 
     def solve_prepared(self, request: PlanRequest,
                        pipelined: bool = False) -> PlanResult:
@@ -253,15 +265,30 @@ class ShockwavePlanner:
         a pure function of the request snapshot."""
         stats: list = []
         obs = self._obs_handle()
+        # Requests predating the ngpus field (old pickles, hand-built
+        # tests) carry the -1 sentinel: solve with the full cluster.
+        ngpus = getattr(request, "ngpus", -1)
+        if ngpus < 0:
+            ngpus = self.ngpus
+        if ngpus <= 0:
+            # Serving reserved the whole cluster this round: nothing to
+            # solve — every horizon round schedules no training.
+            schedules: "OrderedDict[int, List[int]]" = OrderedDict(
+                (request.round_ptr + r, [])
+                for r in range(self.future_nrounds))
+            return PlanResult(round_ptr=request.round_ptr,
+                              schedules=schedules, stats=stats,
+                              generation=request.generation)
         with obs.span(obs_names.SPAN_PLANNER_SOLVE, njobs=len(request.jobs),
                       round=request.round_ptr):
             x = plan_schedule(request.jobs, request.round_ptr,
                               self.future_nrounds, self.round_duration,
-                              self.ngpus, request.share_series, self.opts,
+                              ngpus, request.share_series, self.opts,
                               stats_out=stats, pipelined=pipelined)
         schedules = self._construct_schedules(x, request.job_ids,
                                               request.jobs,
-                                              request.round_ptr)
+                                              request.round_ptr,
+                                              ngpus=ngpus)
         return PlanResult(round_ptr=request.round_ptr, schedules=schedules,
                           stats=stats, generation=request.generation)
 
@@ -330,7 +357,7 @@ class ShockwavePlanner:
                        "cached schedule covers it; serving backfill-only "
                        "schedule", self.round_ptr)
         selected: List[int] = []
-        idle = self.ngpus
+        idle = max(self.ngpus - self.reserved_gpus, 0)
         by_remaining = sorted(
             self.metadata.items(),
             key=lambda kv: kv[1].dirichlet_posterior_remaining_runtime(),
@@ -346,12 +373,16 @@ class ShockwavePlanner:
         self.schedules[self.round_ptr] = selected
         return selected
 
-    def _construct_schedules(self, x, job_ids, jobs,
-                             base_round: int) -> "OrderedDict[int, List[int]]":
+    def _construct_schedules(self, x, job_ids, jobs, base_round: int,
+                             ngpus: Optional[int] = None,
+                             ) -> "OrderedDict[int, List[int]]":
         """Solution matrix -> per-round job lists, with work-conserving
         backfill of idle chips by longest remaining runtime
         (reference: shockwave.py:213-285). Operates purely on the
-        request snapshot (job_ids + views) so it can run off-lock."""
+        request snapshot (job_ids + views) so it can run off-lock.
+        `ngpus` is the request's (serving-shrunk) capacity row."""
+        if ngpus is None:
+            ngpus = self.ngpus
         schedules: "OrderedDict[int, List[int]]" = OrderedDict()
         for r in range(self.future_nrounds):
             round_index = base_round + r
@@ -360,7 +391,7 @@ class ShockwavePlanner:
             if not selected:
                 logger.warning("no jobs scheduled in round %d", round_index)
             used = sum(jobs[j].nworkers for j in sel)
-            idle = self.ngpus - used
+            idle = ngpus - used
             if idle > 0:
                 others = [j for j in range(len(job_ids))
                           if job_ids[j] not in selected]

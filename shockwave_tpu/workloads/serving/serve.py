@@ -1,0 +1,165 @@
+#!/usr/bin/env python3
+"""Serving replica workload (trace: "Serving (batch size N)").
+
+One autoregressive token-serving replica: a small decoder-only LM
+(models/decoder.py, KV-cached decode on the transformer/flash stack)
+greedily generating ``tokens_per_request`` tokens for a batch of
+``batch_size`` synthetic requests per step. The replica flows through
+the standard cluster runtime unchanged — the LeaseIterator accounts one
+step (= one served request batch) against a scheduler-granted lease and
+exits cooperatively at expiry — so "progress" reported to the scheduler
+is requests served, the serving tier's unit of work.
+
+Dispatched with the trace's `serving_command` (core/trace.py) plus the
+scheduler's --replica_of/--replica_index markers; load-curve flags are
+accepted (they parameterize the simulator's analytic twin) but only the
+decode-shape flags matter here.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), *[".."] * 3))
+
+import jax
+import jax.numpy as jnp
+
+from shockwave_tpu.models.decoder import DecoderLM
+from shockwave_tpu.models.train_common import (common_parser,
+                                               enable_compile_cache,
+                                               parse_args)
+from shockwave_tpu.runtime.iterator import LeaseIterator
+
+THROUGHPUT_LOG_INTERVAL = 50
+
+
+def build_parser():
+    p = common_parser("Autoregressive serving replica")
+    p.add_argument("--batch_size", type=int, default=1)
+    p.add_argument("--tokens_per_request", type=int, default=64)
+    # Load-curve parameters: carried by the trace command so one line
+    # parameterizes both the simulator's analytic model and this
+    # process; the replica itself serves as fast as the chip allows.
+    p.add_argument("--base_rps", type=float, default=0.0)
+    p.add_argument("--peak_rps", type=float, default=0.0)
+    p.add_argument("--period_s", type=float, default=0.0)
+    p.add_argument("--phase_s", type=float, default=0.0)
+    p.add_argument("--decode_tokens_per_s", type=float, default=0.0)
+    p.add_argument("--max_replicas", type=int, default=8)
+    p.add_argument("--spike_at", action="append", default=[])
+    p.add_argument("--spike_seed", type=int, default=None)
+    p.add_argument("--num_spikes", type=int, default=0)
+    p.add_argument("--spike_mult", type=float, default=10.0)
+    p.add_argument("--spike_duration_s", type=float, default=1800.0)
+    p.add_argument("--replica_of", type=int, default=None)
+    p.add_argument("--replica_index", type=int, default=0)
+    # Decode model shape (defaults sized for a single chip).
+    p.add_argument("--model_dim", type=int, default=128)
+    p.add_argument("--model_layers", type=int, default=2)
+    p.add_argument("--model_heads", type=int, default=4)
+    p.add_argument("--prompt_len", type=int, default=8)
+    return p
+
+
+def main():
+    args = parse_args(build_parser())
+    enable_compile_cache()
+
+    max_len = args.prompt_len + args.tokens_per_request + 1
+    model = DecoderLM(dim=args.model_dim, num_layers=args.model_layers,
+                      num_heads=args.model_heads,
+                      mlp_dim=2 * args.model_dim, max_len=max_len)
+    rng = jax.random.PRNGKey(args.replica_index or 0)
+    prompt = jax.random.randint(
+        rng, (args.batch_size, args.prompt_len), 0, model.vocab_size,
+        dtype=jnp.int32)
+    params = model.init(rng, prompt)
+
+    @jax.jit
+    def serve_request_batch(params, prompt):
+        """Greedy-decode tokens_per_request tokens for one batch of
+        requests through the KV cache; returns the last generated
+        token ids (the sync ref)."""
+        caches = model.init_cache(args.batch_size)
+
+        def step(carry, token_in):
+            caches, pos = carry
+            logits, caches = model.apply(params, token_in, caches, pos,
+                                         method=DecoderLM.decode_step)
+            next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return (caches, pos + 1), next_tok[:, None]
+
+        carry = (caches, jnp.int32(0))
+        token = prompt[:, :1]
+        for i in range(args.prompt_len):
+            carry, token = step(carry, prompt[:, i:i + 1])
+        def body(i, state):
+            carry, token = state
+            carry, token = step(carry, token)
+            return (carry, token)
+        carry, token = jax.lax.fori_loop(
+            0, args.tokens_per_request, body, (carry, token))
+        return token
+
+    # Synthetic request stream: a small ring of the same cached prompt
+    # batch. The LEASE bounds how long we serve, not the loader length
+    # — the loop below re-enters the iterator at each synthetic "epoch"
+    # boundary (a huge literal list here would cost gigabytes of
+    # pointer storage per replica before the first request).
+    request_ring = [prompt] * 1024
+    if args.enable_lease_iterator:
+        iterator = LeaseIterator(
+            data_loader=request_ring,
+            checkpoint_dir=args.checkpoint_dir,
+            # Replicas are stateless (weights re-init from the replica
+            # seed); there is no training state to checkpoint.
+            load_checkpoint_func=lambda path: None,
+            save_checkpoint_func=lambda path, state: None,
+            synthetic_data=True)
+    else:
+        iterator = None
+
+    served = 0
+    window_start = time.time()
+    window_steps = 0
+    last = None
+    budget = args.num_steps
+
+    def serve_one(batch):
+        nonlocal last, served, window_steps, window_start
+        last = serve_request_batch(params, batch)
+        if iterator is not None:
+            iterator.set_sync_ref(last)
+        served += 1
+        window_steps += 1
+        if window_steps >= THROUGHPUT_LOG_INTERVAL:
+            jax.block_until_ready(last)
+            print(f"[THROUGHPUT_ESTIMATION]\t{time.time()}\t{served}",
+                  flush=True)
+            window_start, window_steps = time.time(), 0
+
+    try:
+        if iterator is not None:
+            while not iterator.done and (budget is None or served < budget):
+                try:
+                    for batch in iterator:
+                        serve_one(batch)
+                        if budget is not None and served >= budget:
+                            iterator.complete()
+                            break
+                except StopIteration:
+                    pass  # lease expiry or epoch boundary; `done` decides
+        else:
+            for _ in range(budget or 100):
+                serve_one(prompt)
+    finally:
+        if last is not None:
+            jax.block_until_ready(last)
+    print(f"SERVED {served} request batches "
+          f"(x{args.batch_size} requests, {args.tokens_per_request} "
+          f"tokens each)", flush=True)
+    return served
+
+
+if __name__ == "__main__":
+    main()

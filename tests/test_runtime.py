@@ -774,6 +774,27 @@ class TestIteratorLogTimelines:
             sched._server.stop(grace=0)
 
 
+#: jax's CPU backend cannot lower cross-process collectives on some
+#: versions (XlaRuntimeError at the first process_allgather). The gang
+#: tests gate on the subprocess's own error rather than a version probe:
+#: the same test passes unchanged wherever the backend supports it
+#: (gloo-enabled jax, TPU pods) and SKIPs — loudly, with the triage
+#: pointer — where it cannot (EXPERIMENTS.md "Pre-existing tier-1
+#: failures").
+CPU_MULTIPROC_UNSUPPORTED = "Multiprocess computations aren't implemented"
+
+
+def assert_gang_member_ok(proc, out):
+    """Assert a gang member subprocess exited cleanly, skipping the test
+    when the failure is the CPU backend's missing multi-process
+    collective support (environment limitation, not a repo bug)."""
+    if proc.returncode != 0 and CPU_MULTIPROC_UNSUPPORTED in out:
+        pytest.skip("CPU backend lacks multi-process collectives in this "
+                    "jax build; gang-barrier coverage needs a "
+                    "gloo-enabled jax or a TPU pod")
+    assert proc.returncode == 0, out[-3000:]
+
+
 class TestGangBarrier:
     def test_two_process_gang_synchronized_exit(self, tmp_path):
         """Two gang members over jax.distributed: consensus-style leases
@@ -829,7 +850,8 @@ class TestGangBarrier:
             for proc in procs:
                 out, _ = proc.communicate(timeout=120)
                 outs.append(out)
-                assert proc.returncode == 0, out[-3000:]
+            for proc, out in zip(procs, outs):
+                assert_gang_member_ok(proc, out)
             for pid, out in enumerate(outs):
                 assert f"EXITED process={pid} steps=6 barriers=1" in out, out
                 # allgather of (x+1) over 2 procs summed: both saw the
@@ -889,9 +911,13 @@ class TestGangBarrier:
                     stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                     text=True, env=env))
             steps_seen = []
+            member_outs = []
             for proc in procs:
                 out, _ = proc.communicate(timeout=180)
-                assert proc.returncode == 0, out[-3000:]
+                member_outs.append(out)
+            for proc, out in zip(procs, member_outs):
+                assert_gang_member_ok(proc, out)
+            for out in member_outs:
                 m = re.search(r"EXITED process=\d steps=(\d+) barriers=1",
                               out)
                 assert m, out[-2000:]
