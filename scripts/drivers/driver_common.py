@@ -1,0 +1,143 @@
+"""Shared machinery for the simulation drivers.
+
+`simulate.py` (trace replay), `simulate_generated.py` (Poisson-generated
+jobs) and `sweep_scenarios.py` (Monte Carlo scenario sweep) all build
+the same scheduler, run the same simulation loop and persist the same
+end-of-run metrics; this module is the single copy of that surface so
+the vectorized sim core has one driver stack instead of drifting
+copies.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.core.metrics import unfair_fraction  # noqa: E402
+from shockwave_tpu.sched import Scheduler, SchedulerConfig  # noqa: E402
+from shockwave_tpu.solver import get_policy  # noqa: E402
+
+
+def load_configs(config_path: Optional[str], policy: str,
+                 cluster_spec: dict, round_duration: float):
+    """(shockwave_config, serving_config) from a driver --config file.
+
+    The serving tier is policy-agnostic; its autoscaler block rides the
+    same config file but a separate SchedulerConfig field (the planner
+    would reject the unknown keys). A shockwave run without a config
+    file gets the planner defaults.
+    """
+    shockwave_config = None
+    serving_config = None
+    if config_path:
+        with open(config_path) as f:
+            shockwave_config = json.load(f)
+        serving_config = shockwave_config.pop("serving", None)
+    if shockwave_config is None and policy == "shockwave":
+        shockwave_config = {}  # planner defaults
+    if shockwave_config is not None:
+        shockwave_config["num_gpus"] = sum(cluster_spec.values())
+        shockwave_config["time_per_iteration"] = round_duration
+    return shockwave_config, serving_config
+
+
+def build_scheduler(policy_name: str, throughputs_file: str, profiles,
+                    *, round_duration: float, seed: int = 0,
+                    max_rounds: Optional[int] = None,
+                    shockwave_config: Optional[dict] = None,
+                    serving_config: Optional[dict] = None,
+                    rate_override: Optional[dict] = None,
+                    vectorized: bool = True) -> Scheduler:
+    """One simulation-mode scheduler, configured the way every driver
+    configures it."""
+    policy = get_policy(policy_name, seed=seed)
+    return Scheduler(
+        policy, simulate=True, throughputs_file=throughputs_file,
+        profiles=profiles,
+        config=SchedulerConfig(
+            time_per_iteration=round_duration, seed=seed,
+            max_rounds=max_rounds, shockwave=shockwave_config,
+            rate_override=rate_override, serving=serving_config,
+            vectorized_sim=vectorized))
+
+
+def collect_metrics(sched: Scheduler, makespan: float,
+                    round_duration: float, policy_name: str) -> dict:
+    """The common end-of-run metrics dict the drivers persist (each
+    driver adds its own provenance keys on top). `policy_name` is the
+    CLI-facing registry name (e.g. "max_min_fairness"), not the policy
+    class's display name."""
+    jct = sched.get_average_jct()
+    ftf_static, ftf_themis = sched.get_finish_time_fairness()
+    util, util_list = sched.get_cluster_utilization()
+    ext_pct, ext, opp = sched.get_num_lease_extensions()
+    envy_ratios, envy_pairwise = sched.get_envy_ratios()
+    metrics = {
+        "policy": policy_name,
+        "makespan": makespan,
+        "avg_jct": jct[0] if jct else None,
+        "geometric_mean_jct": jct[1] if jct else None,
+        "harmonic_mean_jct": jct[2] if jct else None,
+        "jct_list": jct[3] if jct else [],
+        "finish_time_fairness_list": ftf_static,
+        "finish_time_fairness_themis_list": ftf_themis,
+        "cluster_util": util,
+        "utilization_list": util_list,
+        "envy_ratios": envy_ratios,
+        "envy_list": envy_pairwise,
+        "extension_percentage": ext_pct,
+        "num_lease_extensions": ext,
+        "num_lease_extension_opportunities": opp,
+        "per_round_schedule": sched.rounds.per_round_schedule,
+        "time_per_iteration": round_duration,
+        "throughput_timeline": sched.get_throughput_timeline(),
+        "milp_solve_stats": sched.get_solve_stats(),
+    }
+    serving = sched.serving_summary()
+    if serving is not None:
+        metrics["serving"] = serving
+    return metrics
+
+
+def summary_core(metrics: dict, sched: Scheduler) -> dict:
+    """The one-JSON-line summary shared by the drivers."""
+    summary = {
+        "policy": metrics["policy"],
+        "makespan": round(metrics["makespan"], 2),
+        "avg_jct": (round(metrics["avg_jct"], 2)
+                    if metrics["avg_jct"] else None),
+        "unfair_fraction": round(
+            unfair_fraction(metrics["finish_time_fairness_list"]), 4),
+        "cluster_util": round(metrics["cluster_util"], 4),
+        "lease_extension_pct": round(metrics["extension_percentage"], 2),
+        "rounds": sched.rounds.num_completed_rounds,
+    }
+    serving = metrics.get("serving")
+    if serving is not None:
+        summary["serving_slo_attainment"] = serving["slo_attainment"]
+        summary["serving_requests_offered"] = serving["requests_offered"]
+        summary["serving_services"] = serving["services"]
+    return summary
+
+
+def milp_summary(solve_stats: list) -> dict:
+    """Aggregate MILP solve telemetry for a summary line: solve count,
+    per-path counts, greedy rate, worst achieved gap, and total solver
+    wall (the canonical shockwave replay spends ~90% of its wall here —
+    see EXPERIMENTS.md "Fleet-scale simulation")."""
+    if not solve_stats:
+        return {}
+    paths = [s["path"] for s in solve_stats]
+    gaps = [s["mip_gap"] for s in solve_stats if s["mip_gap"] is not None]
+    out = {
+        "milp_solves": len(paths),
+        "milp_paths": {p: paths.count(p) for p in sorted(set(paths))},
+        "milp_greedy_rate": round(paths.count("greedy") / len(paths), 4),
+        "milp_wall_s": round(sum(s["wall_s"] for s in solve_stats), 2),
+    }
+    if gaps:
+        out["milp_max_gap"] = round(max(gaps), 6)
+    return out

@@ -16,17 +16,16 @@ import json
 import os
 import pickle
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
-from shockwave_tpu.core.metrics import (parse_cluster_spec,
-                                        unfair_fraction)
-from shockwave_tpu.core.oracle import read_throughputs
-from shockwave_tpu.core.profiles import build_profiles
-from shockwave_tpu.core.trace import parse_trace
-from shockwave_tpu.obs.logconfig import LEVELS, setup_logging
-from shockwave_tpu.sched import Scheduler, SchedulerConfig
-from shockwave_tpu.solver import get_policy
+import driver_common  # noqa: E402
+from shockwave_tpu.core.metrics import parse_cluster_spec  # noqa: E402
+from shockwave_tpu.core.oracle import read_throughputs  # noqa: E402
+from shockwave_tpu.core.profiles import build_profiles  # noqa: E402
+from shockwave_tpu.core.trace import parse_trace  # noqa: E402
+from shockwave_tpu.obs.logconfig import LEVELS, setup_logging  # noqa: E402
 
 
 def main():
@@ -51,6 +50,17 @@ def main():
     p.add_argument("--json_out", default=None,
                    help="also write the summary JSON line to this file "
                         "(CI artifact for the mixed serving smoke)")
+    p.add_argument("--scalar_sim", action="store_true",
+                   help="run the retained scalar sim core instead of the "
+                        "vectorized passes (reference oracle; equivalent "
+                        "to SWTPU_SCALAR_SIM=1)")
+    p.add_argument("--profile_out", default=None, metavar="PSTATS",
+                   help="cProfile the simulation loop (imports and trace "
+                        "parsing excluded) and dump the pstats binary "
+                        "here, plus a human-readable top-40 cumulative "
+                        "summary at PSTATS.txt — hot-loop work should "
+                        "start from this evidence (EXPERIMENTS.md "
+                        "\"Fleet-scale simulation\")")
     p.add_argument("--replay_schedule", default=None, metavar="PHYSICAL_PKL",
                    help="fidelity analysis: execute this physical metric "
                         "pickle's per_round_schedule verbatim instead of "
@@ -85,20 +95,8 @@ def main():
                 f"--cluster_spec {wt}:{count} is not divisible by "
                 f"--chips_per_server {args.chips_per_server}")
 
-    shockwave_config = None
-    serving_config = None
-    if args.config:
-        with open(args.config) as f:
-            shockwave_config = json.load(f)
-        # The serving tier is policy-agnostic; its autoscaler block
-        # rides the same config file but a separate SchedulerConfig
-        # field (the planner would reject the unknown keys).
-        serving_config = shockwave_config.pop("serving", None)
-    if shockwave_config is None and args.policy == "shockwave":
-        shockwave_config = {}  # planner defaults
-    if shockwave_config is not None:
-        shockwave_config["num_gpus"] = sum(cluster_spec.values())
-        shockwave_config["time_per_iteration"] = args.round_duration
+    shockwave_config, serving_config = driver_common.load_configs(
+        args.config, args.policy, cluster_spec, args.round_duration)
 
     forced_schedule = None
     if args.replay_schedule:
@@ -117,67 +115,53 @@ def main():
             if rates:
                 rate_override[int_id] = sum(rates) / len(rates)
 
-    policy = get_policy(args.policy, seed=args.seed)
-    sched = Scheduler(
-        policy, simulate=True, throughputs_file=args.throughputs,
-        profiles=profiles,
-        config=SchedulerConfig(
-            time_per_iteration=args.round_duration, seed=args.seed,
-            max_rounds=args.max_rounds, shockwave=shockwave_config,
-            rate_override=rate_override, serving=serving_config))
+    sched = driver_common.build_scheduler(
+        args.policy, args.throughputs, profiles,
+        round_duration=args.round_duration, seed=args.seed,
+        max_rounds=args.max_rounds, shockwave_config=shockwave_config,
+        serving_config=serving_config, rate_override=rate_override,
+        vectorized=not args.scalar_sim)
 
+    profiler = None
+    if args.profile_out:
+        import cProfile
+        profiler = cProfile.Profile()
+        profiler.enable()
+    sim_start = time.monotonic()
     makespan = sched.simulate(
         cluster_spec, arrival_times, jobs,
         num_chips_per_server={wt: args.chips_per_server
                               for wt in cluster_spec},
         forced_schedule=forced_schedule)
+    sim_wall_s = time.monotonic() - sim_start
+    if profiler is not None:
+        profiler.disable()
+        profiler.dump_stats(args.profile_out)
+        import io
+        import pstats
+        buf = io.StringIO()
+        pstats.Stats(profiler, stream=buf).sort_stats(
+            "cumulative").print_stats(40)
+        # Telemetry dump, not durable state: a torn file just re-runs.
+        with open(args.profile_out + ".txt", "w") as f:  # swtpu-check: ignore[durability]
+            f.write(buf.getvalue())
+        print(f"profile: {args.profile_out} (summary: "
+              f"{args.profile_out}.txt)", file=sys.stderr)
 
-    jct = sched.get_average_jct()
-    ftf_static, ftf_themis = sched.get_finish_time_fairness()
-    util, util_list = sched.get_cluster_utilization()
-    ext_pct, ext, opp = sched.get_num_lease_extensions()
-    envy_ratios, envy_pairwise = sched.get_envy_ratios()
+    metrics = {"trace_file": args.trace,
+               **driver_common.collect_metrics(sched, makespan,
+                                               args.round_duration,
+                                               args.policy)}
 
-    metrics = {
-        "trace_file": args.trace,
-        "policy": args.policy,
-        "makespan": makespan,
-        "avg_jct": jct[0] if jct else None,
-        "geometric_mean_jct": jct[1] if jct else None,
-        "harmonic_mean_jct": jct[2] if jct else None,
-        "jct_list": jct[3] if jct else [],
-        "finish_time_fairness_list": ftf_static,
-        "finish_time_fairness_themis_list": ftf_themis,
-        "cluster_util": util,
-        "utilization_list": util_list,
-        "envy_ratios": envy_ratios,
-        "envy_list": envy_pairwise,
-        "extension_percentage": ext_pct,
-        "num_lease_extensions": ext,
-        "num_lease_extension_opportunities": opp,
-        "per_round_schedule": sched.rounds.per_round_schedule,
-        "time_per_iteration": args.round_duration,
-        "throughput_timeline": sched.get_throughput_timeline(),
-        "milp_solve_stats": sched.get_solve_stats(),
-    }
-    serving = sched.serving_summary()
-    if serving is not None:
-        metrics["serving"] = serving
-
-    unfair = unfair_fraction(ftf_static)
-    summary = {
-        "policy": args.policy,
-        "makespan": round(makespan, 2),
-        "avg_jct": round(metrics["avg_jct"], 2) if metrics["avg_jct"] else None,
-        "unfair_fraction": round(unfair, 4),
-        "cluster_util": round(util, 4),
-        "lease_extension_pct": round(ext_pct, 2),
-        "rounds": sched.rounds.num_completed_rounds,
-    }
-    if serving is not None:
-        summary["serving_slo_attainment"] = serving["slo_attainment"]
-        summary["serving_requests_offered"] = serving["requests_offered"]
-        summary["serving_services"] = serving["services"]
+    summary = driver_common.summary_core(metrics, sched)
+    milp = driver_common.milp_summary(metrics["milp_solve_stats"])
+    summary.update(milp)
+    # Wall split: the sim core (vectorized per-round bookkeeping) vs the
+    # MILP solver chain — the bench trajectory tracks both.
+    summary["sim_wall_s"] = round(sim_wall_s, 2)
+    summary["milp_wall_s"] = milp.get("milp_wall_s", 0.0)
+    summary["sim_core_wall_s"] = round(
+        sim_wall_s - milp.get("milp_wall_s", 0.0), 2)
     print(json.dumps(summary))
     if args.json_out:
         # CI artifact, not durable state: a torn file just re-runs.

@@ -5,6 +5,8 @@ Instead of replaying a fixed trace, samples `--num_jobs` jobs from the
 template table (Philly scale-factor/duration mixes) with exponential
 interarrival gaps, then runs the same simulator loop as simulate.py
 (reference: scheduler/scripts/drivers/simulate_scheduler_with_generated_jobs.py).
+Trace loading, scheduler construction and metric collection are shared
+with simulate.py via driver_common.
 
 Example:
     python scripts/drivers/simulate_generated.py \
@@ -13,20 +15,18 @@ Example:
 """
 import argparse
 import json
-import logging
 import os
 import pickle
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
-from shockwave_tpu.core.generator import generate_trace
-from shockwave_tpu.core.metrics import (parse_cluster_spec,
-                                        unfair_fraction)
-from shockwave_tpu.core.oracle import read_throughputs
-from shockwave_tpu.core.profiles import build_profiles
-from shockwave_tpu.sched import Scheduler, SchedulerConfig
-from shockwave_tpu.solver import get_policy
+import driver_common  # noqa: E402
+from shockwave_tpu.core.generator import generate_trace  # noqa: E402
+from shockwave_tpu.core.metrics import parse_cluster_spec  # noqa: E402
+from shockwave_tpu.core.oracle import read_throughputs  # noqa: E402
+from shockwave_tpu.core.profiles import build_profiles  # noqa: E402
+from shockwave_tpu.obs.logconfig import setup_logging  # noqa: E402
 
 
 def main():
@@ -54,12 +54,13 @@ def main():
     p.add_argument("--config", default=None,
                    help="JSON file of shockwave hyperparameters")
     p.add_argument("--output", default=None, help="metrics pickle path")
+    p.add_argument("--scalar_sim", action="store_true",
+                   help="run the retained scalar sim core (reference "
+                        "oracle) instead of the vectorized passes")
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args()
 
-    logging.basicConfig(
-        level=logging.INFO if args.verbose else logging.WARNING,
-        format="%(name)s:%(levelname)s %(message)s")
+    setup_logging("info" if args.verbose else "warning")
 
     throughputs = read_throughputs(args.throughputs)
     cluster_spec = parse_cluster_spec(args.cluster_spec)
@@ -76,69 +77,30 @@ def main():
     profiles = build_profiles(jobs, throughputs,
                               worker_type=reference_worker_type)
 
-    shockwave_config = None
-    if args.config:
-        with open(args.config) as f:
-            shockwave_config = json.load(f)
-    elif args.policy == "shockwave":
-        shockwave_config = {}
-    if shockwave_config is not None:
-        shockwave_config["num_gpus"] = sum(cluster_spec.values())
-        shockwave_config["time_per_iteration"] = args.round_duration
+    shockwave_config, serving_config = driver_common.load_configs(
+        args.config, args.policy, cluster_spec, args.round_duration)
 
-    policy = get_policy(args.policy, seed=args.seed)
-    sched = Scheduler(
-        policy, simulate=True, throughputs_file=args.throughputs,
-        profiles=profiles,
-        config=SchedulerConfig(
-            time_per_iteration=args.round_duration, seed=args.seed,
-            max_rounds=args.max_rounds, shockwave=shockwave_config))
+    sched = driver_common.build_scheduler(
+        args.policy, args.throughputs, profiles,
+        round_duration=args.round_duration, seed=args.seed,
+        max_rounds=args.max_rounds, shockwave_config=shockwave_config,
+        serving_config=serving_config, vectorized=not args.scalar_sim)
 
     makespan = sched.simulate(cluster_spec, arrival_times, jobs)
 
-    jct = sched.get_average_jct()
-    ftf_static, ftf_themis = sched.get_finish_time_fairness()
-    util, util_list = sched.get_cluster_utilization()
-    unfair = unfair_fraction(ftf_static)
-    solve_stats = sched.get_solve_stats()
+    metrics = {"num_jobs": args.num_jobs, "lam": args.lam,
+               "seed": args.seed,
+               **driver_common.collect_metrics(sched, makespan,
+                                               args.round_duration,
+                                               args.policy)}
     if args.output:
         with open(args.output, "wb") as f:
-            ext_pct, ext, opp = sched.get_num_lease_extensions()
-            pickle.dump({
-                "policy": args.policy, "num_jobs": args.num_jobs,
-                "lam": args.lam, "seed": args.seed, "makespan": makespan,
-                "avg_jct": jct[0] if jct else None,
-                "geometric_mean_jct": jct[1] if jct else None,
-                "harmonic_mean_jct": jct[2] if jct else None,
-                "jct_list": jct[3] if jct else [],
-                "finish_time_fairness_list": ftf_static,
-                "finish_time_fairness_themis_list": ftf_themis,
-                "cluster_util": util,
-                "utilization_list": util_list,
-                "extension_percentage": ext_pct,
-                "per_round_schedule": sched.rounds.per_round_schedule,
-                "time_per_iteration": args.round_duration,
-                "milp_solve_stats": solve_stats,
-            }, f)
-    summary = {
-        "policy": args.policy,
-        "num_jobs": args.num_jobs,
-        "lam": args.lam,
-        "makespan": round(makespan, 2),
-        "avg_jct": round(jct[0], 2) if jct else None,
-        "unfair_fraction": round(unfair, 4),
-        "cluster_util": round(util, 4),
-    }
-    if solve_stats:
-        paths = [s["path"] for s in solve_stats]
-        gaps = [s["mip_gap"] for s in solve_stats
-                if s["mip_gap"] is not None]
-        summary["milp_solves"] = len(paths)
-        summary["milp_paths"] = {p: paths.count(p) for p in sorted(set(paths))}
-        summary["milp_greedy_rate"] = round(
-            paths.count("greedy") / len(paths), 4)
-        if gaps:
-            summary["milp_max_gap"] = round(max(gaps), 6)
+            pickle.dump(metrics, f)
+
+    summary = driver_common.summary_core(metrics, sched)
+    summary["num_jobs"] = args.num_jobs
+    summary["lam"] = args.lam
+    summary.update(driver_common.milp_summary(metrics["milp_solve_stats"]))
     print(json.dumps(summary))
 
 

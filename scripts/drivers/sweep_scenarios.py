@@ -1,0 +1,420 @@
+#!/usr/bin/env python3
+"""Parallel Monte Carlo scenario sweep over the discrete-event simulator.
+
+Fans seeded what-if perturbations of a base trace across a process
+pool, streams per-scenario results incrementally into ONE resumable
+JSON artifact, and aggregates distributional statistics — the
+capacity-planning harness the serving tier, the 10k-job planner arc and
+the learned throughput oracle all consume (ROADMAP item 4).
+
+Scenario perturbations (each drawn from the scenario's own seeded RNG,
+so the same seed always produces the same scenario):
+
+- ``--subsample lo:hi``       keep a uniform random fraction of the
+                              trace's jobs (arrival order preserved)
+- ``--load_scale lo:hi``      compress/stretch arrival times by a
+                              uniform factor (>1 = more load)
+- ``--arrival_jitter_s S``    add N(0, S) seconds to each arrival
+                              (clamped at 0, then re-sorted)
+- ``--fault_rate R``          Poisson(R) chip-failure events per
+                              scenario, injected through the
+                              simulator's fault hook (the sim-side
+                              analog of runtime/faults.py): each kills
+                              1..--fault_max_chips chips of one worker
+                              type at a uniform time in
+                              [0, --fault_window_s) and revives them
+                              --fault_down_s later
+- ``--serving_spike_seeds``   redraw each serving service's spike seed
+                              (load-curve variation for mixed traces)
+
+Crash safety / resume: the artifact is atomically rewritten after every
+completed scenario (core/durable_io.write_text_atomic), scenarios are
+keyed by seed, and a rerun skips seeds already present (meta mismatch
+is an error unless --restart). Identical seeds and knobs produce a
+byte-equal artifact: all wall-clock telemetry stays OUT of the artifact
+(stdout/--timing_out only), and aggregation is computed from the
+seed-sorted scenario set.
+
+Example (the CI smoke):
+    python scripts/drivers/sweep_scenarios.py \
+        --trace data/canonical_120job.trace --policy max_min_fairness \
+        --throughputs data/tacc_throughputs.json --cluster_spec v100:32 \
+        --round_duration 120 --num_scenarios 8 --subsample 0.2:0.4 \
+        --load_scale 0.8:1.3 --arrival_jitter_s 600 --fault_rate 1 \
+        --out /tmp/sweep.json
+"""
+import argparse
+import json
+import multiprocessing
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import driver_common  # noqa: E402
+from shockwave_tpu.core.durable_io import write_text_atomic  # noqa: E402
+from shockwave_tpu.core.metrics import parse_cluster_spec  # noqa: E402
+from shockwave_tpu.core.oracle import read_throughputs  # noqa: E402
+from shockwave_tpu.core.profiles import build_profiles  # noqa: E402
+from shockwave_tpu.core.trace import parse_trace  # noqa: E402
+from shockwave_tpu.obs import get_observability  # noqa: E402
+from shockwave_tpu.obs import names as obs_names  # noqa: E402
+from shockwave_tpu.obs.logconfig import setup_logging  # noqa: E402
+
+ARTIFACT_SCHEMA = 1
+#: Summary keys whose quantiles the aggregate reports (serving
+#: attainment joins when any scenario carries it).
+AGGREGATE_KEYS = ("makespan", "avg_jct", "unfair_fraction",
+                  "cluster_util", "rounds")
+
+
+def parse_range(spec, name):
+    """'lo:hi' -> (lo, hi) floats, or None for an unset knob."""
+    if spec is None:
+        return None
+    try:
+        lo, hi = (float(x) for x in spec.split(":"))
+    except ValueError:
+        raise SystemExit(f"--{name} wants lo:hi, got {spec!r}") from None
+    if hi < lo:
+        raise SystemExit(f"--{name}: hi < lo in {spec!r}")
+    return (lo, hi)
+
+
+def chip_layout(cluster_spec, chips_per_server=1):
+    """worker_type -> chip ids, matching the registration order
+    simulate() uses (sorted worker types, ids incrementing)."""
+    layout = {}
+    next_id = 0
+    for wt in sorted(cluster_spec):
+        layout[wt] = list(range(next_id, next_id + cluster_spec[wt]))
+        next_id += cluster_spec[wt]
+    return layout
+
+
+def draw_scenario(rng, jobs, arrivals, knobs, cluster_spec):
+    """Apply the seeded perturbations. Returns (jobs, arrivals,
+    fault_events, params) — params records what was drawn so the
+    artifact is self-describing. Draw order is part of the scenario
+    contract (changing it changes every seeded scenario)."""
+    params = {}
+
+    subsample = knobs.get("subsample")
+    if subsample is not None:
+        frac = float(rng.uniform(subsample[0], subsample[1]))
+        keep = max(1, int(round(frac * len(jobs))))
+        idx = sorted(int(i) for i in rng.choice(len(jobs), size=keep,
+                                                replace=False))
+        jobs = [jobs[i] for i in idx]
+        arrivals = [arrivals[i] for i in idx]
+        params["subsample_fraction"] = round(frac, 6)
+        params["num_jobs"] = keep
+
+    load_scale = knobs.get("load_scale")
+    if load_scale is not None:
+        factor = float(rng.uniform(load_scale[0], load_scale[1]))
+        arrivals = [a / factor for a in arrivals]
+        params["load_scale"] = round(factor, 6)
+
+    jitter = knobs.get("arrival_jitter_s", 0.0)
+    if jitter > 0:
+        noise = rng.normal(0.0, jitter, size=len(arrivals))
+        arrivals = [max(0.0, a + float(n)) for a, n in zip(arrivals, noise)]
+        params["arrival_jitter_s"] = jitter
+
+    # Admission is gated on the head arrival (ids follow file order), so
+    # perturbed traces are re-sorted; python sort is stable, preserving
+    # file order among equal arrivals.
+    order = sorted(range(len(jobs)), key=lambda i: arrivals[i])
+    jobs = [jobs[i] for i in order]
+    arrivals = [arrivals[i] for i in order]
+
+    if knobs.get("serving_spike_seeds"):
+        respiked = 0
+        for job in jobs:
+            if job.mode == "serving" and "--spike_seed" in job.command:
+                new_seed = int(rng.randint(0, 2**31 - 1))
+                job.command = re.sub(r"--spike_seed \d+",
+                                     f"--spike_seed {new_seed}", job.command)
+                respiked += 1
+        params["serving_respiked"] = respiked
+
+    fault_events = []
+    fault_rate = knobs.get("fault_rate", 0.0)
+    if fault_rate > 0:
+        layout = chip_layout(cluster_spec)
+        types = sorted(layout)
+        for _ in range(int(rng.poisson(fault_rate))):
+            wt = types[int(rng.randint(len(types)))]
+            k = min(int(rng.randint(1, knobs["fault_max_chips"] + 1)),
+                    len(layout[wt]))
+            ids = sorted(int(i) for i in rng.choice(layout[wt], size=k,
+                                                    replace=False))
+            at = float(rng.uniform(0.0, knobs["fault_window_s"]))
+            fault_events.append({"at": round(at, 3), "kill": ids})
+            fault_events.append({"at": round(at + knobs["fault_down_s"], 3),
+                                 "revive": ids, "worker_type": wt})
+        fault_events.sort(key=lambda e: e["at"])
+        params["fault_events"] = sum(1 for e in fault_events if "kill" in e)
+
+    return jobs, arrivals, fault_events, params
+
+
+def run_scenario(payload):
+    """Process-pool worker: one seeded scenario end to end. Returns
+    (seed_index, record) where record is fully deterministic (no wall
+    telemetry)."""
+    seed_index, cfg = payload
+    import time as _time
+    # Worker-side wall telemetry (returned beside the record, never in
+    # it — the artifact stays byte-deterministic).
+    _t0 = _time.monotonic()  # swtpu-check: ignore[determinism]
+    try:
+        rng = np.random.RandomState(cfg["seed_base"] + seed_index)
+        jobs, arrivals = parse_trace(cfg["trace"])
+        cluster_spec = parse_cluster_spec(cfg["cluster_spec"])
+        jobs, arrivals, fault_events, params = draw_scenario(
+            rng, jobs, arrivals, cfg["knobs"], cluster_spec)
+
+        throughputs = read_throughputs(cfg["throughputs"])
+        profiles = build_profiles(jobs, throughputs)
+        shockwave_config, serving_config = driver_common.load_configs(
+            cfg["config"], cfg["policy"], cluster_spec,
+            cfg["round_duration"])
+        sched = driver_common.build_scheduler(
+            cfg["policy"], cfg["throughputs"], profiles,
+            round_duration=cfg["round_duration"],
+            seed=cfg["seed_base"] + seed_index,
+            max_rounds=cfg["max_rounds"],
+            shockwave_config=shockwave_config,
+            serving_config=serving_config,
+            vectorized=not cfg["scalar_sim"])
+        makespan = sched.simulate(cluster_spec, arrivals, jobs,
+                                  fault_events=fault_events)
+        metrics = driver_common.collect_metrics(sched, makespan,
+                                                cfg["round_duration"],
+                                                cfg["policy"])
+        summary = driver_common.summary_core(metrics, sched)
+        milp = driver_common.milp_summary(metrics["milp_solve_stats"])
+        milp.pop("milp_wall_s", None)  # wall telemetry stays out
+        summary.update(milp)
+        summary["completed_jobs"] = sched.get_num_completed_jobs()
+        wall = _time.monotonic() - _t0  # swtpu-check: ignore[determinism]
+        return seed_index, {"seed": cfg["seed_base"] + seed_index,
+                            "params": params, "summary": summary}, wall
+    except Exception as e:  # noqa: BLE001 - one bad scenario must not
+        # sink a multi-hour sweep; the error lands in the artifact.
+        wall = _time.monotonic() - _t0  # swtpu-check: ignore[determinism]
+        return seed_index, {"seed": cfg["seed_base"] + seed_index,
+                            "error": f"{type(e).__name__}: {e}"}, wall
+
+
+def quantile_stats(values):
+    arr = np.asarray(sorted(values), dtype=np.float64)
+    return {
+        "mean": round(float(arr.mean()), 4),
+        "min": round(float(arr[0]), 4),
+        "p10": round(float(np.percentile(arr, 10)), 4),
+        "p50": round(float(np.percentile(arr, 50)), 4),
+        "p90": round(float(np.percentile(arr, 90)), 4),
+        "p99": round(float(np.percentile(arr, 99)), 4),
+        "max": round(float(arr[-1]), 4),
+        "n": int(arr.size),
+    }
+
+
+def aggregate(scenarios):
+    """Distributional stats over the seed-sorted completed scenarios."""
+    ok = [s["summary"] for _, s in sorted(scenarios.items(),
+                                          key=lambda kv: int(kv[0]))
+          if "summary" in s]
+    agg = {"num_ok": len(ok),
+           "num_failed": len(scenarios) - len(ok)}
+    keys = list(AGGREGATE_KEYS)
+    if any("serving_slo_attainment" in s for s in ok):
+        keys.append("serving_slo_attainment")
+    for key in keys:
+        values = [s[key] for s in ok
+                  if s.get(key) is not None]
+        if values:
+            agg[key] = quantile_stats(values)
+    return agg
+
+
+def write_artifact(path, meta, scenarios):
+    doc = {"schema": ARTIFACT_SCHEMA, "meta": meta,
+           "scenarios": {str(k): scenarios[k] for k in sorted(scenarios)},
+           "aggregate": aggregate(scenarios)}
+    write_text_atomic(path, json.dumps(doc, indent=1, sort_keys=True) + "\n")
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--sweep_config", default=None,
+                   help="JSON file of defaults for any option below "
+                        "(explicit CLI flags win); see "
+                        "configs/sweep_canonical.json")
+    p.add_argument("--trace", default=None)
+    p.add_argument("--policy", default="max_min_fairness")
+    p.add_argument("--throughputs", default=None)
+    p.add_argument("--cluster_spec", default="v100:32")
+    p.add_argument("--round_duration", type=float, default=120.0)
+    p.add_argument("--config", default=None,
+                   help="scheduler config JSON (shockwave/serving blocks)")
+    p.add_argument("--num_scenarios", type=int, default=200)
+    p.add_argument("--seed_base", type=int, default=0)
+    p.add_argument("--processes", type=int, default=None,
+                   help="pool size (default: cpu count)")
+    p.add_argument("--out", required=True, help="results JSON artifact")
+    p.add_argument("--restart", action="store_true",
+                   help="ignore an existing artifact instead of resuming")
+    p.add_argument("--max_rounds", type=int, default=None)
+    p.add_argument("--scalar_sim", action="store_true")
+    # -- scenario knobs --
+    p.add_argument("--subsample", default=None, metavar="LO:HI")
+    p.add_argument("--load_scale", default=None, metavar="LO:HI")
+    p.add_argument("--arrival_jitter_s", type=float, default=0.0)
+    p.add_argument("--fault_rate", type=float, default=0.0)
+    p.add_argument("--fault_max_chips", type=int, default=2)
+    p.add_argument("--fault_down_s", type=float, default=3600.0)
+    p.add_argument("--fault_window_s", type=float, default=20000.0)
+    p.add_argument("--serving_spike_seeds", action="store_true")
+    # -- telemetry (never enters the artifact) --
+    p.add_argument("--timing_out", default=None,
+                   help="sidecar JSON with wall-clock timings")
+    p.add_argument("--metrics_out", default=None,
+                   help="Prometheus text dump of the sweep metrics")
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args()
+
+    if args.sweep_config:
+        with open(args.sweep_config) as f:
+            defaults = json.load(f)
+        defaults = {k: v for k, v in defaults.items()
+                    if not k.startswith("_")}  # _comment etc.
+        unknown = [k for k in defaults if not hasattr(args, k)]
+        if unknown:
+            raise SystemExit(f"--sweep_config: unknown keys {unknown}")
+        p.set_defaults(**defaults)
+        args = p.parse_args()
+    if not args.trace or not args.throughputs:
+        raise SystemExit("--trace and --throughputs are required "
+                         "(directly or via --sweep_config)")
+    setup_logging("info" if args.verbose else "warning")
+
+    knobs = {
+        "subsample": parse_range(args.subsample, "subsample"),
+        "load_scale": parse_range(args.load_scale, "load_scale"),
+        "arrival_jitter_s": args.arrival_jitter_s,
+        "fault_rate": args.fault_rate,
+        "fault_max_chips": args.fault_max_chips,
+        "fault_down_s": args.fault_down_s,
+        "fault_window_s": args.fault_window_s,
+        "serving_spike_seeds": bool(args.serving_spike_seeds),
+    }
+    meta = {
+        "trace": args.trace,
+        "policy": args.policy,
+        "throughputs": args.throughputs,
+        "cluster_spec": args.cluster_spec,
+        "round_duration": args.round_duration,
+        "config": args.config,
+        "seed_base": args.seed_base,
+        "max_rounds": args.max_rounds,
+        "knobs": {k: (list(v) if isinstance(v, tuple) else v)
+                  for k, v in knobs.items()},
+    }
+
+    obs = get_observability()
+    scenarios = {}
+    if os.path.exists(args.out) and not args.restart:
+        with open(args.out) as f:
+            existing = json.load(f)
+        if existing.get("meta") != meta:
+            raise SystemExit(
+                f"{args.out} exists with different sweep parameters; "
+                "pass --restart to discard it or change --out")
+        scenarios = {int(k): v for k, v in existing["scenarios"].items()}
+        for _ in scenarios:
+            obs.inc(obs_names.SWEEP_SCENARIOS_TOTAL,
+                    outcome="skipped_existing")
+
+    pending = [i for i in range(args.num_scenarios) if i not in scenarios]
+    cfg = {
+        "trace": args.trace, "policy": args.policy,
+        "throughputs": args.throughputs,
+        "cluster_spec": args.cluster_spec,
+        "round_duration": args.round_duration, "config": args.config,
+        "seed_base": args.seed_base, "max_rounds": args.max_rounds,
+        "scalar_sim": bool(args.scalar_sim), "knobs": knobs,
+    }
+
+    import time as _time
+    # Wall-clock is sweep-throughput telemetry only; scenario content is
+    # purely seed-driven and the artifact stays byte-deterministic.
+    t0 = _time.monotonic()  # swtpu-check: ignore[determinism]
+    n_failed = 0
+    if pending:
+        processes = args.processes or os.cpu_count() or 4
+        ctx = multiprocessing.get_context("fork")
+        with ctx.Pool(min(processes, len(pending))) as pool:
+            payloads = [(i, cfg) for i in pending]
+            for seed_index, record, wall in pool.imap_unordered(
+                    run_scenario, payloads):
+                now = _time.monotonic()  # swtpu-check: ignore[determinism]
+                scenarios[seed_index] = record
+                failed = "error" in record
+                n_failed += failed
+                obs.inc(obs_names.SWEEP_SCENARIOS_TOTAL,
+                        outcome="failed" if failed else "ok")
+                # Worker-measured per-scenario wall (the parent's
+                # inter-completion gap would undercount by the pool
+                # concurrency factor).
+                obs.observe(obs_names.SWEEP_SCENARIO_WALL_SECONDS, wall)
+                write_artifact(args.out, meta, scenarios)
+                done = len(scenarios)
+                print(f"[{done}/{args.num_scenarios}] scenario "
+                      f"{seed_index} {'FAILED' if failed else 'ok'} "
+                      f"({wall:.1f}s sim, {now - t0:.1f}s elapsed)",
+                      file=sys.stderr, flush=True)
+    else:
+        write_artifact(args.out, meta, scenarios)
+    wall_s = _time.monotonic() - t0  # swtpu-check: ignore[determinism]
+
+    if not pending:
+        print("all scenarios already present; artifact refreshed",
+              file=sys.stderr)
+    # Stats over the REQUESTED seed range only: a resumed artifact may
+    # carry more scenarios than this invocation asked for (e.g. a rerun
+    # with a smaller --num_scenarios), and those must not produce
+    # negative failure counts in the result line / bench row.
+    in_range = {i: r for i, r in scenarios.items()
+                if i < args.num_scenarios}
+    completed = sum(1 for r in in_range.values() if "summary" in r)
+    result = {
+        "artifact": args.out,
+        "scenarios": args.num_scenarios,
+        "completed": completed,
+        "failed": len(in_range) - completed,
+        "skipped_existing": len(in_range) - len(pending),
+        "wall_s": round(wall_s, 2),
+        "scenarios_per_min": (round(len(pending) / wall_s * 60.0, 2)
+                              if pending and wall_s > 0 else None),
+    }
+    print(json.dumps(result))
+    if args.timing_out:
+        # Telemetry sidecar, not durable state.
+        with open(args.timing_out, "w") as f:  # swtpu-check: ignore[durability]
+            json.dump(result, f, indent=2)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:  # swtpu-check: ignore[durability]
+            f.write(obs.registry.render_prometheus())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,207 @@
+#!/usr/bin/env python3
+"""Microbenchmark: simulator round-bookkeeping wall, scalar vs vectorized.
+
+Times one round of the scheduling core's per-round bookkeeping
+(priority recompute + round selection + worker assignment + round
+record — `_schedule_jobs_on_workers`) over synthetic clusters at
+several job counts, on both sim-core paths (sched/simcore.py vs the
+retained scalar oracle), asserting the two produce identical
+assignment sequences. Also replays the canonical 120-job trace end to
+end on both paths and compares the full metrics pickles.
+
+This is the evidence artifact for the ISSUE-9 tentpole: the sim-core
+wall must drop >= 5x at fleet scale with replays bit-identical. (The
+canonical *shockwave* replay's end-to-end wall is dominated ~90% by
+HiGHS MILP solves, which no bookkeeping vectorization can touch — see
+EXPERIMENTS.md "Fleet-scale simulation" for the committed profile;
+this benchmark therefore measures the sim core, the thing the tentpole
+vectorizes.)
+
+Example:
+    python scripts/microbenchmarks/bench_sim_round.py \
+        --num_jobs 120 900 2000 --rounds 20
+    python scripts/microbenchmarks/bench_sim_round.py --smoke
+"""
+import argparse
+import json
+import os
+import pickle
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.core.generator import generate_trace  # noqa: E402
+from shockwave_tpu.core.oracle import read_throughputs  # noqa: E402
+from shockwave_tpu.core.profiles import build_profiles  # noqa: E402
+from shockwave_tpu.core.trace import parse_trace  # noqa: E402
+from shockwave_tpu.obs import get_observability  # noqa: E402
+from shockwave_tpu.obs import names as obs_names  # noqa: E402
+from shockwave_tpu.sched import Scheduler, SchedulerConfig  # noqa: E402
+from shockwave_tpu.solver import get_policy  # noqa: E402
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+DEFAULT_THROUGHPUTS = os.path.join(REPO, "data", "tacc_throughputs.json")
+CANONICAL_TRACE = os.path.join(REPO, "data", "canonical_120job.trace")
+
+
+def build_scheduler(policy_name, throughputs_path, njobs, chips,
+                    vectorized, seed, round_duration):
+    throughputs = read_throughputs(throughputs_path)
+    jobs, _ = generate_trace(njobs, throughputs, lam=0.0, seed=seed,
+                             generate_multi_gpu_jobs=True,
+                             generate_dynamic_jobs=True)
+    profiles = build_profiles(jobs, throughputs)
+    sched = Scheduler(
+        get_policy(policy_name, seed=seed), simulate=True,
+        throughputs_file=throughputs_path, profiles=profiles,
+        config=SchedulerConfig(time_per_iteration=round_duration,
+                               seed=seed, vectorized_sim=vectorized))
+    for _ in range(chips):
+        sched.register_worker("v100", 1)
+    for job in jobs:
+        sched.add_job(job, timestamp=0.0)
+    return sched
+
+
+def freeze_assignments(assignments):
+    return [(repr(job_id), tuple(ids)) for job_id, ids in assignments.items()]
+
+
+def time_rounds(sched, rounds, obs, path):
+    """Per-round wall of `_schedule_jobs_on_workers` after one warmup
+    call (the warmup absorbs the one-time allocation LP solve, leaving
+    the pure bookkeeping pass under the clock)."""
+    sched._schedule_jobs_on_workers()
+    walls, frozen = [], []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        assignments = sched._schedule_jobs_on_workers()
+        dt = time.perf_counter() - t0
+        walls.append(dt)
+        obs.observe(obs_names.SIM_ROUND_CORE_SECONDS, dt, path=path)
+        frozen.append(freeze_assignments(assignments))
+    return walls, frozen
+
+
+def bench_round_pass(policy, throughputs_path, njobs, chips, rounds,
+                     seed, round_duration, obs):
+    results = {}
+    frozen = {}
+    for path, vectorized in (("scalar", False), ("vectorized", True)):
+        sched = build_scheduler(policy, throughputs_path, njobs, chips,
+                                vectorized, seed, round_duration)
+        walls, assignments = time_rounds(sched, rounds, obs, path)
+        results[path] = statistics.median(walls)
+        frozen[path] = assignments
+    return {
+        "kind": "round_pass",
+        "policy": policy,
+        "njobs": njobs,
+        "chips": chips,
+        "rounds": rounds,
+        "scalar_ms_per_round": round(results["scalar"] * 1e3, 3),
+        "vectorized_ms_per_round": round(results["vectorized"] * 1e3, 3),
+        "speedup": round(results["scalar"]
+                         / max(results["vectorized"], 1e-9), 2),
+        "assignments_equal": frozen["scalar"] == frozen["vectorized"],
+    }
+
+
+def bench_replay(policy, throughputs_path, trace, round_duration, seed):
+    """End-to-end replay wall on both paths + metrics-pickle equality
+    (no MILP policy here, so the pickles carry no wall telemetry and
+    compare byte-for-byte)."""
+    throughputs = read_throughputs(throughputs_path)
+    out = {"kind": "replay", "policy": policy,
+           "trace": os.path.relpath(trace, REPO)}
+    pickles = {}
+    for path, vectorized in (("scalar", False), ("vectorized", True)):
+        jobs, arrivals = parse_trace(trace)
+        profiles = build_profiles(jobs, throughputs)
+        sched = Scheduler(
+            get_policy(policy, seed=seed), simulate=True,
+            throughputs_file=throughputs_path, profiles=profiles,
+            config=SchedulerConfig(time_per_iteration=round_duration,
+                                   seed=seed, vectorized_sim=vectorized))
+        t0 = time.perf_counter()
+        makespan = sched.simulate({"v100": 32}, arrivals, jobs)
+        out[f"{path}_wall_s"] = round(time.perf_counter() - t0, 3)
+        pickles[path] = pickle.dumps({
+            "makespan": makespan,
+            "jct": sched.get_average_jct(),
+            "ftf": sched.get_finish_time_fairness(),
+            "rounds": sched.rounds.num_completed_rounds,
+            "per_round_schedule": sched.rounds.per_round_schedule,
+        })
+    out["replay_speedup"] = round(
+        out["scalar_wall_s"] / max(out["vectorized_wall_s"], 1e-9), 2)
+    out["bit_identical"] = pickles["scalar"] == pickles["vectorized"]
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--num_jobs", nargs="*", type=int,
+                   default=[120, 900, 2000])
+    p.add_argument("--chips", type=int, default=None,
+                   help="cluster size (default: 32 for <=120 jobs, "
+                        "256 otherwise)")
+    p.add_argument("--rounds", type=int, default=20)
+    p.add_argument("--policy", default="max_min_fairness")
+    p.add_argument("--throughputs", default=DEFAULT_THROUGHPUTS)
+    p.add_argument("--trace", default=CANONICAL_TRACE,
+                   help="trace for the end-to-end replay phase")
+    p.add_argument("--round_duration", type=float, default=120.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--skip_replay", action="store_true")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI gate: small grid, assert bit-identity and "
+                        "a speedup floor")
+    p.add_argument("--min_speedup", type=float, default=5.0,
+                   help="--smoke fails unless the largest round-pass "
+                        "grid point reaches this speedup")
+    p.add_argument("--metrics_out", default=None, metavar="PROM_TXT")
+    args = p.parse_args()
+
+    if args.smoke:
+        args.num_jobs = [120, 900]
+        args.rounds = min(args.rounds, 10)
+
+    obs = get_observability()
+    rows = []
+    for njobs in args.num_jobs:
+        chips = args.chips or (32 if njobs <= 120 else 256)
+        row = bench_round_pass(args.policy, args.throughputs, njobs,
+                               chips, args.rounds, args.seed,
+                               args.round_duration, obs)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if not args.skip_replay:
+        row = bench_replay(args.policy, args.throughputs, args.trace,
+                           args.round_duration, args.seed)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            f.write(obs.registry.render_prometheus())
+
+    if args.smoke:
+        for row in rows:
+            if not row.get("assignments_equal",
+                           row.get("bit_identical", False)):
+                print("FAIL: scalar/vectorized divergence", file=sys.stderr)
+                sys.exit(1)
+        top = max((r for r in rows if r["kind"] == "round_pass"),
+                  key=lambda r: r["njobs"])
+        if top["speedup"] < args.min_speedup:
+            print(f"FAIL: round-pass speedup {top['speedup']}x at "
+                  f"{top['njobs']} jobs below the {args.min_speedup}x "
+                  "floor", file=sys.stderr)
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -132,6 +132,35 @@ def _gns_bs_schedule(model: str, initial_bs: int, num_epochs: int,
     return tuple(min(bs, cap) for bs in schedule)
 
 
+def gns_bs_at(model: str, initial_bs: int, num_epochs: int,
+              scale_factor: int, epoch: int) -> int:
+    """``gns_bs_schedule(...)[epoch]`` without building the schedule.
+
+    The simulator's GNS oracle queries exactly two epochs per job per
+    round with ``num_epochs = max(760, epoch + 2)`` — once the run
+    passes 760 epochs every query carries a fresh ``num_epochs`` and
+    the memoized full-schedule path rebuilds an O(num_epochs) tuple per
+    call. This point query replays the same segment arithmetic (same
+    multiplication order, same first-segment-only final-epoch rule,
+    same MAX_BS cap) for one epoch in O(#segments); equivalence with
+    the full schedule is pinned by tests/test_sim_vectorized.py.
+    """
+    if model in _NON_ADAPTIVE:
+        return initial_bs
+    bs = initial_bs
+    entry = _GNS_SEGMENTS.get((model, initial_bs, scale_factor))
+    if entry is not None:
+        min_epochs, segments = entry
+        if num_epochs > min_epochs:
+            for i, (start, end, mult) in enumerate(segments):
+                stop = num_epochs if i == 0 else num_epochs - 1
+                if end is not None:
+                    stop = min(stop, end)
+                if start <= epoch < stop:
+                    bs *= mult
+    return min(bs, MAX_BS[model])
+
+
 def bs_schedule_for_mode(mode: str, model: str, initial_bs: int, num_epochs: int,
                          scale_factor: int) -> List[int]:
     if mode == "accordion":

@@ -52,6 +52,23 @@ def write_durable(path: str, payload: bytes, magic: bytes,
     return path
 
 
+def write_text_atomic(path: str, text: str) -> str:
+    """Crash-safe plain-text artifact write: tmp file, fsync, atomic
+    rename, directory fsync — the same replacement discipline as
+    `write_durable` but without the CRC footer, for artifacts that must
+    stay directly readable by external tools (e.g. the Monte Carlo
+    sweep's incrementally-rewritten results JSON, which a crash must
+    leave either whole-old or whole-new, never torn)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".")
+    return path
+
+
 def verify_footer(blob: bytes, magic: bytes) -> Tuple[str, Optional[bytes]]:
     """Check `blob`'s integrity footer. Returns (status, payload):
     (FOOTER_OK, payload) with the footer stripped, (FOOTER_MISSING,

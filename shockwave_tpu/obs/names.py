@@ -222,6 +222,30 @@ SERVING_SCALE_EVENTS_TOTAL = _counter(
     "replica spawned or drained", ("direction",))
 
 # ----------------------------------------------------------------------
+# Fleet-scale simulation (vectorized sim core + Monte Carlo sweep:
+# sched/simcore.py, scripts/drivers/sweep_scenarios.py,
+# scripts/microbenchmarks/bench_sim_round.py)
+# ----------------------------------------------------------------------
+
+SIM_FAULT_EVENTS_TOTAL = _counter(
+    "swtpu_sim_fault_events_total",
+    "Injected chip-fault events applied by the simulator, by action "
+    "(kill / revive) — sweep scenarios only, zero on canonical replays",
+    ("action",))
+SIM_ROUND_CORE_SECONDS = _histogram(
+    "swtpu_sim_round_core_seconds",
+    "bench_sim_round: wall time of one round of scheduling bookkeeping "
+    "(priorities + selection + assignment + round record), by sim-core "
+    "path (scalar / vectorized)", ("path",))
+SWEEP_SCENARIOS_TOTAL = _counter(
+    "swtpu_sweep_scenarios_total",
+    "Monte Carlo sweep scenarios, by outcome (ok / failed / "
+    "skipped_existing)", ("outcome",))
+SWEEP_SCENARIO_WALL_SECONDS = _histogram(
+    "swtpu_sweep_scenario_wall_seconds",
+    "Per-scenario simulation wall time inside the sweep's process pool")
+
+# ----------------------------------------------------------------------
 # Offline harnesses (scripts/microbenchmarks, scripts/profiling)
 # ----------------------------------------------------------------------
 

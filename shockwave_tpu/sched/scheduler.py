@@ -30,6 +30,7 @@ from ..core.job import Job, JobIdPair
 from ..core.oracle import read_oracle
 from ..obs import Observability
 from ..obs import names as obs_names
+from . import simcore
 from .journal import decode_job_key, encode_job_key
 from .state import JobAccounting, RoundState, WorkerState
 
@@ -171,6 +172,16 @@ class SchedulerConfig:
     # (view in Perfetto, or summarize with
     # `python -m shockwave_tpu.obs.report`). None skips the export.
     obs_trace_path: Optional[str] = None
+    # ---- simulation performance (see README "Fleet-scale simulation")
+    # Vectorized sim-core passes (sched/simcore.py): priority recompute,
+    # round-queue sort, schedule-membership bookkeeping, batched
+    # micro-task completion, O(1) GNS oracle. Bit-identical to the
+    # retained scalar path (the regression suite pins every policy);
+    # False — or env SWTPU_SCALAR_SIM=1 — selects the scalar reference
+    # oracle. Packing policies fall back to the scalar PRIORITY pass
+    # (pair [a, b] throughput entries); the other vectorized passes
+    # handle pair keys and stay active.
+    vectorized_sim: bool = True
     # ---- serving tier (both modes; see README "Serving tier" and
     # configs/serving_mixed.json) ----
     # Autoscaler options for latency-SLO serving jobs
@@ -195,6 +206,13 @@ class Scheduler:
         self._job_packing = "Packing" in policy.name
         self._config = config or SchedulerConfig()
         self._time_per_iteration = self._config.time_per_iteration
+        # Vectorized sim-core passes (sched/simcore.py); the env var is
+        # the kill switch the regression suite and bench_sim_round.py
+        # flip to reach the retained scalar oracle without config
+        # plumbing.
+        import os as _os
+        self._vectorized = (bool(self._config.vectorized_sim)
+                            and _os.environ.get("SWTPU_SCALAR_SIM") != "1")
 
         self._current_timestamp: float = 0.0
         self._job_id_counter = 0
@@ -1148,6 +1166,12 @@ class Scheduler:
 
         inflight_job, inflight_worker = self._inflight_elapsed_times(
             current_time)
+        if self._vectorized and not self._job_packing:
+            # Packing policies carry [a, b] pair throughput entries the
+            # scalar zero-throughput guard compares directly; the
+            # vectorized pass handles scalar rates only.
+            simcore.update_priorities(self, inflight_job, inflight_worker)
+            return
         for wt in self.workers.worker_types:
             worker_time = (self.acct.worker_type_time.get(wt, 0.0)
                            + inflight_worker.get(wt, 0.0))
@@ -1300,6 +1324,10 @@ class Scheduler:
                                    int_id, sf)
             return scheduled
 
+        if self._vectorized:
+            return simcore.select_jobs_for_round(self, worker_types,
+                                                 reserved)
+
         scheduled = {wt: [] for wt in worker_types}
         workers_left = {wt: self.workers.cluster_spec[wt]
                         - reserved.get(wt, 0) for wt in worker_types}
@@ -1364,8 +1392,11 @@ class Scheduler:
                 # deepcopy here ran every round on the hot path.
                 # Serving-reserved chips never enter the pools, and
                 # seeding `assigned` with them blocks sticky reuse too.
-                "servers": [[w for w in s if w not in reserved_chips]
-                            for s in self.workers.type_to_server_ids[wt]],
+                "servers": ([list(s)
+                             for s in self.workers.type_to_server_ids[wt]]
+                            if not reserved_chips else
+                            [[w for w in s if w not in reserved_chips]
+                             for s in self.workers.type_to_server_ids[wt]]),
                 "assigned": set(reserved_chips),
                 "ptr": 0,
             }
@@ -1448,8 +1479,13 @@ class Scheduler:
             self._worker_type_shuffler.shuffle(worker_types)
 
         scheduled = self._select_jobs_for_round(worker_types, reserved)
-        assignments = self._assign_workers(scheduled, worker_types,
-                                           serving_assignments)
+        if self._vectorized:
+            assignments = simcore.assign_workers(self, scheduled,
+                                                 worker_types,
+                                                 serving_assignments)
+        else:
+            assignments = self._assign_workers(scheduled, worker_types,
+                                               serving_assignments)
 
         int_assignments = {}
         for job_id, ids in assignments.items():
@@ -1473,6 +1509,9 @@ class Scheduler:
         """Per-round bookkeeping shared by the live scheduler and the
         replay path — keeping it in one place keeps the replay leg's
         metrics structurally identical to the free run's."""
+        if self._vectorized:
+            simcore.record_round(self, int_assignments)
+            return
         self.rounds.per_round_schedule.append(int_assignments)
         self.rounds.jobs_in_round.append(len(self.acct.jobs))
         for job_id in self.acct.jobs:
@@ -1582,6 +1621,12 @@ class Scheduler:
     def _simulate_gns(self, job_id: JobIdPair):
         """Oracle for the GNS workload's noise-scale batch doubling
         (reference: scheduler.py:1604-1656)."""
+        if self._vectorized:
+            # O(1) point queries instead of rebuilding the full
+            # per-epoch schedule every round (same decision, pinned by
+            # the gns_bs_at equivalence test).
+            simcore.simulate_gns(self, job_id)
+            return
         from ..core.adaptation import gns_bs_schedule
         job = self.acct.jobs[job_id]
         model, bs = job.model, job.batch_size
@@ -1672,22 +1717,11 @@ class Scheduler:
                       iterator_logs: Optional[Sequence[str]] = None):
         """Handle completion of one worker's micro-task for a job round."""
         a = self.acct
-        to_remove: List[JobIdPair] = []
         # Pair keys (packing) accumulate run time on both members.
         run_time = float(np.max(all_execution_times))
         for m in job_id.singletons():
             a.run_time_per_worker.setdefault(m, {}).setdefault(worker_id, 0.0)
             a.run_time_per_worker[m][worker_id] += run_time
-
-        def member_over_deadline(m: JobIdPair) -> bool:
-            if m not in a.jobs:
-                return True
-            run_time_so_far = (sum(a.run_time_per_worker[m].values())
-                               / a.jobs[m].scale_factor)
-            return run_time_so_far > int(a.jobs[m].duration * DEADLINE_SLACK)
-
-        over_deadline = {m: member_over_deadline(m)
-                         for m in job_id.singletons()}
 
         members = job_id.singletons()
         is_active = {m: m in a.jobs for m in members}
@@ -1706,6 +1740,29 @@ class Scheduler:
 
         updates = sorted(self._in_progress_updates[job_id], key=lambda u: u[0])
         self._in_progress_updates[job_id] = []
+        self._finalize_microtask(job_id, worker_type, scale_factor, updates)
+
+    def _finalize_microtask(self, job_id: JobIdPair, worker_type: str,
+                            scale_factor: int, updates: list) -> None:
+        """Aggregate one complete micro-task (all of a gang's per-worker
+        updates staged and sorted by worker id). Shared by the
+        per-worker ``done_callback`` staging path and the simulator's
+        batched completion (simcore.complete_microtask_batch), so both
+        execution modes run the exact same accounting arithmetic."""
+        a = self.acct
+        to_remove: List[JobIdPair] = []
+        members = job_id.singletons()
+        is_active = {m: m in a.jobs for m in members}
+
+        def member_over_deadline(m: JobIdPair) -> bool:
+            if m not in a.jobs:
+                return True
+            run_time_so_far = (sum(a.run_time_per_worker[m].values())
+                               / a.jobs[m].scale_factor)
+            return run_time_so_far > int(a.jobs[m].duration * DEADLINE_SLACK)
+
+        over_deadline = {m: member_over_deadline(m) for m in members}
+
         self.rounds.completed_in_round.add(job_id)
         if self._journal is not None and not self._replaying:
             self._emit("microtask_done", key=encode_job_key(job_id),
@@ -1924,7 +1981,8 @@ class Scheduler:
                  checkpoint_threshold: Optional[float] = None,
                  resume_from: Optional[str] = None,
                  forced_schedule: Optional[Sequence[Dict[int, Sequence[int]]]]
-                 = None) -> float:
+                 = None,
+                 fault_events: Optional[Sequence[dict]] = None) -> float:
         """Discrete-event simulation of a trace. Returns the makespan.
 
         With `checkpoint_file` + `checkpoint_threshold` in (0, 1), the full
@@ -1943,6 +2001,16 @@ class Scheduler:
         compares free-running runs only). Rounds past the end of the
         recording fall back to the live policy so a slower replay can
         finish its stragglers.
+
+        With `fault_events` (the Monte Carlo sweep's deterministic
+        chip-failure injection — the sim-side analog of
+        runtime/faults.py), each event dict is applied at the first
+        round boundary at or after its ``at`` timestamp:
+        ``{"at": t, "kill": [worker_ids]}`` retires chips from capacity
+        (deregister_workers) and ``{"at": t, "revive": [worker_ids],
+        "worker_type": wt}`` returns them. Events must be sorted by
+        ``at``. None (the default) leaves the canonical replay path
+        untouched.
         """
         if resume_from is not None:
             queued, running, remaining_jobs, current_round = (
@@ -1972,6 +2040,7 @@ class Scheduler:
             running: List[tuple] = []
         num_trace_jobs = remaining_jobs + len(self._completed_jobs)
         checkpoint_saved = resume_from is not None
+        fault_queue = list(fault_events) if fault_events else []
 
         forced_resolve = False
         while remaining_jobs > 0:
@@ -2034,6 +2103,14 @@ class Scheduler:
                 # consulted and the service can scale back up / retire.
                 self._current_timestamp += self._time_per_iteration
                 forced_resolve = False
+            elif fault_queue:
+                # Nothing can run until an injected fault resolves
+                # (e.g. every remaining job needs more chips than the
+                # surviving capacity): advance to the next fault event
+                # (typically a revive) instead of declaring deadlock.
+                self._current_timestamp = max(
+                    self._current_timestamp, float(fault_queue[0]["at"]))
+                forced_resolve = False
             else:
                 self.log.warning("no running jobs and no arrivals; stopping")
                 break
@@ -2074,7 +2151,8 @@ class Scheduler:
                 scale_factor = len(worker_ids)
                 adj_steps = [int(s * slowdown) for s in all_num_steps]
                 assigned = [0] * len(adj_steps)
-                for i, worker_id in enumerate(worker_ids):
+                per_worker_steps = []
+                for i in range(scale_factor):
                     if i == scale_factor - 1:
                         per_worker = [adj_steps[j] - assigned[j]
                                       for j in range(len(adj_steps))]
@@ -2082,8 +2160,16 @@ class Scheduler:
                         per_worker = [s // scale_factor for s in adj_steps]
                     for j in range(len(per_worker)):
                         assigned[j] += per_worker[j]
-                    self.done_callback(job_id, worker_id, per_worker,
-                                       all_execution_times)
+                    per_worker_steps.append(per_worker)
+                if self._vectorized:
+                    simcore.complete_microtask_batch(
+                        self, job_id, worker_ids, per_worker_steps,
+                        all_execution_times)
+                else:
+                    for i, worker_id in enumerate(worker_ids):
+                        self.done_callback(job_id, worker_id,
+                                           per_worker_steps[i],
+                                           all_execution_times)
                 for m in job_id.singletons():
                     if m not in self.acct.jobs:
                         remaining_jobs -= 1
@@ -2108,6 +2194,21 @@ class Scheduler:
             while queued and queued[0][0] <= self._current_timestamp:
                 arrival_time, job = queued.pop(0)
                 self.add_job(job, timestamp=arrival_time)
+
+            # Apply due fault-injection events (sweep scenarios only;
+            # the queue is empty on the canonical replay path).
+            while (fault_queue
+                   and float(fault_queue[0]["at"]) <= self._current_timestamp):
+                event = fault_queue.pop(0)
+                if event.get("kill"):
+                    self.deregister_workers([int(w) for w in event["kill"]])
+                    self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
+                                  action="kill")
+                if event.get("revive"):
+                    self.revive_workers([int(w) for w in event["revive"]],
+                                        event["worker_type"])
+                    self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
+                                  action="revive")
 
             if not self.acct.jobs and not self._serving_live():
                 if not queued:

@@ -1,0 +1,357 @@
+"""Vectorized simulator hot-loop passes.
+
+The discrete-event simulator's per-round cost is dominated by per-job
+Python loops: the priority recompute, the round-queue build + tuple
+sort, the per-round schedule-membership bookkeeping, and the per-worker
+micro-task completion staging (profile evidence in EXPERIMENTS.md
+"Fleet-scale simulation"). This module batches those passes into numpy
+— the same recipe `shockwave/milp.py` applied to MILP assembly — while
+the scheduler retains the original scalar code as the reference oracle
+(`SchedulerConfig.vectorized_sim=False` or ``SWTPU_SCALAR_SIM=1``).
+
+Bit-identity contract: every function here performs the *same IEEE-754
+operations in the same order* as its scalar counterpart in
+``scheduler.py`` — elementwise numpy float64 division/multiplication is
+identical to CPython float arithmetic, ``np.lexsort`` over negated keys
+reproduces the stable ``sorted(..., reverse=True)`` tuple order, and
+integer bookkeeping is exact. The regression suite
+(tests/test_sim_vectorized.py) pins scalar-vs-vectorized equality for
+every policy in reproduce/pickles plus the serving mixed trace, and the
+canonical 120-job replays are pinned against the committed pickles.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from ..core.adaptation import gns_bs_at
+from ..core.job import JobIdPair
+
+_EMPTY: dict = {}
+
+
+def update_priorities(sched, inflight_job: dict, inflight_worker: dict) -> None:
+    """Vectorized body of ``Scheduler._update_priorities``'s per-job
+    loop (non-packing policies: scalar throughput entries only).
+
+    priority = alloc / (job_time / worker_time), with the scalar path's
+    zero-priority guards (job absent from the allocation, zero
+    allocation, zero throughput) and the newly-added-job boost
+    (alloc * 1e9 when the job has no received fraction yet).
+    """
+    acct = sched.acct
+    alloc_map = sched._allocation
+    throughputs = sched._throughputs
+    no_inflight = not inflight_job  # simulation: always empty
+    for wt in sched.workers.worker_types:
+        prio_map = sched._priorities[wt]
+        keys = list(prio_map)
+        n = len(keys)
+        if not n:
+            continue
+        worker_time = (acct.worker_type_time.get(wt, 0.0)
+                       + inflight_worker.get(wt, 0.0))
+        # One hash per (job, map) — each key is looked up once and the
+        # resulting entry dicts are reused across the arrays below.
+        alloc_entries = [alloc_map.get(k) for k in keys]
+        jt_maps = [acct.job_time.get(k) for k in keys]
+        in_alloc = np.fromiter((e is not None for e in alloc_entries),
+                               dtype=bool, count=n)
+        alloc = np.fromiter(
+            (e[wt] if e is not None else 0.0 for e in alloc_entries),
+            dtype=np.float64, count=n)
+        tput = np.fromiter((throughputs[k][wt] for k in keys),
+                           dtype=np.float64, count=n)
+        has_jt = np.fromiter((m is not None and wt in m for m in jt_maps),
+                             dtype=bool, count=n)
+        if no_inflight:
+            job_time = np.fromiter(
+                (m[wt] if (m is not None and wt in m) else 0.0
+                 for m in jt_maps), dtype=np.float64, count=n)
+        else:
+            job_time = np.fromiter(
+                ((m[wt] + inflight_job.get(k, _EMPTY).get(wt, 0.0))
+                 if (m is not None and wt in m) else 0.0
+                 for k, m in zip(keys, jt_maps)),
+                dtype=np.float64, count=n)
+        fraction = np.zeros(n)
+        if worker_time > 0:
+            np.divide(job_time, worker_time, out=fraction, where=has_jt)
+        # Newly added job (no received fraction yet): alloc * 1e9.
+        out = alloc * 1e9
+        np.divide(alloc, fraction, out=out, where=fraction > 0.0)
+        out[~in_alloc | (alloc == 0.0) | (tput == 0.0)] = 0.0
+        # tolist() yields python floats with the exact same bit
+        # patterns; rebuilding the dict preserves key insertion order.
+        sched._priorities[wt] = dict(zip(keys, out.tolist()))
+
+
+def build_round_queue(sched, worker_types: Sequence[str]) -> list:
+    """The scalar queue of ``_select_jobs_for_round`` — per worker type,
+    jobs ordered by (priority, deficit, allocation) descending — built
+    with one ``np.lexsort`` per worker type instead of n-tuple
+    construction + comparison sort.
+
+    ``np.lexsort`` is stable ascending on its last key first; sorting
+    by the negated keys therefore reproduces
+    ``sorted(entries, key=(p, d, a), reverse=True)`` exactly, including
+    insertion-order preservation among fully tied entries (both sorts
+    are stable; negation of IEEE doubles is exact).
+    """
+    queue: list = []
+    for wt in worker_types:
+        prio_map = sched._priorities[wt]
+        keys = list(prio_map)
+        n = len(keys)
+        if not n:
+            continue
+        deficit_map = sched._deficits[wt]
+        alloc_map = sched._allocation
+        # values() iterates in the same insertion order as list(prio_map)
+        # — zero per-key hashing for the priority column.
+        p = np.fromiter(prio_map.values(), dtype=np.float64, count=n)
+        d = np.fromiter((deficit_map[k] for k in keys),
+                        dtype=np.float64, count=n)
+        alloc_entries = [alloc_map.get(k) for k in keys]
+        a = np.fromiter(
+            (e.get(wt, 0.0) if e is not None else 0.0
+             for e in alloc_entries), dtype=np.float64, count=n)
+        order = np.lexsort((-a, -d, -p))
+        queue.extend((keys[i], wt, p[i]) for i in order)
+    return queue
+
+
+def select_jobs_for_round(sched, worker_types: List[str],
+                          reserved: Optional[Dict[str, int]] = None) -> dict:
+    """Vectorized ``_select_jobs_for_round`` for policy-driven (non-
+    shockwave) rounds: identical greedy consumption over the lexsorted
+    queue. The shockwave branch stays scalar in the scheduler (it is
+    planner-driven and O(selected), not O(jobs))."""
+    reserved = reserved or {}
+    scheduled: Dict[str, list] = {wt: [] for wt in worker_types}
+    workers_left = {wt: sched.workers.cluster_spec[wt]
+                    - reserved.get(wt, 0) for wt in worker_types}
+    total_left = sum(workers_left.values())
+    already: Set[JobIdPair] = set()
+    policy_name = sched._policy.name
+    is_fifo = policy_name.startswith("FIFO")
+    jobs = sched.acct.jobs
+    throughputs = sched._throughputs
+
+    for job_id, wt, priority in build_round_queue(sched, worker_types):
+        if total_left == 0:
+            # No capacity anywhere: the scalar loop keeps scanning but
+            # can assign nothing more (pure no-op iterations).
+            break
+        if workers_left[wt] == 0:
+            continue
+        if not job_id.is_pair():
+            # Non-pair fast path (every policy outside packing mode):
+            # members == (job_id,), so the set algebra collapses.
+            if job_id in already:
+                continue
+            if throughputs[job_id][wt] <= 0:
+                continue
+            if is_fifo and priority <= 0.0:
+                continue
+            scale_factor = jobs[job_id].scale_factor
+            if scale_factor > workers_left[wt]:
+                if policy_name == "Isolated_plus":
+                    break  # strict priority order
+                continue
+            workers_left[wt] -= scale_factor
+            total_left -= scale_factor
+            already.add(job_id)
+            scheduled[wt].append((job_id, scale_factor))
+            continue
+        members = job_id.singletons()
+        if any(m in already for m in members):
+            continue
+        tput = throughputs[job_id][wt]
+        if tput[0] <= 0 or tput[1] <= 0:
+            continue
+        if is_fifo and priority <= 0.0:
+            continue
+        sfs = {jobs[m].scale_factor for m in members}
+        if len(sfs) != 1:
+            continue
+        scale_factor = sfs.pop()
+        if scale_factor > workers_left[wt]:
+            if policy_name == "Isolated_plus":
+                break  # strict priority order
+            continue
+        workers_left[wt] -= scale_factor
+        total_left -= scale_factor
+        already.update(members)
+        scheduled[wt].append((job_id, scale_factor))
+    return scheduled
+
+
+def assign_workers(sched, scheduled: dict, worker_types: List[str],
+                   serving_assignments=None):
+    """``_assign_workers`` with a flat per-type chip pool and an index
+    pointer instead of nested per-server list pops.
+
+    The scalar ``_take_workers`` walks server lists popping chip ids —
+    consuming skipped (sticky-reserved) chips permanently; a flattened
+    pool with a monotone cursor visits the exact same chips in the
+    exact same order, so the produced assignment sequence (and the
+    OrderedDict insertion order consumers rely on) is identical.
+    """
+    import collections
+    new_assignments = collections.OrderedDict(serving_assignments or ())
+    reserved_chips = {w for ids in new_assignments.values() for w in ids}
+    current = sched.rounds.current_assignments
+    id_to_type = sched.workers.id_to_type
+    prev_types = {job_id: id_to_type[ids[0]]
+                  for job_id, ids in current.items()}
+    dead = sched.workers.dead
+    is_shockwave = sched._policy.name == "shockwave"
+    alloc_map = sched._allocation
+
+    for wt in worker_types:
+        scheduled[wt].sort(key=lambda x: x[1], reverse=True)
+        entries = scheduled[wt]
+        if not entries:
+            continue
+        if reserved_chips:
+            pool = [w for s in sched.workers.type_to_server_ids[wt]
+                    for w in s if w not in reserved_chips]
+        else:
+            pool = [w for s in sched.workers.type_to_server_ids[wt]
+                    for w in s]
+        assigned = set(reserved_chips)
+        pos = 0
+        npool = len(pool)
+        for current_sf in sorted({sf for _, sf in entries}, reverse=True):
+            # Sticky pass: keep jobs on their previous workers — unless
+            # any of those chips has since been marked dead.
+            for job_id, sf in entries:
+                if sf != current_sf or prev_types.get(job_id) != wt:
+                    continue
+                prev_ids = current[job_id]
+                if any(w in dead for w in prev_ids):
+                    continue
+                if all(w not in assigned for w in prev_ids):
+                    new_assignments[job_id] = prev_ids
+                    assigned.update(prev_ids)
+            # Fill pass.
+            for job_id, sf in entries:
+                if sf != current_sf or job_id in new_assignments:
+                    continue
+                if not is_shockwave and job_id not in alloc_map:
+                    continue
+                taken = []
+                while len(taken) < sf and pos < npool:
+                    w = pool[pos]
+                    pos += 1
+                    if w not in assigned:
+                        taken.append(w)
+                        assigned.add(w)
+                if len(taken) < sf:
+                    raise RuntimeError(
+                        f"could not assign workers to {job_id}")
+                new_assignments[job_id] = tuple(taken)
+                if is_shockwave:
+                    alloc_map.setdefault(job_id, {})[wt] = -1.0
+
+    # Invariant: each chip assigned at most once.
+    seen: Dict[int, int] = {}
+    for ids in new_assignments.values():
+        for w in ids:
+            seen[w] = seen.get(w, 0) + 1
+            if seen[w] > 1:
+                raise RuntimeError(f"worker {w} multiply assigned")
+
+    if sched._simulate:
+        now = sched.get_current_timestamp()
+        latest = sched.acct.latest_timestamps
+        running = sched._running_jobs
+        for job_id in new_assignments:
+            for m in job_id.singletons():
+                latest[m] = now
+                running.add(m)
+    return new_assignments
+
+
+def record_round(sched, int_assignments: Dict) -> None:
+    """``_record_round`` with O(1) schedule membership: the scalar path
+    re-scans the round's key set (including packed-pair tuple keys) for
+    every active job; one flattened id set answers all of them."""
+    sched.rounds.per_round_schedule.append(int_assignments)
+    sched.rounds.jobs_in_round.append(len(sched.acct.jobs))
+    in_round: Set[int] = set()
+    for k in int_assignments:
+        if isinstance(k, tuple):
+            in_round.update(k)
+        else:
+            in_round.add(k)
+    num_scheduled = sched.rounds.num_scheduled_rounds
+    num_queued = sched.rounds.num_queued_rounds
+    for job_id in sched.acct.jobs:
+        int_id = job_id.integer_job_id()
+        if int_id in in_round:
+            num_scheduled[int_id] += 1
+        else:
+            num_queued[int_id] += 1
+    sched._emit("round_recorded", assignments=[
+        [list(k) if isinstance(k, tuple) else k, list(ids)]
+        for k, ids in int_assignments.items()])
+
+
+def complete_microtask_batch(sched, job_id, worker_ids: Sequence[int],
+                             per_worker_steps: Sequence[Sequence[int]],
+                             all_execution_times: Sequence[float]) -> None:
+    """One simulated micro-task completion, batched.
+
+    Equivalent to the scalar drain's ``scale_factor`` separate
+    ``done_callback`` calls: the per-call staging protocol
+    (``_in_progress_updates`` append + length check) is skipped, the
+    per-(member, worker) run-time accumulation and the final
+    aggregation (``_finalize_microtask``) are performed identically.
+    Falls back to the per-call path when the recorded assignment width
+    disagrees with the dispatch (the scalar path would then finalize
+    per call).
+    """
+    recorded = sched.rounds.current_assignments.get(job_id)
+    if recorded is None or len(recorded) != len(worker_ids):
+        for i, worker_id in enumerate(worker_ids):
+            sched.done_callback(job_id, worker_id,
+                                list(per_worker_steps[i]),
+                                list(all_execution_times))
+        return
+    a = sched.acct
+    run_time = float(np.max(all_execution_times))
+    members = job_id.singletons()
+    for m in members:
+        rtpw = a.run_time_per_worker.setdefault(m, {})
+        for w in worker_ids:
+            rtpw[w] = rtpw.get(w, 0.0) + run_time
+    if not any(m in a.jobs for m in members):
+        return
+    # The scalar path's finalizing call is the last dispatched worker's.
+    worker_type = sched.workers.id_to_type[worker_ids[-1]]
+    scale_factor = len(recorded)
+    updates = sorted(
+        ((w, list(steps), [float(t) for t in all_execution_times])
+         for w, steps in zip(worker_ids, per_worker_steps)),
+        key=lambda u: u[0])
+    sched._in_progress_updates[job_id] = []
+    sched._finalize_microtask(job_id, worker_type, scale_factor, updates)
+
+
+def simulate_gns(sched, job_id) -> None:
+    """O(1)-per-epoch GNS oracle: same decision as the scalar
+    ``_simulate_gns`` (which rebuilds the whole per-epoch schedule every
+    round) via ``adaptation.gns_bs_at`` point queries."""
+    job = sched.acct.jobs[job_id]
+    model, bs = job.model, job.batch_size
+    bs0 = sched.acct.original_bs[job_id]
+    epoch = sched._current_epoch(job_id)
+    num_epochs = max(760, epoch + 2)
+    if (gns_bs_at(model, bs0, num_epochs, job.scale_factor, epoch + 1) > bs
+            or gns_bs_at(model, bs0, num_epochs, job.scale_factor,
+                         epoch) > bs):
+        if not sched._at_max_bs(model, bs):
+            sched._bs_flags[job_id]["big_bs"] = True

@@ -1,0 +1,311 @@
+"""Fleet-scale simulation: vectorized sim core + Monte Carlo sweep.
+
+Bit-identical-replay regression suite (scalar reference oracle vs the
+vectorized passes in sched/simcore.py) across every policy in
+reproduce/pickles plus the serving mixed trace, the GNS point-query
+equivalence, deterministic fault injection, and the sweep harness's
+byte-equal-artifact / resume / crash-safety contracts.
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import pytest
+
+from shockwave_tpu.core.adaptation import (_GNS_SEGMENTS, gns_bs_at,
+                                           gns_bs_schedule)
+from shockwave_tpu.core.oracle import read_throughputs
+from shockwave_tpu.core.profiles import build_profiles
+from shockwave_tpu.core.trace import parse_trace
+from shockwave_tpu.sched import Scheduler, SchedulerConfig
+from shockwave_tpu.solver import get_policy
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+DATA = os.path.join(REPO, "data")
+TRACE = os.path.join(DATA, "canonical_120job.trace")
+SERVING_TRACE = os.path.join(DATA, "serving_mixed.trace")
+THROUGHPUTS = os.path.join(DATA, "tacc_throughputs.json")
+SWEEP_DRIVER = os.path.join(REPO, "scripts", "drivers",
+                            "sweep_scenarios.py")
+
+#: Every policy with a canonical result pickle in reproduce/pickles/.
+PICKLE_POLICIES = ("max_min_fairness", "gandiva_fair", "allox",
+                   "max_sum_throughput_perf", "min_total_duration",
+                   "finish_time_fairness", "shockwave")
+
+
+def run_replay(policy, *, vectorized, trace=TRACE, max_jobs=None,
+               max_rounds=None, config=None, seed=0):
+    """One in-process replay; returns a picklable result bundle with no
+    wall-clock telemetry (SolveStats wall fields are stripped)."""
+    jobs, arrivals = parse_trace(trace)
+    if max_jobs is not None:
+        jobs, arrivals = jobs[:max_jobs], arrivals[:max_jobs]
+    throughputs = read_throughputs(THROUGHPUTS)
+    profiles = build_profiles(jobs, throughputs)
+    shockwave_config = None
+    serving_config = None
+    if config is not None:
+        with open(config) as f:
+            shockwave_config = json.load(f)
+        serving_config = shockwave_config.pop("serving", None)
+    elif policy == "shockwave":
+        shockwave_config = {}
+    if shockwave_config is not None:
+        shockwave_config["num_gpus"] = 32
+        shockwave_config["time_per_iteration"] = 120.0
+    sched = Scheduler(
+        get_policy(policy, seed=seed), simulate=True,
+        throughputs_file=THROUGHPUTS, profiles=profiles,
+        config=SchedulerConfig(
+            time_per_iteration=120.0, seed=seed, max_rounds=max_rounds,
+            shockwave=shockwave_config, serving=serving_config,
+            vectorized_sim=vectorized))
+    makespan = sched.simulate({"v100": 32}, arrivals, jobs)
+    solve_stats = [{k: v for k, v in s.items()
+                    if k not in ("wall_s", "assembly_s")}
+                   for s in sched.get_solve_stats()]
+    return {
+        "makespan": makespan,
+        "jct": sched.get_average_jct(),
+        "ftf": sched.get_finish_time_fairness(),
+        "util": sched.get_cluster_utilization(),
+        "rounds": sched.rounds.num_completed_rounds,
+        "per_round_schedule": sched.rounds.per_round_schedule,
+        "timelines": sched._job_timelines,
+        "solve_stats": solve_stats,
+        "serving": sched.serving_summary(),
+    }
+
+
+class TestScalarVectorizedParity:
+    """The acceptance gate: scalar oracle == vectorized passes, to the
+    pickle byte. Tier-1 runs subsampled replays across every canonical
+    policy; the slow suite replays the full canonical trace."""
+
+    @pytest.mark.parametrize("policy", PICKLE_POLICIES)
+    def test_subsampled_replay_bit_identical(self, policy):
+        kwargs = dict(max_jobs=25, max_rounds=40)
+        if policy == "shockwave":
+            kwargs["config"] = os.path.join(REPO, "configs",
+                                            "tacc_32gpus.json")
+        a = run_replay(policy, vectorized=False, **kwargs)
+        b = run_replay(policy, vectorized=True, **kwargs)
+        assert pickle.dumps(a) == pickle.dumps(b)
+
+    def test_serving_mixed_replay_bit_identical(self):
+        config = os.path.join(REPO, "configs", "serving_mixed.json")
+        a = run_replay("max_min_fairness", vectorized=False,
+                       trace=SERVING_TRACE, config=config, max_rounds=40)
+        b = run_replay("max_min_fairness", vectorized=True,
+                       trace=SERVING_TRACE, config=config, max_rounds=40)
+        assert pickle.dumps(a) == pickle.dumps(b)
+        assert b["serving"] is not None
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("policy", PICKLE_POLICIES)
+    def test_full_canonical_replay_bit_identical(self, policy):
+        kwargs = {}
+        if policy == "shockwave":
+            kwargs["config"] = os.path.join(REPO, "configs",
+                                            "tacc_32gpus.json")
+        a = run_replay(policy, vectorized=False, **kwargs)
+        b = run_replay(policy, vectorized=True, **kwargs)
+        assert pickle.dumps(a) == pickle.dumps(b)
+
+    def test_canonical_values_pinned(self):
+        """The vectorized max_min subsample of the canonical replay is
+        deterministic run to run (same process)."""
+        a = run_replay("max_min_fairness", vectorized=True, max_jobs=25,
+                       max_rounds=40)
+        b = run_replay("max_min_fairness", vectorized=True, max_jobs=25,
+                       max_rounds=40)
+        assert pickle.dumps(a) == pickle.dumps(b)
+
+
+class TestGnsPointQuery:
+    """gns_bs_at must agree with the full memoized schedule for every
+    profiled (model, bs, scale_factor) segment table, including the
+    first-segment-only final-epoch rule and the MAX_BS cap."""
+
+    @pytest.mark.parametrize("key", sorted(_GNS_SEGMENTS))
+    def test_matches_full_schedule(self, key):
+        model, bs0, sf = key
+        for num_epochs in (1, 5, 40, 120, 763):
+            schedule = gns_bs_schedule(model, bs0, num_epochs, sf)
+            for epoch in range(num_epochs):
+                assert gns_bs_at(model, bs0, num_epochs, sf, epoch) == \
+                    schedule[epoch], (key, num_epochs, epoch)
+
+    def test_non_adaptive_model(self):
+        assert gns_bs_at("Transformer", 32, 100, 1, 50) == 32
+
+
+def make_job(total_steps=20000, scale_factor=1):
+    from shockwave_tpu.core.job import Job
+    return Job(None, "ResNet-18 (batch size 32)",
+               "python3 main.py --batch_size 32",
+               "image_classification/cifar10", "--num_steps",
+               total_steps=total_steps, duration=2000,
+               scale_factor=scale_factor)
+
+
+class TestFaultInjection:
+    """simulate(fault_events=...): deterministic chip kill/revive at
+    round boundaries — the sweep's failure-scenario hook."""
+
+    def _run(self, fault_events=None, num_jobs=4, num_workers=4):
+        sched = Scheduler(
+            get_policy("max_min_fairness", seed=0), simulate=True,
+            throughputs_file=THROUGHPUTS,
+            config=SchedulerConfig(time_per_iteration=120.0))
+        jobs = [make_job() for _ in range(num_jobs)]
+        makespan = sched.simulate({"v100": num_workers},
+                                  [0.0] * num_jobs, jobs,
+                                  fault_events=fault_events)
+        return sched, makespan
+
+    def test_kill_shrinks_capacity_and_slows_completion(self):
+        _, base = self._run()
+        sched, slow = self._run(fault_events=[
+            {"at": 100.0, "kill": [0, 1]},
+            {"at": 8000.0, "revive": [0, 1], "worker_type": "v100"}])
+        assert len(sched._completed_jobs) == 4
+        assert slow > base  # two of four chips lost for most of the run
+        from shockwave_tpu.obs import names as obs_names
+        assert sched.obs.registry.value(
+            obs_names.SIM_FAULT_EVENTS_TOTAL, action="kill") == 1
+
+    def test_revive_restores_capacity(self):
+        sched, _ = self._run(fault_events=[
+            {"at": 100.0, "kill": [2, 3]},
+            {"at": 400.0, "revive": [2, 3], "worker_type": "v100"}])
+        assert sched.workers.cluster_spec["v100"] == 4
+        assert not sched.workers.dead
+
+    def test_all_chips_down_waits_for_revive(self):
+        """With every chip dead the sim must advance to the revive
+        event instead of declaring deadlock."""
+        sched, _ = self._run(fault_events=[
+            {"at": 100.0, "kill": [0, 1, 2, 3]},
+            {"at": 2000.0, "revive": [0, 1, 2, 3],
+             "worker_type": "v100"}])
+        assert len(sched._completed_jobs) == 4
+
+    def test_deterministic(self):
+        events = [{"at": 150.0, "kill": [1]},
+                  {"at": 3000.0, "revive": [1], "worker_type": "v100"}]
+        _, a = self._run(fault_events=list(events))
+        _, b = self._run(fault_events=list(events))
+        assert a == b
+
+
+def run_sweep(out, num_scenarios=4, processes=2, extra=()):
+    from conftest import cpu_subprocess_env
+    cmd = [sys.executable, SWEEP_DRIVER,
+           "--trace", TRACE, "--policy", "max_min_fairness",
+           "--throughputs", THROUGHPUTS, "--cluster_spec", "v100:32",
+           "--round_duration", "120",
+           "--num_scenarios", str(num_scenarios),
+           "--processes", str(processes),
+           "--subsample", "0.1:0.2", "--load_scale", "0.8:1.2",
+           "--arrival_jitter_s", "300", "--fault_rate", "1",
+           "--out", out, *extra]
+    res = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
+                         timeout=600, env=cpu_subprocess_env())
+    assert res.returncode == 0, res.stderr[-2000:]
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+class TestSweepHarness:
+    def test_byte_equal_artifacts_across_process_counts(self, tmp_path):
+        """Same seeds -> byte-equal artifact, regardless of pool size
+        or completion order."""
+        a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        run_sweep(a, processes=1)
+        run_sweep(b, processes=4)
+        assert open(a, "rb").read() == open(b, "rb").read()
+
+    def test_artifact_schema_and_aggregate(self, tmp_path):
+        out = str(tmp_path / "sweep.json")
+        summary = run_sweep(out)
+        assert summary["completed"] == 4
+        doc = json.load(open(out))
+        assert set(doc) == {"schema", "meta", "scenarios", "aggregate"}
+        assert len(doc["scenarios"]) == 4
+        for record in doc["scenarios"].values():
+            assert "summary" in record and "params" in record
+            assert record["summary"]["makespan"] > 0
+        agg = doc["aggregate"]
+        assert agg["num_ok"] == 4 and agg["num_failed"] == 0
+        assert {"p10", "p50", "p90", "p99", "mean"} <= set(
+            agg["makespan"])
+
+    def test_resume_skips_completed_seeds(self, tmp_path):
+        out = str(tmp_path / "sweep.json")
+        run_sweep(out, num_scenarios=2)
+        summary = run_sweep(out, num_scenarios=4)
+        assert summary["skipped_existing"] == 2
+        assert summary["completed"] == 4
+        # Extending a sweep yields the identical artifact a fresh
+        # 4-scenario run produces (resume is content-transparent).
+        fresh = str(tmp_path / "fresh.json")
+        run_sweep(fresh, num_scenarios=4)
+        assert open(out, "rb").read() == open(fresh, "rb").read()
+
+    def test_meta_mismatch_refuses_resume(self, tmp_path):
+        out = str(tmp_path / "sweep.json")
+        run_sweep(out, num_scenarios=2)
+        from conftest import cpu_subprocess_env
+        res = subprocess.run(
+            [sys.executable, SWEEP_DRIVER, "--trace", TRACE,
+             "--policy", "max_min_fairness",
+             "--throughputs", THROUGHPUTS, "--cluster_spec", "v100:32",
+             "--round_duration", "120", "--num_scenarios", "2",
+             "--subsample", "0.5:0.6",  # different knobs
+             "--out", out],
+            capture_output=True, text=True, cwd=REPO, timeout=600,
+            env=cpu_subprocess_env())
+        assert res.returncode != 0
+        assert "different sweep parameters" in res.stderr
+
+    def test_sweep_config_defaults(self, tmp_path):
+        cfg = tmp_path / "sweep_cfg.json"
+        cfg.write_text(json.dumps({
+            "trace": TRACE, "policy": "max_min_fairness",
+            "throughputs": THROUGHPUTS, "cluster_spec": "v100:32",
+            "round_duration": 120.0, "num_scenarios": 2,
+            "subsample": "0.1:0.2"}))
+        out = str(tmp_path / "sweep.json")
+        from conftest import cpu_subprocess_env
+        res = subprocess.run(
+            [sys.executable, SWEEP_DRIVER, "--sweep_config", str(cfg),
+             "--out", out],
+            capture_output=True, text=True, cwd=REPO, timeout=600,
+            env=cpu_subprocess_env())
+        assert res.returncode == 0, res.stderr[-2000:]
+        assert json.load(open(out))["aggregate"]["num_ok"] == 2
+
+
+class TestBenchSimRound:
+    def test_smoke(self, tmp_path):
+        """The microbenchmark's CI gate: identical assignments on both
+        paths and the speedup floor at the largest smoke grid point."""
+        from conftest import cpu_subprocess_env
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "microbenchmarks",
+                          "bench_sim_round.py"),
+             "--smoke", "--rounds", "5", "--min_speedup", "2.0",
+             "--metrics_out", str(tmp_path / "prom.txt")],
+            capture_output=True, text=True, cwd=REPO, timeout=900,
+            env=cpu_subprocess_env())
+        assert res.returncode == 0, (res.stdout + res.stderr)[-2000:]
+        rows = [json.loads(line)
+                for line in res.stdout.strip().splitlines()]
+        assert all(r.get("assignments_equal", r.get("bit_identical"))
+                   for r in rows)
+        prom = (tmp_path / "prom.txt").read_text()
+        assert "swtpu_sim_round_core_seconds" in prom
