@@ -1,0 +1,622 @@
+#!/usr/bin/env python3
+"""Chaos campaign: N seeded randomized multi-fault schedules, each
+checked against hard invariants — the harness that stops robustness
+validation being one hand-written fault at a time.
+
+Two execution modes share one resumable artifact:
+
+- **sim** schedules drive `Scheduler.simulate(fault_events=...)` with a
+  seeded mix of kill/revive (dead workers) and degrade/restore (gray
+  failures: chips stay registered but run at a fraction of oracle
+  speed) events over a subsample of the base trace.
+- **physical** schedules drive the REAL control plane: a
+  `run_physical.py` scheduler subprocess (journal + `SWTPU_SANITIZE=1`
+  lock sanitizer on) against stub worker daemons
+  (tests/fault_stub_worker.py), with a seeded `SWTPU_FAULTS` rule set
+  (degrade + drop/delay/blackhole) and, on some seeds, a SIGKILLed
+  worker mid-run.
+
+Invariants asserted after every schedule (any violation makes the
+campaign exit nonzero and is recorded in the artifact):
+
+- every job completes (``all_jobs_completed``),
+- exact step accounting (``steps_accounted``): static jobs must land
+  EXACTLY on their step budget — a shortfall means steps were lost to
+  a fault (or the job was dropped at the failure cap), an overshoot
+  means a completion was double-counted; physical mode re-derives the
+  budgets from the durable journal in a fresh process, independent of
+  the live run. Adaptive (accordion/GNS) sim jobs rescale their
+  budgets mid-flight, so they are checked as covered (>=) rather than
+  exact. A job completed short of budget is tolerated ONLY when the
+  books prove the scheduler's DEADLINE_SLACK policy fired (accounted
+  run time > 1.5x expected duration — intended behavior when injected
+  faults starve a job, recorded as ``deadline_dropped``),
+- zero failure charges (``zero_failure_charges``): injected faults are
+  the infrastructure's fault, never the job's. In simulation this is
+  a sharp DIFFERENTIAL check: each schedule also runs once with its
+  fault events stripped, and the injected faults must add ZERO failed
+  micro-task aggregates over that baseline (the
+  `swtpu_microtasks_total{outcome="failed"}` counter survives job
+  completion, unlike `acct.failures`, which resets on success and is
+  deleted at removal — so a fault-induced charge is caught even after
+  every job drains). In physical mode transient charges are by design
+  (a dropped Done's watchdog kill charges the attempt and the next
+  success resets it), so the durable books are checked for residual
+  charges — and a job actually dropped at the failure cap surfaces as
+  a ``steps_accounted`` violation (its budget is short),
+- physical only: the journal passes ``fsck_journal`` (exit 0,
+  ``journal_fsck_clean``), the run was lock-sanitizer clean
+  (``sanitizer_clean`` — SWTPU_SANITIZE=1 aborts the process on a
+  violation, so a zero exit IS the assertion), and no lease wedged the
+  round pipeline (``no_stuck_leases``: the drive finished inside its
+  deadline with the trace drained).
+
+Crash safety / reproducibility, same contract as sweep_scenarios.py:
+the artifact is atomically rewritten after every schedule
+(core/durable_io.write_text_atomic), schedules are keyed by seed and a
+rerun skips completed ones (meta mismatch refuses without --restart),
+and identical seeds+knobs produce a byte-equal artifact — all wall
+telemetry stays on stderr / --timing_out.
+
+Examples:
+    # the committed study (sim only)
+    python scripts/drivers/chaos_campaign.py \
+        --trace data/canonical_120job.trace --policy max_min_fairness \
+        --throughputs data/tacc_throughputs.json --cluster_spec v100:8 \
+        --round_duration 120 --num_schedules 40 \
+        --out reproduce/chaos/chaos_campaign_40.json
+
+    # the CI smoke (sim + one physical-loopback schedule)
+    python scripts/drivers/chaos_campaign.py ... \
+        --num_schedules 6 --physical_schedules 1 --out /tmp/chaos.json
+"""
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import driver_common  # noqa: E402
+from shockwave_tpu.core.durable_io import write_text_atomic  # noqa: E402
+from shockwave_tpu.core.metrics import parse_cluster_spec  # noqa: E402
+from shockwave_tpu.core.profiles import build_profiles  # noqa: E402
+from shockwave_tpu.core.trace import parse_trace  # noqa: E402
+from shockwave_tpu.obs.logconfig import setup_logging  # noqa: E402
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+RUN_PHYSICAL = os.path.join(REPO, "scripts", "drivers", "run_physical.py")
+FSCK = os.path.join(REPO, "scripts", "utils", "fsck_journal.py")
+# The jax-free real-process stub daemon the fault-injection suite
+# already drives; the campaign reuses it as its loopback worker.
+STUB_WORKER = os.path.join(REPO, "tests", "fault_stub_worker.py")
+
+ARTIFACT_SCHEMA = 1
+SIM_INVARIANTS = ("all_jobs_completed", "steps_accounted",
+                  "zero_failure_charges")
+PHYS_INVARIANTS = SIM_INVARIANTS + ("journal_fsck_clean",
+                                    "sanitizer_clean", "no_stuck_leases")
+
+
+chip_layout = driver_common.chip_layout
+
+
+# ----------------------------------------------------------------------
+# Sim schedules
+# ----------------------------------------------------------------------
+
+def draw_sim_schedule(rng, jobs, arrivals, cluster_spec, knobs):
+    """One seeded multi-fault sim schedule: subsampled trace + a mixed
+    kill/degrade event queue. Draw order is the schedule contract."""
+    keep = max(2, int(round(
+        float(rng.uniform(*knobs["subsample"])) * len(jobs))))
+    idx = sorted(int(i) for i in rng.choice(len(jobs), size=min(
+        keep, len(jobs)), replace=False))
+    jobs = [jobs[i] for i in idx]
+    arrivals = [arrivals[i] for i in idx]
+    order = sorted(range(len(jobs)), key=lambda i: arrivals[i])
+    jobs = [jobs[i] for i in order]
+    arrivals = [arrivals[i] for i in order]
+
+    layout = chip_layout(cluster_spec)
+    types = sorted(layout)
+    events = []
+    n_kill = int(rng.poisson(knobs["kill_rate"]))
+    for _ in range(n_kill):
+        wt = types[int(rng.randint(len(types)))]
+        k = min(int(rng.randint(1, knobs["max_chips"] + 1)),
+                max(len(layout[wt]) - 1, 1))  # never kill the whole type
+        ids = sorted(int(i) for i in rng.choice(layout[wt], size=k,
+                                                replace=False))
+        at = float(rng.uniform(0.0, knobs["window_s"]))
+        events.append({"at": round(at, 3), "kill": ids})
+        events.append({"at": round(at + knobs["down_s"], 3),
+                       "revive": ids, "worker_type": wt})
+    n_degrade = int(rng.poisson(knobs["degrade_rate"]))
+    for _ in range(n_degrade):
+        wt = types[int(rng.randint(len(types)))]
+        k = min(int(rng.randint(1, knobs["max_chips"] + 1)),
+                len(layout[wt]))
+        ids = sorted(int(i) for i in rng.choice(layout[wt], size=k,
+                                                replace=False))
+        factor = round(float(rng.uniform(0.05, 0.5)), 6)
+        at = float(rng.uniform(0.0, knobs["window_s"]))
+        events.append({"at": round(at, 3), "degrade": ids,
+                       "factor": factor})
+        events.append({"at": round(at + knobs["down_s"], 3),
+                       "restore": ids})
+    events.sort(key=lambda e: e["at"])
+    plan = {"num_jobs": len(jobs), "kills": n_kill, "degrades": n_degrade}
+    return jobs, arrivals, events, plan
+
+
+def run_sim_schedule(seed, cfg):
+    """One sim schedule end to end; returns the deterministic record."""
+    rng = np.random.RandomState(seed)
+    jobs, arrivals = parse_trace(cfg["trace"])
+    cluster_spec = parse_cluster_spec(cfg["cluster_spec"])
+    jobs, arrivals, events, plan = draw_sim_schedule(
+        rng, jobs, arrivals, cluster_spec, cfg["knobs"])
+    profiles = build_profiles(jobs, cfg["throughput_table"])
+    shockwave_config, serving_config = driver_common.load_configs(
+        cfg["config"], cfg["policy"], cluster_spec, cfg["round_duration"])
+
+    def build():
+        return driver_common.build_scheduler(
+            cfg["policy"], cfg["throughputs"], profiles,
+            round_duration=cfg["round_duration"], seed=seed,
+            shockwave_config=shockwave_config,
+            serving_config=serving_config)
+
+    violations = []
+    try:
+        # Baseline leg: the SAME schedule with its faults stripped.
+        # Some traces produce failed micro-task aggregates with no
+        # faults at all (policy behavior, e.g. a failure-capped job
+        # family); the invariant below is the DIFFERENTIAL — injected
+        # faults must add zero failures over this baseline.
+        import pickle
+        baseline = build()
+        base_jobs, base_arrivals = pickle.loads(
+            pickle.dumps((jobs, arrivals)))  # simulate mutates Jobs
+        baseline.simulate(cluster_spec, base_arrivals, base_jobs,
+                          fault_events=[])
+        from shockwave_tpu.obs import names as obs_names
+        baseline_failed = baseline._obs.registry.value(
+            obs_names.MICROTASKS_TOTAL, outcome="failed")
+
+        sched = build()
+        makespan = sched.simulate(cluster_spec, arrivals, jobs,
+                                  fault_events=events)
+    except Exception as e:  # noqa: BLE001 - a crash is the worst
+        # invariant violation of all; it must land in the artifact, not
+        # sink the campaign.
+        return {"seed": seed, "plan": plan,
+                "violations": [f"simulate raised "
+                               f"{type(e).__name__}: {e}"],
+                "invariants": {k: False for k in SIM_INVARIANTS}}
+
+    completed = sched.get_num_completed_jobs()
+    inv = {}
+    inv["all_jobs_completed"] = completed == len(jobs)
+    if not inv["all_jobs_completed"]:
+        violations.append(f"{completed}/{len(jobs)} jobs completed")
+    from shockwave_tpu.sched.scheduler import DEADLINE_SLACK
+    short, over, deadline_dropped = [], [], []
+    for j in jobs:
+        run = sched.acct.total_steps_run.get(j.job_id, 0)
+        if run >= j.total_steps:
+            # Static budgets are immutable, so any overshoot is a
+            # double-counted completion; adaptive modes rescale both
+            # sides mid-flight and are only checked as covered.
+            if j.mode == "static" and run > j.total_steps:
+                over.append(str(j.job_id))
+            continue
+        run_time = (sum(sched.acct.run_time_per_worker
+                        .get(j.job_id, {}).values())
+                    / max(j.scale_factor, 1))
+        if run_time > int(j.duration * DEADLINE_SLACK):
+            # The scheduler's deadline policy force-completed a
+            # fault-starved job — intended behavior, and the books
+            # prove it (accounted run time over the slack budget).
+            deadline_dropped.append(str(j.job_id))
+        else:
+            short.append(str(j.job_id))
+    inv["steps_accounted"] = not short and not over
+    if short:
+        violations.append(f"step budget not covered for jobs {short} "
+                          "(and not deadline-dropped)")
+    if over:
+        violations.append(f"step budget OVERSHOT for static jobs {over} "
+                          "(double-counted completion?)")
+    # Differential: faults must add zero failed micro-task aggregates
+    # over the fault-free baseline of the same schedule (the counter
+    # survives job completion, unlike acct.failures).
+    failed_microtasks = sched._obs.registry.value(
+        obs_names.MICROTASKS_TOTAL, outcome="failed")
+    inv["zero_failure_charges"] = failed_microtasks <= baseline_failed
+    if failed_microtasks > baseline_failed:
+        violations.append(
+            f"injected faults added "
+            f"{failed_microtasks - baseline_failed:.0f} failed "
+            f"micro-task aggregate(s) over the fault-free baseline "
+            f"({baseline_failed:.0f})")
+    return {"seed": seed, "plan": plan, "invariants": inv,
+            "violations": violations,
+            "summary": {"makespan": round(makespan, 2),
+                        "rounds": sched.rounds.num_completed_rounds,
+                        "completed_jobs": completed,
+                        "failed_microtasks_baseline":
+                            round(baseline_failed, 1),
+                        "failed_microtasks_with_faults":
+                            round(failed_microtasks, 1),
+                        "deadline_dropped": deadline_dropped}}
+
+
+# ----------------------------------------------------------------------
+# Physical-loopback schedules
+# ----------------------------------------------------------------------
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def draw_physical_schedule(rng):
+    """Seeded SWTPU_FAULTS rule set + worker plan for one loopback
+    drive. Every schedule carries a gray failure (degrade that later
+    expires, so recovery is exercised); the RPC-level faults and the
+    mid-run worker SIGKILL are drawn per seed."""
+    rules = [{
+        "method": "execute", "action": "degrade",
+        "factor": round(float(rng.uniform(0.05, 0.3)), 4),
+        "after": int(rng.randint(1, 3)),
+        "times": int(rng.randint(2, 5)),
+    }]
+    if rng.uniform() < 0.5:
+        rules.append({"method": "Done", "action": "drop",
+                      "times": int(rng.randint(1, 3))})
+    if rng.uniform() < 0.4:
+        rules.append({"method": "UpdateLease", "action": "delay",
+                      "delay_s": round(float(rng.uniform(0.1, 0.4)), 3),
+                      "times": int(rng.randint(1, 4))})
+    if rng.uniform() < 0.3:
+        rules.append({"method": "Ping", "action": "blackhole",
+                      "delay_s": 2.0, "times": 1})
+    plan = {
+        "rules": rules,
+        "num_workers": 2,
+        # SIGKILL one worker mid-run on some seeds (jobs must finish on
+        # the survivor with exact accounting).
+        "kill_worker": bool(rng.uniform() < 0.4),
+        "kill_after_s": round(float(rng.uniform(3.0, 8.0)), 2),
+    }
+    return plan
+
+
+def _write_loopback_trace(path, num_jobs=2, steps=300):
+    line = ("ResNet-18 (batch size 32)\tpython3 main.py "
+            "--batch_size 32\timage_classification/cifar10\t"
+            "--num_steps\t0\t{steps}\t1\tstatic\t1\t-1.000000\t10000\t0")
+    with open(path, "w") as f:  # harness input, not durable state
+        for _ in range(num_jobs):
+            f.write(line.format(steps=steps) + "\n")
+    return num_jobs, steps
+
+
+def run_physical_schedule(seed, cfg, workdir):
+    """One real-control-plane schedule: scheduler subprocess + stub
+    worker daemons under a seeded fault rule set. Deterministic record
+    (plans + invariant booleans); wall telemetry to stderr."""
+    import pickle
+    import time as _time  # wall-clock is subprocess babysitting only,
+    # never in the record  # swtpu-check: ignore[determinism]
+
+    rng = np.random.RandomState(cfg["seed_base"] + 10_000 + seed)
+    plan = draw_physical_schedule(rng)
+    os.makedirs(workdir, exist_ok=True)
+    trace = os.path.join(workdir, "loopback.trace")
+    num_jobs, steps = _write_loopback_trace(trace)
+    state_dir = os.path.join(workdir, "state")
+    out_pickle = os.path.join(workdir, "metrics.pkl")
+    sched_port = free_port()
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SWTPU_SANITIZE"] = "1"          # lock sanitizer: abort on violation
+    env["SWTPU_RPC_JITTER_SEED"] = str(seed)
+
+    sched_log = open(os.path.join(workdir, "sched.log"), "w")
+    sched = subprocess.Popen(
+        [sys.executable, RUN_PHYSICAL, "--trace", trace,
+         "--policy", "max_min_fairness",
+         "--throughputs", cfg["throughputs"],
+         "--expected_num_workers", str(plan["num_workers"]),
+         "--round_duration", "2", "--port", str(sched_port),
+         "--state_dir", state_dir, "--snapshot_interval", "2",
+         "--output", out_pickle,
+         "--heartbeat_interval", "0.2", "--worker_timeout", "1.0",
+         "--probe_failures", "2", "--kill_wait", "0.5",
+         "--completion_buffer", "5", "--first_init_grace", "0",
+         "--quarantine_backoff", "3", "--verbose"],
+        stdout=sched_log, stderr=subprocess.STDOUT, env=env)
+
+    workers = []
+    wenv = dict(env)
+    wenv["SWTPU_FAULTS"] = json.dumps(plan["rules"])
+    # Port-bind wait: subprocess babysitting, never in the record.
+    deadline = _time.time() + 30  # swtpu-check: ignore[determinism]
+    while _time.time() < deadline:  # swtpu-check: ignore[determinism]
+        with socket.socket() as s:
+            s.settimeout(0.2)
+            try:
+                s.connect(("127.0.0.1", sched_port))
+                break
+            except OSError:
+                _time.sleep(0.1)
+    for w in range(plan["num_workers"]):
+        wlog = open(os.path.join(workdir, f"worker{w}.log"), "w")
+        workers.append((subprocess.Popen(
+            [sys.executable, STUB_WORKER,
+             "--sched_port", str(sched_port),
+             "--worker_port", str(free_port()), "--num_chips", "1",
+             "--state_file", os.path.join(workdir, f"w{w}.json")],
+            stdout=wlog, stderr=subprocess.STDOUT, env=wenv), wlog))
+
+    violations = []
+    inv = {k: False for k in PHYS_INVARIANTS}
+    try:
+        if plan["kill_worker"]:
+            try:
+                sched.wait(timeout=plan["kill_after_s"])
+            except subprocess.TimeoutExpired:
+                victim = workers[-1][0]
+                if victim.poll() is None:
+                    os.kill(victim.pid, signal.SIGKILL)
+        try:
+            rc = sched.wait(timeout=cfg["physical_timeout_s"])
+            inv["no_stuck_leases"] = True
+        except subprocess.TimeoutExpired:
+            violations.append(
+                f"scheduler did not finish within "
+                f"{cfg['physical_timeout_s']}s (stuck lease / wedged "
+                "round pipeline?)")
+            sched.kill()
+            rc = sched.wait(timeout=10)
+        inv["sanitizer_clean"] = rc == 0
+        if rc != 0:
+            violations.append(f"scheduler exited {rc} under "
+                              "SWTPU_SANITIZE=1")
+
+        if os.path.exists(out_pickle):
+            with open(out_pickle, "rb") as f:
+                metrics = pickle.load(f)
+            inv["all_jobs_completed"] = bool(
+                metrics.get("all_jobs_completed"))
+        if not inv["all_jobs_completed"]:
+            violations.append("not all jobs completed")
+
+        # Exact step accounting, re-derived from the DURABLE record —
+        # the journal is the ground truth that survives the process.
+        fsck = subprocess.run(
+            [sys.executable, FSCK, state_dir], env=env,
+            capture_output=True, text=True, timeout=60)
+        inv["journal_fsck_clean"] = fsck.returncode == 0
+        if fsck.returncode != 0:
+            violations.append(
+                f"fsck_journal exit {fsck.returncode}: "
+                f"{fsck.stdout.strip().splitlines()[-1:]}")
+        check = subprocess.run(
+            [sys.executable, "-c", (
+                "import sys; sys.path.insert(0, sys.argv[1])\n"
+                "from shockwave_tpu.sched import journal\n"
+                "from shockwave_tpu.sched.scheduler import Scheduler\n"
+                "from shockwave_tpu.solver import get_policy\n"
+                "s = Scheduler(get_policy('max_min_fairness'),"
+                " throughputs_file=sys.argv[3])\n"
+                "s.restore_from_durable_state("
+                "journal.load_state(sys.argv[2]))\n"
+                "import json\n"
+                "print(json.dumps({str(k.integer_job_id()): v for k, v"
+                " in s.acct.total_steps_run.items()}))\n"
+                "print(json.dumps({str(k.integer_job_id()): v for k, v"
+                " in s.acct.failures.items()}))"),
+             REPO, state_dir, cfg["throughputs"]],
+            env=env, capture_output=True, text=True, timeout=120)
+        if check.returncode == 0:
+            lines = check.stdout.strip().splitlines()
+            steps_by_job = json.loads(lines[-2])
+            failures = json.loads(lines[-1])
+            # Exact equality: the loopback jobs are static, so a
+            # shortfall means lost progress (or a failure-cap drop)
+            # and an overshoot means a double-counted report.
+            wrong = {j: s for j, s in steps_by_job.items()
+                     if s != steps}
+            inv["steps_accounted"] = (len(steps_by_job) == num_jobs
+                                      and not wrong)
+            if wrong or len(steps_by_job) != num_jobs:
+                violations.append(
+                    f"journal step accounting {steps_by_job} != "
+                    f"{num_jobs}x{steps} exactly")
+            charged = {j: c for j, c in failures.items() if c > 0}
+            inv["zero_failure_charges"] = not charged
+            if charged:
+                violations.append(
+                    f"failure charges under injected faults: {charged}")
+        else:
+            violations.append("journal replay cross-check failed: "
+                              + check.stderr.strip()[-200:])
+    finally:
+        for proc in [sched] + [w for w, _ in workers]:
+            try:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=10)
+            except (subprocess.TimeoutExpired, OSError) as e:
+                # A subprocess stuck in uninterruptible sleep must not
+                # sink the campaign: the schedule's record (and its
+                # violations) is the contract, not this cleanup.
+                print(f"[physical {seed}] cleanup of pid {proc.pid} "
+                      f"failed: {e}", file=sys.stderr)
+        sched_log.close()
+        for _, wlog in workers:
+            wlog.close()
+
+    return {"seed": seed, "plan": plan, "invariants": inv,
+            "violations": violations}
+
+
+# ----------------------------------------------------------------------
+# Artifact plumbing (sweep_scenarios.py contract)
+# ----------------------------------------------------------------------
+
+def write_artifact(path, meta, sim, physical):
+    def _summary():
+        records = list(sim.values()) + list(physical.values())
+        bad = [r for r in records if r.get("violations")]
+        return {
+            "schedules": len(records),
+            "passed": len(records) - len(bad),
+            "violations": sorted(v for r in bad for v in r["violations"]),
+        }
+    doc = {"schema": ARTIFACT_SCHEMA, "meta": meta,
+           "sim": {str(k): sim[k] for k in sorted(sim)},
+           "physical": {str(k): physical[k] for k in sorted(physical)},
+           "summary": _summary()}
+    write_text_atomic(path, json.dumps(doc, indent=1, sort_keys=True) + "\n")
+    return doc
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--trace", required=True)
+    p.add_argument("--policy", default="max_min_fairness")
+    p.add_argument("--throughputs", required=True)
+    p.add_argument("--cluster_spec", default="v100:8")
+    p.add_argument("--round_duration", type=float, default=120.0)
+    p.add_argument("--config", default=None,
+                   help="scheduler config JSON (shockwave/serving blocks)")
+    p.add_argument("--num_schedules", type=int, default=25,
+                   help="seeded sim schedules")
+    p.add_argument("--physical_schedules", type=int, default=0,
+                   help="seeded physical-loopback schedules (real "
+                        "scheduler + stub worker subprocesses; ~15-60s "
+                        "each)")
+    p.add_argument("--seed_base", type=int, default=0)
+    p.add_argument("--out", required=True, help="results JSON artifact")
+    p.add_argument("--restart", action="store_true",
+                   help="ignore an existing artifact instead of resuming")
+    p.add_argument("--workdir", default=None,
+                   help="scratch dir for physical schedules (default: "
+                        "<out>.work)")
+    p.add_argument("--physical_timeout_s", type=float, default=120.0)
+    # -- sim fault knobs --
+    p.add_argument("--subsample", default="0.08:0.2", metavar="LO:HI")
+    p.add_argument("--kill_rate", type=float, default=1.5)
+    p.add_argument("--degrade_rate", type=float, default=1.5)
+    p.add_argument("--max_chips", type=int, default=2)
+    p.add_argument("--fault_window_s", type=float, default=15000.0)
+    p.add_argument("--fault_down_s", type=float, default=4000.0)
+    p.add_argument("--timing_out", default=None,
+                   help="sidecar JSON with wall-clock timings")
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args()
+    setup_logging("info" if args.verbose else "warning")
+
+    try:
+        lo, hi = (float(x) for x in args.subsample.split(":"))
+    except ValueError:
+        raise SystemExit(f"--subsample wants lo:hi, got "
+                         f"{args.subsample!r}") from None
+    knobs = {"subsample": (lo, hi), "kill_rate": args.kill_rate,
+             "degrade_rate": args.degrade_rate,
+             "max_chips": args.max_chips,
+             "window_s": args.fault_window_s, "down_s": args.fault_down_s}
+    meta = {
+        "trace": args.trace, "policy": args.policy,
+        "throughputs": args.throughputs,
+        "cluster_spec": args.cluster_spec,
+        "round_duration": args.round_duration, "config": args.config,
+        "seed_base": args.seed_base,
+        "knobs": {k: (list(v) if isinstance(v, tuple) else v)
+                  for k, v in knobs.items()},
+    }
+
+    sim, physical = {}, {}
+    existing = driver_common.load_resumable_artifact(args.out, meta,
+                                                     args.restart)
+    if existing is not None:
+        sim = {int(k): v for k, v in existing.get("sim", {}).items()}
+        physical = {int(k): v
+                    for k, v in existing.get("physical", {}).items()}
+
+    from shockwave_tpu.core.oracle import read_throughputs
+    cfg = {
+        "trace": args.trace, "policy": args.policy,
+        "throughputs": args.throughputs,
+        "throughput_table": read_throughputs(args.throughputs),
+        "cluster_spec": args.cluster_spec,
+        "round_duration": args.round_duration, "config": args.config,
+        "seed_base": args.seed_base, "knobs": knobs,
+        "physical_timeout_s": args.physical_timeout_s,
+    }
+
+    import time as _time
+    # Wall-clock is campaign-throughput telemetry only (stderr /
+    # --timing_out); the artifact stays byte-deterministic.
+    t0 = _time.monotonic()  # swtpu-check: ignore[determinism]
+    workdir = args.workdir or (args.out + ".work")
+
+    for i in range(args.num_schedules):
+        if i in sim:
+            continue
+        record = run_sim_schedule(args.seed_base + i, cfg)
+        sim[i] = record
+        write_artifact(args.out, meta, sim, physical)
+        status = "ok" if not record["violations"] else "VIOLATION"
+        print(f"[sim {len(sim)}/{args.num_schedules}] seed "
+              f"{args.seed_base + i} {status} "
+              f"({_time.monotonic() - t0:.1f}s elapsed)",  # swtpu-check: ignore[determinism]
+              file=sys.stderr, flush=True)
+
+    for i in range(args.physical_schedules):
+        if i in physical:
+            continue
+        record = run_physical_schedule(
+            i, cfg, os.path.join(workdir, f"phys{i}"))
+        physical[i] = record
+        write_artifact(args.out, meta, sim, physical)
+        status = "ok" if not record["violations"] else "VIOLATION"
+        print(f"[physical {len(physical)}/{args.physical_schedules}] "
+              f"seed {i} {status} "
+              f"({_time.monotonic() - t0:.1f}s elapsed)",  # swtpu-check: ignore[determinism]
+              file=sys.stderr, flush=True)
+
+    doc = write_artifact(args.out, meta, sim, physical)
+    summary = doc["summary"]
+    wall_s = _time.monotonic() - t0  # swtpu-check: ignore[determinism]
+    result = {"artifact": args.out, **summary,
+              "wall_s": round(wall_s, 2)}
+    print(json.dumps(result))
+    if args.timing_out:
+        # Telemetry sidecar, not durable state.
+        with open(args.timing_out, "w") as f:  # swtpu-check: ignore[durability]
+            json.dump(result, f, indent=2)
+    if summary["violations"]:
+        print(f"CHAOS CAMPAIGN FAILED: {len(summary['violations'])} "
+              "invariant violation(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

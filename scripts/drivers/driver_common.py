@@ -21,6 +21,38 @@ from shockwave_tpu.sched import Scheduler, SchedulerConfig  # noqa: E402
 from shockwave_tpu.solver import get_policy  # noqa: E402
 
 
+def chip_layout(cluster_spec: dict, chips_per_server: int = 1) -> dict:
+    """worker_type -> chip ids, matching the registration order
+    simulate() uses (sorted worker types, ids incrementing) — shared by
+    the sweep and chaos drivers so their seeded fault events target the
+    same chips the simulator actually registered."""
+    layout = {}
+    next_id = 0
+    for wt in sorted(cluster_spec):
+        layout[wt] = list(range(next_id, next_id + cluster_spec[wt]))
+        next_id += cluster_spec[wt]
+    return layout
+
+
+def load_resumable_artifact(path: str, meta: dict,
+                            restart: bool) -> Optional[dict]:
+    """Resume contract shared by the sweep and chaos harnesses: an
+    existing artifact at `path` is loaded for seed-keyed resume IFF its
+    recorded meta matches this invocation's exactly; a mismatch refuses
+    loudly (resuming different knobs into one artifact would silently
+    blend two studies) unless `restart` discards it. Returns the loaded
+    document, or None when starting fresh."""
+    if not os.path.exists(path) or restart:
+        return None
+    with open(path) as f:
+        existing = json.load(f)
+    if existing.get("meta") != meta:
+        raise SystemExit(
+            f"{path} exists with different sweep parameters; pass "
+            "--restart to discard it or change --out")
+    return existing
+
+
 def load_configs(config_path: Optional[str], policy: str,
                  cluster_spec: dict, round_duration: float):
     """(shockwave_config, serving_config) from a driver --config file.

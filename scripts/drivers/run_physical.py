@@ -92,6 +92,18 @@ def main():
     p.add_argument("--kill_wait", type=float, default=30.0,
                    help="seconds _kill_job waits for the worker to confirm "
                         "before synthesizing a zero-step completion")
+    # Gray-failure knobs (see README "Gray failures & chaos testing").
+    p.add_argument("--no_worker_health", action="store_true",
+                   help="disable the per-host gray-failure health "
+                        "classifier and worker quarantine")
+    p.add_argument("--quarantine_backoff", type=float, default=None,
+                   help="seconds a quarantined host sits out before its "
+                        "probed probational release (doubles per "
+                        "re-quarantine; default 120)")
+    p.add_argument("--health_config", default=None, metavar="JSON",
+                   help="JSON file (or inline JSON object) of "
+                        "runtime/resilience.HealthConfig field overrides "
+                        "for the gray-failure classifier")
     # Durability knobs (defaults recorded in configs/durability.json;
     # see README "Scheduler crash recovery").
     p.add_argument("--state_dir", "--state-dir", dest="state_dir",
@@ -154,6 +166,17 @@ def main():
             shockwave_config.setdefault("num_gpus", args.expected_num_workers)
         shockwave_config["time_per_iteration"] = args.round_duration
 
+    worker_health = None
+    if args.health_config:
+        if args.health_config.strip().startswith("{"):
+            worker_health = json.loads(args.health_config)
+        else:
+            with open(args.health_config) as f:
+                worker_health = json.load(f)
+    if args.quarantine_backoff is not None:
+        worker_health = dict(worker_health or {})
+        worker_health["quarantine_backoff_s"] = args.quarantine_backoff
+
     policy = get_policy(args.policy, seed=args.seed)
     sched = PhysicalScheduler(
         policy, throughputs_file=args.throughputs, profiles=profiles,
@@ -168,6 +191,8 @@ def main():
             worker_timeout_s=args.worker_timeout,
             worker_probe_failures=args.probe_failures,
             kill_wait_s=args.kill_wait,
+            worker_health_enabled=not args.no_worker_health,
+            worker_health=worker_health,
             state_dir=args.state_dir, resume=args.resume,
             snapshot_interval_rounds=args.snapshot_interval,
             pipelined_planning=not args.no_pipelined_solve,

@@ -24,6 +24,14 @@ so the same seed always produces the same scenario):
                               type at a uniform time in
                               [0, --fault_window_s) and revives them
                               --fault_down_s later
+- ``--degrade_rate R``        Poisson(R) GRAY-failure events per
+                              scenario: each degrades
+                              1..--fault_max_chips chips of one worker
+                              type to a uniform factor in
+                              --degrade_factor of oracle speed (the
+                              simulator's `degrade` fault event — the
+                              chips stay in capacity, just slow) and
+                              restores them --degrade_down_s later
 - ``--serving_spike_seeds``   redraw each serving service's spike seed
                               (load-curve variation for mixed traces)
 
@@ -84,15 +92,7 @@ def parse_range(spec, name):
     return (lo, hi)
 
 
-def chip_layout(cluster_spec, chips_per_server=1):
-    """worker_type -> chip ids, matching the registration order
-    simulate() uses (sorted worker types, ids incrementing)."""
-    layout = {}
-    next_id = 0
-    for wt in sorted(cluster_spec):
-        layout[wt] = list(range(next_id, next_id + cluster_spec[wt]))
-        next_id += cluster_spec[wt]
-    return layout
+chip_layout = driver_common.chip_layout
 
 
 def draw_scenario(rng, jobs, arrivals, knobs, cluster_spec):
@@ -157,9 +157,34 @@ def draw_scenario(rng, jobs, arrivals, knobs, cluster_spec):
             fault_events.append({"at": round(at, 3), "kill": ids})
             fault_events.append({"at": round(at + knobs["fault_down_s"], 3),
                                  "revive": ids, "worker_type": wt})
-        fault_events.sort(key=lambda e: e["at"])
         params["fault_events"] = sum(1 for e in fault_events if "kill" in e)
 
+    # Gray failures: degrade events ride the same queue. Drawn AFTER
+    # the kill events (draw order is the scenario contract), so
+    # degrade_rate=0 — every pre-existing sweep config — reproduces the
+    # exact historical scenarios.
+    degrade_rate = knobs.get("degrade_rate", 0.0)
+    if degrade_rate > 0:
+        layout = chip_layout(cluster_spec)
+        types = sorted(layout)
+        lo, hi = knobs.get("degrade_factor") or (0.05, 0.5)
+        for _ in range(int(rng.poisson(degrade_rate))):
+            wt = types[int(rng.randint(len(types)))]
+            k = min(int(rng.randint(1, knobs["fault_max_chips"] + 1)),
+                    len(layout[wt]))
+            ids = sorted(int(i) for i in rng.choice(layout[wt], size=k,
+                                                    replace=False))
+            factor = round(float(rng.uniform(lo, hi)), 6)
+            at = float(rng.uniform(0.0, knobs["fault_window_s"]))
+            fault_events.append({"at": round(at, 3), "degrade": ids,
+                                 "factor": factor})
+            fault_events.append(
+                {"at": round(at + knobs["degrade_down_s"], 3),
+                 "restore": ids})
+        params["degrade_events"] = sum(1 for e in fault_events
+                                       if "degrade" in e)
+
+    fault_events.sort(key=lambda e: e["at"])
     return jobs, arrivals, fault_events, params
 
 
@@ -283,6 +308,15 @@ def main():
     p.add_argument("--fault_max_chips", type=int, default=2)
     p.add_argument("--fault_down_s", type=float, default=3600.0)
     p.add_argument("--fault_window_s", type=float, default=20000.0)
+    p.add_argument("--degrade_rate", type=float, default=0.0,
+                   help="Poisson rate of gray-failure (degrade) events "
+                        "per scenario")
+    p.add_argument("--degrade_factor", default="0.05:0.5", metavar="LO:HI",
+                   help="uniform range of the multiplicative slowdown "
+                        "factor for degrade events")
+    p.add_argument("--degrade_down_s", type=float, default=3600.0,
+                   help="seconds a degrade event lasts before its chips "
+                        "are restored to full speed")
     p.add_argument("--serving_spike_seeds", action="store_true")
     # -- telemetry (never enters the artifact) --
     p.add_argument("--timing_out", default=None,
@@ -315,6 +349,10 @@ def main():
         "fault_max_chips": args.fault_max_chips,
         "fault_down_s": args.fault_down_s,
         "fault_window_s": args.fault_window_s,
+        "degrade_rate": args.degrade_rate,
+        "degrade_factor": parse_range(args.degrade_factor,
+                                      "degrade_factor"),
+        "degrade_down_s": args.degrade_down_s,
         "serving_spike_seeds": bool(args.serving_spike_seeds),
     }
     meta = {
@@ -332,13 +370,9 @@ def main():
 
     obs = get_observability()
     scenarios = {}
-    if os.path.exists(args.out) and not args.restart:
-        with open(args.out) as f:
-            existing = json.load(f)
-        if existing.get("meta") != meta:
-            raise SystemExit(
-                f"{args.out} exists with different sweep parameters; "
-                "pass --restart to discard it or change --out")
+    existing = driver_common.load_resumable_artifact(args.out, meta,
+                                                     args.restart)
+    if existing is not None:
         scenarios = {int(k): v for k, v in existing["scenarios"].items()}
         for _ in scenarios:
             obs.inc(obs_names.SWEEP_SCENARIOS_TOTAL,

@@ -251,10 +251,12 @@ DETERMINISM_SCOPE_GLOBS = (
     "shockwave_tpu/sched/scheduler.py",
     "shockwave_tpu/sched/simcore.py",
     "shockwave_tpu/sched/state.py",
-    # The Monte Carlo sweep's artifact must be byte-reproducible from
-    # its seeds: scenario content is seeded-RNG only, and wall clocks
-    # are confined to inline-suppressed throughput telemetry.
+    # The Monte Carlo sweep's and the chaos campaign's artifacts must
+    # be byte-reproducible from their seeds: scenario content is
+    # seeded-RNG only, and wall clocks are confined to inline-
+    # suppressed throughput telemetry / subprocess babysitting.
     "scripts/drivers/sweep_scenarios.py",
+    "scripts/drivers/chaos_campaign.py",
 )
 #: Wall-clock measurement utilities (two-point marginal timing) are the
 #: sanctioned home for real clocks.

@@ -111,7 +111,40 @@ WORKER_REVIVALS_TOTAL = _counter(
 WORKER_HEARTBEAT_AGE_SECONDS = _gauge(
     "swtpu_worker_heartbeat_age_seconds",
     "Seconds since each live worker host was last heard from "
-    "(refreshed by the liveness monitor)", ("host",))
+    "(refreshed by the liveness monitor; series dropped when the host "
+    "is retired or quarantined)", ("host",))
+WORKER_BREAKER_STATE = _gauge(
+    "swtpu_worker_breaker_state",
+    "Circuit-breaker state of each live worker host's channel "
+    "(0=closed, 1=half-open, 2=open; series dropped when the host is "
+    "retired or quarantined)", ("host",))
+
+# ----------------------------------------------------------------------
+# Gray-failure resilience: per-host health scoring + worker quarantine
+# (runtime/resilience.py HostHealth, sched/physical.py)
+# ----------------------------------------------------------------------
+
+WORKER_HEALTH_SCORE = _gauge(
+    "swtpu_worker_health_score",
+    "EWMA gray-failure health score of each worker host in [0, 1] "
+    "(1 = nominal; fed by observed steps/s vs the fleet reference, "
+    "dispatch latency, and working-host heartbeat age; kept live for "
+    "quarantined hosts — it is their recovery signal)", ("host",))
+WORKER_HEALTH_TRANSITIONS_TOTAL = _counter(
+    "swtpu_worker_health_transitions_total",
+    "Host health-state transitions, by destination state "
+    "(healthy / suspect / degraded)", ("to",))
+QUARANTINE_EVENTS_TOTAL = _counter(
+    "swtpu_quarantine_events_total",
+    "Worker-host quarantine lifecycle events, by action (quarantine / "
+    "release / dead / reregistered — dead: a quarantined host stopped "
+    "answering probes and converts to a plain retirement; "
+    "reregistered: its daemon restarted, which clears the quarantine)",
+    ("action",))
+QUARANTINED_CHIPS = _gauge(
+    "swtpu_quarantined_chips",
+    "Chips currently held out of capacity by the gray-failure "
+    "quarantine (alive but degraded)")
 
 # ----------------------------------------------------------------------
 # Solver / shockwave planner
@@ -230,8 +263,8 @@ SERVING_SCALE_EVENTS_TOTAL = _counter(
 SIM_FAULT_EVENTS_TOTAL = _counter(
     "swtpu_sim_fault_events_total",
     "Injected chip-fault events applied by the simulator, by action "
-    "(kill / revive) — sweep scenarios only, zero on canonical replays",
-    ("action",))
+    "(kill / revive / degrade / restore) — sweep and chaos scenarios "
+    "only, zero on canonical replays", ("action",))
 SIM_ROUND_CORE_SECONDS = _histogram(
     "swtpu_sim_round_core_seconds",
     "bench_sim_round: wall time of one round of scheduling bookkeeping "

@@ -168,6 +168,13 @@ class Dispatcher:
                     return
                 command = self._construct_command(job, chip_id, worker_id)
                 env = self._job_env(job, worker_id, round_id, chip_id)
+                slowdown = faults.get_injector().slowdown("dispatch")
+                if slowdown < 1.0:
+                    # Gray-failure drill: the process runs, leases renew,
+                    # Ping answers — only step throughput shrinks. The
+                    # training side reads this to throttle itself (the
+                    # stub workers scale their simulated rate by it).
+                    env["SWTPU_DEGRADE_FACTOR"] = f"{slowdown:.6f}"
                 cwd = self._run_dirs.get(job["mode"], ".")
                 if job["working_directory"]:
                     cwd = os.path.join(cwd, job["working_directory"])

@@ -19,6 +19,18 @@ Actions:
 - ``delay``      sleep ``delay_s`` then answer normally.
 - ``freeze``     dispatcher only: launch nothing and report nothing for
                  the job, holding the chip — a wedged process.
+- ``degrade``    dispatcher only: a multiplicative slowdown (``factor``
+                 in (0, 1], default 0.1) — NOT a freeze. The worker
+                 stays live (Ping answers, leases renew) but every
+                 dispatched job runs at ``factor`` of its speed: the
+                 gray-failure the quarantine layer exists to catch.
+                 The dispatcher exports the factor to the training
+                 process as ``SWTPU_DEGRADE_FACTOR`` and the job-side
+                 LeaseIterator honors it by padding each step to
+                 compute/factor (real trainers genuinely slow down);
+                 the stub workers (tests/fault_stub_worker.py) consult
+                 ``injector.slowdown("execute")`` directly to scale
+                 their simulated throughput.
 
 Each rule fires for matching calls number ``after`` .. ``after+times-1``
 (per-rule call counter, so a test can say "drop the first two Done RPCs
@@ -42,7 +54,7 @@ import grpc
 
 logger = logging.getLogger("shockwave_tpu.runtime")
 
-ACTIONS = ("drop", "blackhole", "delay", "freeze")
+ACTIONS = ("drop", "blackhole", "delay", "freeze", "degrade")
 
 
 @dataclass
@@ -52,6 +64,8 @@ class FaultRule:
     method: str
     action: str = "drop"
     delay_s: float = 0.0
+    #: degrade only: multiplicative execution-speed factor in (0, 1].
+    factor: float = 0.1
     #: Apply to at most this many matching calls (None = every call).
     times: Optional[int] = None
     #: Skip this many matching calls before the rule starts firing.
@@ -62,6 +76,9 @@ class FaultRule:
         if self.action not in ACTIONS:
             raise ValueError(f"unknown fault action {self.action!r}; "
                              f"expected one of {ACTIONS}")
+        if self.action == "degrade" and not 0.0 < self.factor <= 1.0:
+            raise ValueError(f"degrade factor must be in (0, 1], got "
+                             f"{self.factor!r}")
 
     def matches(self, method: str) -> bool:
         if self.method == "*":
@@ -139,6 +156,24 @@ class FaultInjector:
             return False
         logger.warning("fault injection: freezing dispatch of %s", method)
         return True
+
+    def slowdown(self, method: str) -> float:
+        """Dispatcher-side hook: multiplicative slowdown factor for this
+        execution (1.0 = full speed). Each matching degrade rule's
+        firing window advances once per call; overlapping rules
+        compound, like stacked throttling causes would."""
+        factor = 1.0
+        with self._lock:
+            for rule in self._rules:
+                if rule.action != "degrade" or not rule.matches(method):
+                    continue
+                if rule.should_fire():
+                    self.fired.append((method, rule.action))
+                    factor *= rule.factor
+        if factor < 1.0:
+            logger.warning("fault injection: degrading %s to %.3fx speed",
+                           method, factor)
+        return factor
 
 
 class _InjectedRpcError(grpc.RpcError):

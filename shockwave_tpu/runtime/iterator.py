@@ -133,6 +133,20 @@ class LeaseIterator:
         self._steps = 0
         self._duration = 0.0
         self._done = False
+        # Gray-failure drill hook (runtime/faults.py `degrade` rules):
+        # the dispatcher exports SWTPU_DEGRADE_FACTOR when an injected
+        # slowdown covers this dispatch, and the iterator honors it by
+        # padding each step to compute_time / factor — the process
+        # stays fully live (renewals, heartbeats, checkpoints) while
+        # its step rate drops to `factor` of normal, exactly the
+        # straggler the scheduler's health layer must catch.
+        try:
+            self._degrade_factor = min(max(float(
+                os.environ.get("SWTPU_DEGRADE_FACTOR", "") or 1.0),
+                1e-3), 1.0)
+        except ValueError:
+            self._degrade_factor = 1.0
+        self._last_degrade_sleep = 0.0
         self._sync_ref: Any = None
         # Sliding window bounding async run-ahead (module docstring).
         self._runahead = max(
@@ -174,6 +188,23 @@ class LeaseIterator:
         elapsed = now - self._prev_time
         self._duration += elapsed
         self._prev_time = now
+
+        if self._degrade_factor < 1.0:
+            # Injected slowdown: pad the step by compute/factor -
+            # compute. The previous pad is subtracted from `elapsed`
+            # first, or each round's pad would compound on the last
+            # one's instead of on the real compute time.
+            compute = max(elapsed - self._last_degrade_sleep, 0.0)
+            pause = compute * (1.0 / self._degrade_factor - 1.0)
+            if pause > 0:
+                time.sleep(pause)
+                self._last_degrade_sleep = pause
+                slept_until = time.time()
+                self._duration += slept_until - self._prev_time
+                elapsed += slept_until - self._prev_time
+                self._prev_time = slept_until
+            else:
+                self._last_degrade_sleep = 0.0
 
         gang = self._gang_allreduce is not None
         if not gang:

@@ -7,8 +7,13 @@ training job's lease renewal) hung inside a deadline-less gRPC call, and
 that policy lives:
 
 - `RetryPolicy`: per-attempt deadline + bounded exponential backoff over
-  a total wall-clock budget. Backoff is deterministic (no jitter) so
-  fault-injection tests can assert exact return-time bounds.
+  a total wall-clock budget. Backoff applies FULL JITTER (uniform in
+  [0, bounded-exponential]) so a healed partition does not turn every
+  worker's queued retry into one synchronized storm at the scheduler;
+  the jitter RNG is injectable (`call_with_retry(rng=...)` /
+  `seed_backoff_jitter`) so seeded tests stay deterministic, and the
+  deterministic upper bound is unchanged — return-time BOUNDS asserted
+  by fault-injection tests still hold.
 - `CircuitBreaker`: per-peer-channel failure counter. After
   `failure_threshold` consecutive transport failures the circuit opens
   and calls fail fast (`CircuitOpenError`) for `reset_timeout_s`; the
@@ -29,9 +34,11 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
 import time
 from dataclasses import dataclass, replace
+from typing import Optional
 
 import grpc
 
@@ -93,15 +100,54 @@ class RetryPolicy:
     backoff_multiplier: float = 2.0
     backoff_max_s: float = 5.0
 
-    def backoff(self, attempt: int) -> float:
-        """Deterministic bounded exponential backoff before attempt N+1."""
+    def backoff_bound(self, attempt: int) -> float:
+        """Deterministic bounded-exponential CEILING of the backoff
+        before attempt N+1 (what budget math and test bounds use)."""
         return min(self.backoff_base_s * self.backoff_multiplier ** attempt,
                    self.backoff_max_s)
+
+    def backoff(self, attempt: int,
+                rng: Optional[random.Random] = None) -> float:
+        """Backoff before attempt N+1: full jitter, uniform in
+        (0, backoff_bound]. Without an RNG the deterministic ceiling is
+        returned (legacy behavior; exact-bound tests use this)."""
+        bound = self.backoff_bound(attempt)
+        if rng is None:
+            return bound
+        # Floor at 1% of the bound: a zero draw would hammer the peer
+        # with a same-instant retry, defeating the backoff entirely.
+        return bound * max(rng.random(), 0.01)
 
     def one_shot(self) -> "RetryPolicy":
         """Same deadline, no retries — for liveness probes, where the
         monitor loop owns the retry cadence."""
         return replace(self, max_attempts=1, total_budget_s=self.deadline_s)
+
+
+def _jitter_seed_from_env() -> Optional[int]:
+    raw = os.environ.get("SWTPU_RPC_JITTER_SEED")
+    if raw is None or raw == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("ignoring non-integer SWTPU_RPC_JITTER_SEED=%r "
+                       "(backoff jitter falls back to OS entropy)", raw)
+        return None
+
+
+#: Process-wide jitter RNG for retry backoff. Seedable twice over: via
+#: `seed_backoff_jitter()` (tests) or `SWTPU_RPC_JITTER_SEED` (the
+#: dispatcher exports env into training processes, so a whole seeded
+#: drill gets reproducible retry timing end to end).
+_jitter_rng = random.Random(_jitter_seed_from_env())
+
+
+def seed_backoff_jitter(seed: Optional[int]) -> None:
+    """Re-seed the process-wide backoff-jitter RNG (None = OS entropy).
+    Retry timing after this call is a pure function of the seed and the
+    failure sequence — what seeded chaos drills assert against."""
+    _jitter_rng.seed(seed)
 
 
 def policy_from_env(default: RetryPolicy = RetryPolicy()) -> RetryPolicy:
@@ -211,7 +257,8 @@ def call_with_retry(callable_, request, *, method: str,
                     policy: RetryPolicy,
                     breaker: CircuitBreaker | None = None,
                     retryable=RETRYABLE_CODES,
-                    clock=time.monotonic, sleep=time.sleep):
+                    clock=time.monotonic, sleep=time.sleep,
+                    rng: Optional[random.Random] = None):
     """Invoke a gRPC unary callable under deadline/retry/breaker policy.
 
     Raises `CircuitOpenError` without touching the network when the
@@ -223,6 +270,13 @@ def call_with_retry(callable_, request, *, method: str,
     calls (e.g. Done, whose handler blocks on the round boundary) pass
     {UNAVAILABLE} only, so a deadline expiry — where the server may
     still be processing the first attempt — is never replayed.
+
+    Backoff sleeps draw full jitter from `rng` (default: the process
+    RNG, seedable via `seed_backoff_jitter` / SWTPU_RPC_JITTER_SEED) so
+    many peers retrying the same healed partition fan out instead of
+    landing as one synchronized storm. Budget exhaustion is still
+    decided against the deterministic `backoff_bound`, keeping the
+    worst-case return time independent of the draw.
     """
     start = clock()
     last_code = None
@@ -252,8 +306,11 @@ def call_with_retry(callable_, request, *, method: str,
             attempt += 1
             if breaker is not None:
                 breaker.record_failure()
-            backoff = policy.backoff(attempt - 1)
-            out_of_budget = (clock() - start) + backoff >= policy.total_budget_s
+            backoff = policy.backoff(attempt - 1,
+                                     rng if rng is not None else _jitter_rng)
+            out_of_budget = ((clock() - start)
+                             + policy.backoff_bound(attempt - 1)
+                             >= policy.total_budget_s)
             if attempt >= policy.max_attempts or out_of_budget:
                 get_observability().inc(obs_names.RPC_UNAVAILABLE_TOTAL,
                                         method=_method_label(method))
@@ -267,3 +324,136 @@ def call_with_retry(callable_, request, *, method: str,
         if breaker is not None:
             breaker.record_success()
         return response
+
+
+# ----------------------------------------------------------------------
+# Gray-failure health scoring (detection half of worker quarantine)
+# ----------------------------------------------------------------------
+
+#: Health states, in decreasing order of trust. `suspect` keeps the
+#: worker schedulable for training but serving replica placement avoids
+#: it; `degraded` quarantines the host (sched/physical.py).
+HEALTH_HEALTHY = "healthy"
+HEALTH_SUSPECT = "suspect"
+HEALTH_DEGRADED = "degraded"
+
+
+@dataclass(frozen=True)
+class HealthConfig:
+    """Knobs of the per-host gray-failure classifier (EWMA + hysteresis)
+    and of the quarantine lifecycle built on it. Defaults detect a
+    worker running at ~10% speed within 3-4 scored observations (about
+    that many rounds when the host runs a job every round) without
+    flapping on a single slow round. README "Gray failures & chaos
+    testing" documents each knob."""
+    #: EWMA smoothing of the 0..1 health samples (higher = reacts
+    #: faster, flaps easier).
+    ewma_alpha: float = 0.45
+    #: Score below which the host becomes `suspect` (serving replica
+    #: placement starts avoiding it).
+    suspect_below: float = 0.6
+    #: Score below which the host is a quarantine candidate.
+    degraded_below: float = 0.3
+    #: Score at or above which a suspect/degraded host may return to
+    #: `healthy` (hysteresis: strictly above suspect_below).
+    recover_above: float = 0.8
+    #: Observations required before the classifier may leave `healthy`
+    #: (one anomalous first sample must not quarantine a cold host).
+    min_samples: int = 3
+    #: Consecutive sub-degraded scores required to enter `degraded`.
+    degraded_consecutive: int = 2
+    #: Consecutive recovered scores required to return to `healthy`.
+    recover_consecutive: int = 2
+    #: Dispatch RPC wall time scoring 0.0 (healthy dispatches are
+    #: milliseconds; a multi-second RunJob round trip is an interconnect
+    #: or daemon symptom).
+    dispatch_latency_ref_s: float = 5.0
+    #: Per-(job_type, scale_factor, worker_type) fleet reference rate
+    #: decay per observation: the reference tracks the FASTEST recent
+    #: observation (max(obs, ref * decay)), so one degraded host cannot
+    #: drag the yardstick it is measured against down with itself.
+    rate_ref_decay: float = 0.995
+    #: Quarantine release probation: how long a freshly quarantined host
+    #: sits out before being released back to capacity as `suspect`
+    #: (a ping cannot prove compute speed, so release is probational —
+    #: still-slow hosts are re-quarantined by the same classifier and
+    #: the backoff doubles, up to the cap).
+    quarantine_backoff_s: float = 120.0
+    quarantine_backoff_max_s: float = 1800.0
+
+    @classmethod
+    def from_dict(cls, config: Optional[dict]) -> "HealthConfig":
+        if not config:
+            return cls()
+        # "_"-prefixed keys are comments (config-file convention, same
+        # as the sweep configs) — a copied reference block must load.
+        config = {k: v for k, v in config.items()
+                  if not k.startswith("_")}
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(
+                f"unknown worker-health option(s): {sorted(unknown)}")
+        return cls(**config)
+
+
+class HostHealth:
+    """EWMA + hysteresis health classifier for one worker host.
+
+    Scored samples in [0, 1] arrive from three telemetry feeds obs
+    already collects (sched/physical.py): observed steps/s vs the
+    fleet-reference rate for the same (job_type, scale_factor), RunJob
+    dispatch latency, and working-host heartbeat age. The classifier is
+    a pure state machine over those samples — no clocks, no RNG — so
+    identical telemetry always produces identical verdicts (the chaos
+    campaign's byte-reproducibility leans on this).
+
+    healthy --(score < suspect_below, >= min_samples)--> suspect
+    suspect --(score < degraded_below for degraded_consecutive)--> degraded
+    degraded/suspect --(score >= recover_above for recover_consecutive)
+        --> healthy
+    """
+
+    def __init__(self, config: Optional[HealthConfig] = None):
+        self.config = config or HealthConfig()
+        self.score = 1.0
+        self.state = HEALTH_HEALTHY
+        self.samples = 0
+        self._below_degraded = 0
+        self._above_recover = 0
+
+    def observe(self, sample: float) -> Optional[str]:
+        """Fold one 0..1 sample in; returns the new state when this
+        observation caused a transition, else None."""
+        cfg = self.config
+        sample = min(max(float(sample), 0.0), 1.0)
+        self.samples += 1
+        self.score = (cfg.ewma_alpha * sample
+                      + (1.0 - cfg.ewma_alpha) * self.score)
+        self._below_degraded = (self._below_degraded + 1
+                                if self.score < cfg.degraded_below else 0)
+        self._above_recover = (self._above_recover + 1
+                               if self.score >= cfg.recover_above else 0)
+        previous = self.state
+        if self.samples >= cfg.min_samples:
+            if (self.state != HEALTH_DEGRADED
+                    and self._below_degraded >= cfg.degraded_consecutive):
+                self.state = HEALTH_DEGRADED
+            elif (self.state == HEALTH_HEALTHY
+                    and self.score < cfg.suspect_below):
+                self.state = HEALTH_SUSPECT
+            elif (self.state != HEALTH_HEALTHY
+                    and self._above_recover >= cfg.recover_consecutive):
+                self.state = HEALTH_HEALTHY
+        return self.state if self.state != previous else None
+
+    def reset_probation(self) -> None:
+        """Re-admit after quarantine: the host starts over as `suspect`
+        with a neutral-but-wary score — it must re-earn `healthy`
+        through recover_consecutive good observations, and one bad
+        observation re-degrades it quickly."""
+        self.score = max(self.score, self.config.suspect_below)
+        self.state = HEALTH_SUSPECT
+        self.samples = max(self.samples, self.config.min_samples)
+        self._below_degraded = 0
+        self._above_recover = 0

@@ -35,7 +35,9 @@ from ..analysis.sanitizer import maybe_wrap
 from ..core.job import JobIdPair
 from ..core.locking import requires_lock
 from ..obs import names as obs_names
-from ..runtime.resilience import RpcUnavailableError
+from ..runtime.resilience import (HEALTH_DEGRADED, HEALTH_HEALTHY,
+                                  HealthConfig, HostHealth,
+                                  RpcUnavailableError)
 from .journal import encode_job_key
 from .scheduler import DEADLINE_SLACK, INFINITY, Scheduler, SchedulerConfig
 
@@ -86,6 +88,10 @@ class PhysicalScheduler(Scheduler):
         "_port_offset",
         # pipelined-planning handoff (round loop <-> solve thread)
         "_planner_request", "_planner_result", "_planner_busy",
+        # gray-failure health scoring + quarantine (fed by done/dispatch
+        # callbacks and the liveness monitor; read by the round pipeline
+        # and the serving tier's suspect-skip)
+        "_host_health", "_fleet_rate",
         # serving tier (mutated by plan_round inside the locked round
         # pipeline and by add_job; read by _serving_live)
         "_serving_tier", "_serving_job_ids",
@@ -151,6 +157,19 @@ class PhysicalScheduler(Scheduler):
         self._planner_request = None
         self._planner_result = None
         self._planner_busy = False
+
+        # Gray-failure detection (see README "Gray failures & chaos
+        # testing"): per-host EWMA health classifier + the
+        # fleet-reference rates it scores observed steps/s against.
+        self._health_enabled = bool(self._config.worker_health_enabled)
+        self._health_cfg = HealthConfig.from_dict(self._config.worker_health)
+        self._host_health: Dict[Tuple[str, int], HostHealth] = {}
+        # (job_type, scale_factor, worker_type) -> fastest recent
+        # observed steps/s (decayed max): the yardstick a host's own
+        # observation is scored against, deliberately NOT the EMA
+        # throughput table (which tracks the degraded host downward and
+        # would launder a slow worker back to "expected").
+        self._fleet_rate: Dict[Tuple[str, int, str], float] = {}
 
         # Durability: recover BEFORE the gRPC server starts (RPCs land
         # the moment the port is bound, and they must see the rebuilt
@@ -275,14 +294,20 @@ class PhysicalScheduler(Scheduler):
             breaker = getattr(host.get("client"), "breaker", None)
             if breaker is not None:
                 breakers[f"{addr}:{port}"] = breaker.state
+        worker_health = {
+            f"{addr}:{port}": {"state": h.state,
+                               "score": round(h.score, 4)}
+            for (addr, port), h in self._host_health.items()}
         return {
             "round": self.rounds.num_completed_rounds,
             "active_jobs": len(self.acct.jobs),
             "completed_jobs": len(self._completed_jobs),
             "live_workers": len(self.workers.worker_ids),
             "dead_workers": len(self.workers.dead),
+            "quarantined_workers": len(self.workers.quarantined),
             "worker_hosts": len(self._worker_hosts),
             "breakers": breakers,
+            "worker_health": worker_health,
             "recovered": self._recovered,
             "uptime_s": round(time.time() - self._start_time, 3),
         }
@@ -341,6 +366,29 @@ class PhysicalScheduler(Scheduler):
                                     host["worker_type"],
                                     host["num_chips"],
                                     [int(i) for i in host["worker_ids"]])
+        # Quarantine survives --resume: the chip-level marker rides the
+        # snapshot (workers.quarantined) and the journal events; rebuild
+        # the host-level bookkeeping from it. The release clock restarts
+        # conservatively at recovery time — a restarted scheduler
+        # re-observes a full backoff before trusting the host again.
+        now = self.get_current_timestamp()
+        for key, host in self._worker_hosts.items():
+            ids = set(host["worker_ids"])
+            # ANY quarantined chip marks the host: a chip that died
+            # BEFORE the quarantine is in workers.dead but not in the
+            # marker, and requiring the full id set would leave the
+            # host without a release clock — quarantined forever.
+            if ids & self.workers.quarantined:
+                host["quarantined_at"] = now
+                host.setdefault("quarantine_backoff_s",
+                                self._health_cfg.quarantine_backoff_s)
+                health = self._host_health.setdefault(
+                    key, HostHealth(self._health_cfg))
+                health.state = HEALTH_DEGRADED
+                health.samples = max(health.samples,
+                                     self._health_cfg.min_samples)
+        self._obs.set_gauge(obs_names.QUARANTINED_CHIPS,
+                            len(self.workers.quarantined))
 
     @requires_lock
     def _adopt_worker_host(self, addr: str, port: int, worker_type: str,
@@ -363,6 +411,7 @@ class PhysicalScheduler(Scheduler):
         self._worker_hosts[key] = dict(
             worker_type=worker_type, num_chips=num_chips,
             worker_ids=list(worker_ids), client=client, probe_failures=0)
+        self._host_health.setdefault(key, HostHealth(self._health_cfg))
 
     def _replay_worker_host(self, data: dict) -> None:
         self._adopt_worker_host(data["addr"], int(data["port"]),
@@ -473,6 +522,7 @@ class PhysicalScheduler(Scheduler):
                 worker_type=worker_type, num_chips=num_chips,
                 worker_ids=list(worker_ids), client=client,
                 probe_failures=0)
+            self._host_health[key] = HostHealth(self._health_cfg)
             self._emit("worker_host", addr=ip_addr, port=port,
                        worker_type=worker_type, num_chips=num_chips,
                        worker_ids=list(worker_ids))
@@ -485,6 +535,12 @@ class PhysicalScheduler(Scheduler):
         duplicate register retry). Must hold the lock."""
         host = self._worker_hosts[key]
         ids = host["worker_ids"]
+        if any(i in self.workers.quarantined for i in ids):
+            # Re-registration of a quarantined host: a restarted daemon
+            # is operator intervention — clear the quarantine (journaled
+            # so replay agrees) and let the probation scoring below
+            # re-earn trust.
+            self._clear_quarantine_marker(key, reason="reregistered")
         if any(i not in self.workers.dead for i in ids):
             # Re-register from a host we still considered live: the
             # daemon restarted (losing its dispatch state), so anything
@@ -494,6 +550,11 @@ class PhysicalScheduler(Scheduler):
         self._close_host_client(host)
         client = SchedulerToWorkerClient(*key)
         self._obs.inc(obs_names.WORKER_REVIVALS_TOTAL)
+        # A rejoining daemon starts over on probation: suspect until it
+        # posts recover_consecutive good observations.
+        health = self._host_health.setdefault(key,
+                                              HostHealth(self._health_cfg))
+        health.reset_probation()
         self.revive_workers(ids, host["worker_type"])
         now = self.get_current_timestamp()
         for worker_id in ids:
@@ -538,11 +599,20 @@ class PhysicalScheduler(Scheduler):
     def _probe_workers(self):
         now = self.get_current_timestamp()
         with self._lock:
-            stale, dead = [], []
+            stale, dead, quarantined = [], [], []
+            job_stamps = self._inflight_job_stamp_by_host()
             for key, host in self._worker_hosts.items():
                 live = [i for i in host["worker_ids"]
                         if i not in self.workers.dead]
                 if not live:
+                    if any(i in self.workers.quarantined
+                           for i in host["worker_ids"]):
+                        # Quarantined host: alive but distrusted. Keep
+                        # probing — death during quarantine converts to
+                        # a plain retirement, and a completed backoff
+                        # releases it on probation.
+                        quarantined.append((key, host))
+                        continue
                     # Fully-retired host: keep probing. A transient
                     # network partition retires a healthy daemon that
                     # will never re-register (it registers once, at
@@ -550,13 +620,38 @@ class PhysicalScheduler(Scheduler):
                     dead.append((key, host))
                     continue
                 last = max(self.workers.last_seen.get(i, 0.0) for i in live)
+                age = max(now - last, 0.0)
                 self._obs.set_gauge(obs_names.WORKER_HEARTBEAT_AGE_SECONDS,
-                                    max(now - last, 0.0),
-                                    host=f"{key[0]}:{key[1]}")
+                                    age, host=f"{key[0]}:{key[1]}")
+                self._set_breaker_gauge(key, host)
+                # Health feed (asymmetric: silence is only evidence when
+                # the host SHOULD be talking): a host with in-round work
+                # stamps a JOB heartbeat on every InitJob / lease
+                # renewal / Done — and a successful Ping cannot refresh
+                # those stamps, so a job-heartbeat age beyond a round +
+                # buffer is a gray signal even while Ping keeps
+                # answering (the wedged-mid-round host). Idle hosts feed
+                # nothing.
+                signal_window = self._time_per_iteration + (
+                    self._config.job_completion_buffer_s
+                    if self._config.job_completion_buffer_s is not None
+                    else JOB_COMPLETION_BUFFER_TIME)
+                job_stamp = job_stamps.get(key)
+                if job_stamp is not None:
+                    job_age = max(now - job_stamp, 0.0)
+                    if job_age > signal_window:
+                        # Graded: 0.5 at one signal window (already
+                        # under the suspect threshold, so suspicion
+                        # accumulates), falling to 0.0 at two windows.
+                        self._health_observe(
+                            key,
+                            max(0.0, 1.0 - 0.5 * job_age / signal_window),
+                            reason="job-heartbeat-age")
                 if now - last >= self._config.worker_timeout_s:
                     stale.append((key, host))
-        for key, host in stale + dead:
+        for key, host in stale + dead + quarantined:
             retired = (key, host) in dead
+            in_quarantine = (key, host) in quarantined
             try:
                 # Probe outside the lock: the deadline bounds it, but the
                 # round pipeline must not stall behind a probe. The
@@ -577,7 +672,15 @@ class PhysicalScheduler(Scheduler):
                         self._config.worker_probe_failures)
                     if (host["probe_failures"]
                             >= self._config.worker_probe_failures):
-                        self._retire_worker_host(key)
+                        if in_quarantine:
+                            # The quarantined daemon stopped answering:
+                            # gray failure turned black. Convert to a
+                            # plain retirement (capacity is already out;
+                            # only the marker and lifecycle change).
+                            self._clear_quarantine_marker(key,
+                                                          reason="dead")
+                        else:
+                            self._retire_worker_host(key)
             else:
                 with self._cv:
                     if host is not self._worker_hosts.get(key):
@@ -589,6 +692,9 @@ class PhysicalScheduler(Scheduler):
                         self._revive_worker_host(key)
                         continue
                     host["probe_failures"] = 0
+                    if in_quarantine:
+                        self._maybe_release_quarantine(key)
+                        continue
                     stamp = self.get_current_timestamp()
                     for i in host["worker_ids"]:
                         if i not in self.workers.dead:
@@ -602,6 +708,10 @@ class PhysicalScheduler(Scheduler):
         host = self._worker_hosts.get(key)
         if host is None:
             return
+        if any(i in self.workers.quarantined for i in host["worker_ids"]):
+            # Retiring a quarantined host (shape-change re-registration,
+            # operator action): it is dead now, not merely distrusted.
+            self._clear_quarantine_marker(key, reason="dead")
         dead_ids = [i for i in host["worker_ids"]
                     if i not in self.workers.dead]
         if not dead_ids:
@@ -609,11 +719,12 @@ class PhysicalScheduler(Scheduler):
         self.log.warning("worker %s:%d presumed dead; retiring chips %s",
                          key[0], key[1], dead_ids)
         self._obs.inc(obs_names.WORKER_RETIREMENTS_TOTAL)
-        # Drop the host's heartbeat-age series: a frozen last-known age
-        # would keep a dead host looking live on /metrics.
-        self._obs.registry.remove_series(
-            obs_names.WORKER_HEARTBEAT_AGE_SECONDS,
-            host=f"{key[0]}:{key[1]}")
+        # Drop the host's per-host gauge series AND its classifier
+        # entry: a frozen last-known heartbeat age / breaker state /
+        # health score would keep a dead host looking live on /metrics
+        # and /healthz forever (revival recreates the entry fresh).
+        self._drop_host_series(key, health_too=True)
+        self._host_health.pop(key, None)
         self.deregister_workers(dead_ids)
         for worker_id in dead_ids:
             self._remove_available_worker(worker_id)
@@ -728,6 +839,335 @@ class PhysicalScheduler(Scheduler):
                     self._job_timelines[m.integer_job_id()].append(
                         f"t={self.get_current_timestamp():.1f} "
                         f"WORKER_FAILED chips={missing} requeued")
+
+    # ------------------------------------------------------------------
+    # Gray-failure health scoring + worker quarantine
+    # ------------------------------------------------------------------
+
+    @requires_lock
+    def _inflight_job_stamp_by_host(self) -> dict:
+        """Host key -> newest JOB-level heartbeat stamp (InitJob /
+        UpdateLease / Done / dispatch time, self._last_heartbeat) among
+        the micro-tasks currently in flight on that host's chips. A
+        successful Ping refreshes workers.last_seen but can NEVER
+        refresh these, so their age is the honest 'working but silent'
+        gray signal — a host wedged mid-round while still answering
+        probes goes stale here and nowhere else. Must hold the lock."""
+        worker_to_key = {w: key
+                         for key, host in self._worker_hosts.items()
+                         for w in host["worker_ids"]}
+        out: dict = {}
+        for job_id, ids in self.rounds.current_assignments.items():
+            if job_id in self.rounds.completed_in_round:
+                continue
+            stamps = [self._last_heartbeat[m]
+                      for m in job_id.singletons()
+                      if m in self._last_heartbeat]
+            if not stamps:
+                continue
+            newest = max(stamps)
+            for w in ids:
+                key = worker_to_key.get(w)
+                if key is not None:
+                    out[key] = max(out.get(key, 0.0), newest)
+        return out
+
+    @requires_lock
+    def _host_key_for_worker(self, worker_id: int):
+        for key, host in self._worker_hosts.items():
+            if worker_id in host["worker_ids"]:
+                return key
+        return None
+
+    def _set_breaker_gauge(self, key, host) -> None:
+        breaker = getattr(host.get("client"), "breaker", None)
+        if breaker is not None:
+            value = {"closed": 0.0, "half-open": 1.0,
+                     "open": 2.0}.get(breaker.state, 0.0)
+            self._obs.set_gauge(obs_names.WORKER_BREAKER_STATE, value,
+                                host=f"{key[0]}:{key[1]}")
+
+    def _drop_host_series(self, key, health_too: bool = False) -> None:
+        """Remove a host's per-host gauge series from /metrics: retired
+        and quarantined hosts must stop exposing their last-known
+        heartbeat age / breaker state instead of reporting it forever.
+        The health score survives quarantine (`health_too=False`) — it
+        is the quarantined host's recovery signal."""
+        host_label = f"{key[0]}:{key[1]}"
+        self._obs.registry.remove_series(
+            obs_names.WORKER_HEARTBEAT_AGE_SECONDS, host=host_label)
+        self._obs.registry.remove_series(
+            obs_names.WORKER_BREAKER_STATE, host=host_label)
+        if health_too:
+            self._obs.registry.remove_series(
+                obs_names.WORKER_HEALTH_SCORE, host=host_label)
+
+    @requires_lock
+    def _health_observe(self, key, sample: float, reason: str) -> None:
+        """Feed one 0..1 sample into a host's health classifier and act
+        on the verdict: a transition to `degraded` quarantines the
+        host. Must hold the lock."""
+        if not self._health_enabled:
+            return
+        health = self._host_health.get(key)
+        if health is None:
+            return
+        transition = health.observe(sample)
+        self._obs.set_gauge(obs_names.WORKER_HEALTH_SCORE, health.score,
+                            host=f"{key[0]}:{key[1]}")
+        if transition is None:
+            return
+        self._obs.inc(obs_names.WORKER_HEALTH_TRANSITIONS_TOTAL,
+                      to=transition)
+        self.log.warning(
+            "worker %s:%d health -> %s (score %.3f after %s sample %.3f)",
+            key[0], key[1], transition, health.score, reason, sample)
+        if transition == HEALTH_DEGRADED:
+            self._quarantine_worker_host(key)
+
+    @requires_lock
+    def _health_note_rate(self, worker_id: int, job_id: JobIdPair,
+                          steps: int, exec_time: float) -> None:
+        """Score one completed micro-task's observed steps/s against the
+        fleet-reference rate for the same (job_type, scale_factor,
+        worker_type). The reference is a decayed max across hosts, so a
+        straggler is measured against its healthy peers (and against
+        its own past self on a one-host cluster), not against the EMA
+        table it is actively dragging down. Must hold the lock."""
+        if not self._health_enabled or job_id.is_pair():
+            return
+        if steps <= 0 or exec_time <= 0:
+            return  # failure signal, not a rate measurement
+        job = self.acct.jobs.get(job_id)
+        if job is None or worker_id not in self.workers.id_to_type:
+            return
+        key = self._host_key_for_worker(worker_id)
+        if key is None:
+            return
+        rate = steps / exec_time
+        ref_key = (job.job_type, job.scale_factor,
+                   self.workers.id_to_type[worker_id])
+        ref = self._fleet_rate.get(ref_key)
+        if ref is None or ref <= 0:
+            self._fleet_rate[ref_key] = rate
+            self._health_observe(key, 1.0, reason="throughput")
+            return
+        sample = min(rate / ref, 1.0)
+        self._fleet_rate[ref_key] = max(
+            rate, ref * self._health_cfg.rate_ref_decay)
+        self._health_observe(key, sample, reason="throughput")
+
+    @requires_lock
+    def _health_note_dispatch(self, worker_id: int, latency_s: float) -> None:
+        """Dispatch-latency health feed: fast RunJob round trips carry
+        no signal (feed nothing); one inside striking distance of the
+        reference budget is interconnect/daemon trouble even when it
+        succeeds. Must hold the lock."""
+        if not self._health_enabled:
+            return
+        ref = self._health_cfg.dispatch_latency_ref_s
+        if ref <= 0 or latency_s < 0.1 * ref:
+            return
+        key = self._host_key_for_worker(worker_id)
+        if key is not None:
+            self._health_observe(
+                key, max(0.0, 1.0 - latency_s / ref),
+                reason="dispatch-latency")
+
+    @requires_lock
+    def _quarantine_worker_host(self, key) -> None:
+        """Quarantine a degraded-but-alive host: pull its chips from
+        assignable capacity through the PR 1 deregister/requeue
+        machinery (in-round micro-tasks synthesized failed + requeued
+        with NO failure charge), kill the straggling processes through
+        the still-reachable daemon, and start the probed release
+        backoff. Journaled, so quarantine survives --resume. Must hold
+        the lock."""
+        host = self._worker_hosts.get(key)
+        if host is None:
+            return
+        ids = [i for i in host["worker_ids"]
+               if i not in self.workers.dead]
+        if not ids:
+            return
+        self.log.warning(
+            "worker %s:%d QUARANTINED (gray failure): chips %s leave "
+            "assignable capacity; daemon stays probed for recovery",
+            key[0], key[1], ids)
+        self._obs.inc(obs_names.QUARANTINE_EVENTS_TOTAL,
+                      action="quarantine")
+        # The straggler's in-flight processes burn the chip and would
+        # report a late Done (rejected by the dispatch stamps, but why
+        # wait): kill them through the daemon, which — unlike a dead
+        # host's — is reachable. Best-effort short deadline: the lock
+        # is held.
+        victims = []
+        for job_id, worker_ids in list(
+                self.rounds.current_assignments.items()):
+            if (set(worker_ids) & set(ids)
+                    and job_id not in self.rounds.completed_in_round):
+                victims.extend(m.integer_job_id()
+                               for m in job_id.singletons()
+                               if m in self.acct.jobs)
+        for int_id in victims:
+            try:
+                host["client"].kill_job(
+                    int_id,
+                    deadline_s=self._config.worker_probe_deadline_s)
+            except WORKER_RPC_ERRORS:
+                break  # daemon unreachable after all; probes decide
+        self.workers.quarantined.update(ids)
+        self.deregister_workers(ids)
+        for worker_id in ids:
+            self._remove_available_worker(worker_id)
+        self._fail_jobs_on_dead_workers(set(ids))
+        host["quarantined_at"] = self.get_current_timestamp()
+        backoff = host.get("quarantine_backoff_s")
+        host["quarantine_backoff_s"] = (
+            self._health_cfg.quarantine_backoff_s if backoff is None
+            else min(backoff * 2.0,
+                     self._health_cfg.quarantine_backoff_max_s))
+        host["probe_failures"] = 0
+        self._drop_host_series(key)  # health score stays live
+        self._obs.set_gauge(obs_names.QUARANTINED_CHIPS,
+                            len(self.workers.quarantined))
+        self._emit("worker_quarantined", addr=key[0], port=key[1],
+                   worker_type=host["worker_type"],
+                   worker_ids=list(ids),
+                   ts=self.get_current_timestamp())
+        self._cv.notify_all()
+
+    @requires_lock
+    def _maybe_release_quarantine(self, key) -> None:
+        """A quarantined host answered a probe: release it on probation
+        once its backoff has elapsed. A ping proves liveness, not
+        compute speed — so the released host comes back `suspect`
+        (serving keeps avoiding it) and must re-earn `healthy` through
+        real observed throughput; a still-slow host is re-quarantined
+        by the same classifier with a doubled backoff. Must hold the
+        lock."""
+        host = self._worker_hosts.get(key)
+        if host is None or "quarantined_at" not in host:
+            return
+        now = self.get_current_timestamp()
+        backoff = host.get("quarantine_backoff_s",
+                           self._health_cfg.quarantine_backoff_s)
+        if now - host["quarantined_at"] < backoff:
+            return
+        ids = [i for i in host["worker_ids"]
+               if i in self.workers.quarantined]
+        if not ids:
+            return
+        self.log.warning(
+            "worker %s:%d released from quarantine on probation after "
+            "%.0fs (suspect until throughput recovers)", key[0], key[1],
+            now - host["quarantined_at"])
+        self._obs.inc(obs_names.QUARANTINE_EVENTS_TOTAL, action="release")
+        del host["quarantined_at"]
+        health = self._host_health.setdefault(key,
+                                              HostHealth(self._health_cfg))
+        health.reset_probation()
+        self._obs.inc(obs_names.WORKER_HEALTH_TRANSITIONS_TOTAL,
+                      to=health.state)
+        # revive_workers clears the quarantined marker and restores
+        # capacity; the explicit event keeps replay (and the journal-
+        # coverage invariant) in step with the live transition.
+        self.revive_workers(ids, host["worker_type"])
+        now_ts = self.get_current_timestamp()
+        for worker_id in ids:
+            self.workers.last_seen[worker_id] = now_ts
+        self._obs.set_gauge(obs_names.QUARANTINED_CHIPS,
+                            len(self.workers.quarantined))
+        self._emit("worker_unquarantined", addr=key[0], port=key[1],
+                   worker_type=host["worker_type"],
+                   worker_ids=list(ids), reason="released", ts=now_ts)
+        self._cv.notify_all()
+
+    @requires_lock
+    def _clear_quarantine_marker(self, key, reason: str) -> None:
+        """Drop a host's quarantine marker WITHOUT restoring capacity:
+        the host died in quarantine (or was retired / re-registered).
+        The chips stay in workers.dead; only the lifecycle bookkeeping
+        changes. Must hold the lock."""
+        host = self._worker_hosts.get(key)
+        if host is None:
+            return
+        ids = [i for i in host["worker_ids"]
+               if i in self.workers.quarantined]
+        if not ids:
+            return
+        self.log.warning("worker %s:%d leaves quarantine (%s); chips %s "
+                         "remain out of capacity", key[0], key[1], reason,
+                         ids)
+        self._obs.inc(obs_names.QUARANTINE_EVENTS_TOTAL, action=reason)
+        if reason == "dead":
+            # Gray turned black: this IS a retirement (capacity left at
+            # quarantine time, so _retire_worker_host's early return
+            # would skip both of these) — count it, and drop the health
+            # series a quarantined host keeps as its recovery signal,
+            # or the dead host's last score is exposed forever.
+            self._obs.inc(obs_names.WORKER_RETIREMENTS_TOTAL)
+            self._drop_host_series(key, health_too=True)
+            self._host_health.pop(key, None)
+        for worker_id in ids:
+            self.workers.quarantined.discard(worker_id)
+        host.pop("quarantined_at", None)
+        self._obs.set_gauge(obs_names.QUARANTINED_CHIPS,
+                            len(self.workers.quarantined))
+        self._emit("worker_unquarantined", addr=key[0], port=key[1],
+                   worker_type=host["worker_type"],
+                   worker_ids=list(ids), reason=reason,
+                   ts=self.get_current_timestamp())
+
+    @requires_lock
+    def _replay_worker_quarantined(self, data: dict) -> None:
+        """Replay: re-mark the chips quarantined (capacity was already
+        removed by the paired workers_retired event) and restart the
+        release clock conservatively at recovery time. Runs under the
+        recovery lock."""
+        ids = [int(i) for i in data["worker_ids"]]
+        self.workers.quarantined.update(
+            i for i in ids if i in self.workers.dead)
+        key = (data["addr"], int(data["port"]))
+        host = self._worker_hosts.get(key)
+        if host is not None:
+            host["quarantined_at"] = self.get_current_timestamp()
+            host.setdefault("quarantine_backoff_s",
+                            self._health_cfg.quarantine_backoff_s)
+            health = self._host_health.setdefault(
+                key, HostHealth(self._health_cfg))
+            health.state = HEALTH_DEGRADED
+            health.samples = max(health.samples,
+                                 self._health_cfg.min_samples)
+
+    @requires_lock
+    def _replay_worker_unquarantined(self, data: dict) -> None:
+        """Replay: drop the marker. Capacity (when the release restored
+        it) is replayed by the paired workers_revived event, which
+        already clears the marker too — this handler covers the
+        marker-only paths (death in quarantine, re-registration). Runs
+        under the recovery lock."""
+        for i in data["worker_ids"]:
+            self.workers.quarantined.discard(int(i))
+        host = self._worker_hosts.get((data["addr"], int(data["port"])))
+        if host is not None:
+            host.pop("quarantined_at", None)
+
+    def suspect_worker_ids(self) -> frozenset:
+        """Chips on hosts currently classified suspect or degraded —
+        the serving tier's replica placement avoids these (a latency-SLO
+        replica pinned to a straggler violates its SLO every round the
+        training tier would merely run slow)."""
+        with self._lock:
+            if not self._health_enabled:
+                return frozenset()
+            out = set()
+            for key, health in self._host_health.items():
+                if health.state != HEALTH_HEALTHY:
+                    host = self._worker_hosts.get(key)
+                    if host is not None:
+                        out.update(host["worker_ids"])
+            return frozenset(out)
 
     def _init_job_callback(self, job_id: JobIdPair):
         """Grant the initial lease (reference: scheduler.py:3880-4048)."""
@@ -1026,6 +1466,15 @@ class PhysicalScheduler(Scheduler):
             if is_active and job_id in self.rounds.extended_leases:
                 self._redispatch_assignments[job_id] = (
                     self.rounds.next_assignments[job_id])
+            # Gray-failure feed, LAST: the micro-task is fully accounted
+            # (completed_in_round, chip back in the pool), so a degraded
+            # verdict's quarantine sees consistent round state when it
+            # requeues the host's other work and drains the pool.
+            if (not job_id.is_pair() and all_num_steps
+                    and all_execution_times):
+                self._health_note_rate(worker_id, job_id,
+                                       int(all_num_steps[0]),
+                                       float(all_execution_times[0]))
             self._cv.notify_all()
 
     @requires_lock
@@ -1190,6 +1639,7 @@ class PhysicalScheduler(Scheduler):
         for worker_id in worker_ids:
             self._dispatch_seq += 1
             self._dispatch_stamp[(job_id, worker_id)] = self._dispatch_seq
+        slow_dispatches = []
         for rank, worker_id in enumerate(worker_ids):
             descriptions = []
             for m in job_id.singletons():
@@ -1256,11 +1706,22 @@ class PhysicalScheduler(Scheduler):
                         except WORKER_RPC_ERRORS:
                             break  # host unreachable too; probe reaps it
                 return
+            dispatch_latency = max(self._obs.clock() - dispatch_start, 0.0)
             self._obs.observe(obs_names.DISPATCH_LATENCY_SECONDS,
-                              max(self._obs.clock() - dispatch_start, 0.0))
+                              dispatch_latency)
             self._obs.inc(obs_names.DISPATCHES_TOTAL, outcome="ok")
+            slow_dispatches.append((worker_id, dispatch_latency))
             if not next_round:
                 self._remove_available_worker(worker_id)
+        # Health feed AFTER the whole gang is dispatched: a degraded
+        # verdict mid-loop would quarantine the host, synthesize this
+        # job failed and prune it from the assignment maps while the
+        # loop keeps launching its remaining ranks — orphan processes
+        # no watchdog covers, racing the requeued copy. Fed here, a
+        # quarantine sees a fully-dispatched job and the standard
+        # victim-kill/requeue machinery handles it consistently.
+        for worker_id, dispatch_latency in slow_dispatches:
+            self._health_note_dispatch(worker_id, dispatch_latency)
 
     @requires_lock
     def _fail_dispatch_in_round(self, job_id: JobIdPair, worker_ids,

@@ -190,6 +190,23 @@ class SchedulerConfig:
     # defaults; the tier itself only exists once a serving job arrives,
     # so training-only traces never touch this path.
     serving: Optional[dict] = None
+    # ---- gray-failure resilience (physical mode; see README "Gray
+    # failures & chaos testing") ----
+    # Per-host health scoring + quarantine of degraded-but-alive
+    # workers (thermal throttling, flaky interconnect, slow disk): a
+    # worker that answers Ping while running at a fraction of its speed
+    # is classified healthy -> suspect -> degraded by an EWMA +
+    # hysteresis score over telemetry obs already collects, quarantined
+    # out of assignable capacity (journaled, so quarantine survives
+    # --resume), probed while out, and released on probation after a
+    # backoff. False disables scoring and quarantine entirely.
+    worker_health_enabled: bool = True
+    # runtime/resilience.HealthConfig field overrides (ewma_alpha,
+    # suspect_below, degraded_below, recover_above, min_samples,
+    # degraded_consecutive, recover_consecutive,
+    # dispatch_latency_ref_s, rate_ref_decay, quarantine_backoff_s,
+    # quarantine_backoff_max_s). None = the recorded defaults.
+    worker_health: Optional[dict] = None
 
 
 class Scheduler:
@@ -296,6 +313,11 @@ class Scheduler:
             or self._round_drain or self._round_drain_by_type
             or self._round_drain_by_sf)
         self._sim_round_start: Optional[float] = None
+        # Simulated gray failures: worker_id -> multiplicative speed
+        # factor, installed/cleared by `simulate(fault_events=...)`
+        # degrade/restore events. Empty on every canonical replay path
+        # (the fast-path guard keeps the float math untouched).
+        self._sim_degraded: Dict[int, float] = {}
         self._throughput_timeline: Dict[int, "collections.OrderedDict"] = {}
 
         # Cost / SLO / timeline observability.
@@ -503,6 +525,10 @@ class Scheduler:
         for f in self._SNAPSHOT_FIELDS:
             if f in state:
                 setattr(self, f, state[f])
+        if not hasattr(self.workers, "quarantined"):
+            # Snapshot written before the gray-failure layer existed:
+            # the pickled WorkerState lacks the field.
+            self.workers.quarantined = set()
         if self._serving_tier is not None:
             # The tier pickles without its scheduler reference.
             self._serving_tier.bind(self)
@@ -995,6 +1021,11 @@ class Scheduler:
             return
         for worker_id in ids:
             w.dead.discard(worker_id)
+            # Revived => assignable => by definition not quarantined
+            # (quarantine release and daemon re-registration both come
+            # through here; replay of `workers_revived` reproduces the
+            # same clearing, keeping recovery consistent).
+            w.quarantined.discard(worker_id)
             if worker_id not in w.worker_ids:
                 w.worker_ids.append(worker_id)
             w.cluster_spec[worker_type] = (
@@ -1005,6 +1036,14 @@ class Scheduler:
                    worker_type=worker_type)
         self.log.info("[Workers rejoined] chips %s restored to capacity "
                       "(%s)", ids, dict(w.cluster_spec))
+
+    def suspect_worker_ids(self) -> frozenset:
+        """Chips on hosts the gray-failure layer currently distrusts
+        (suspect or degraded) — consumers that can choose placement
+        (serving replica assignment) prefer other chips. The base
+        scheduler has no health layer, so simulation always returns the
+        empty set and replays stay bit-identical."""
+        return frozenset()
 
     # ------------------------------------------------------------------
     # Throughputs
@@ -2002,15 +2041,19 @@ class Scheduler:
         recording fall back to the live policy so a slower replay can
         finish its stragglers.
 
-        With `fault_events` (the Monte Carlo sweep's deterministic
-        chip-failure injection — the sim-side analog of
-        runtime/faults.py), each event dict is applied at the first
+        With `fault_events` (the Monte Carlo sweep's and the chaos
+        campaign's deterministic fault injection — the sim-side analog
+        of runtime/faults.py), each event dict is applied at the first
         round boundary at or after its ``at`` timestamp:
         ``{"at": t, "kill": [worker_ids]}`` retires chips from capacity
-        (deregister_workers) and ``{"at": t, "revive": [worker_ids],
-        "worker_type": wt}`` returns them. Events must be sorted by
-        ``at``. None (the default) leaves the canonical replay path
-        untouched.
+        (deregister_workers); ``{"at": t, "revive": [worker_ids],
+        "worker_type": wt}`` returns them; ``{"at": t, "degrade":
+        [worker_ids], "factor": f}`` makes those chips run every
+        micro-task at ``f`` of oracle speed (a gray failure: capacity
+        unchanged, throughput silently slashed — gangs run at the
+        slowest member's factor); ``{"at": t, "restore": [worker_ids]}``
+        returns them to full speed. Events must be sorted by ``at``.
+        None (the default) leaves the canonical replay path untouched.
         """
         if resume_from is not None:
             queued, running, remaining_jobs, current_round = (
@@ -2209,6 +2252,25 @@ class Scheduler:
                                         event["worker_type"])
                     self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
                                   action="revive")
+                if event.get("degrade"):
+                    factor = float(event.get("factor", 0.1))
+                    if not 0.0 < factor <= 1.0:
+                        raise ValueError(f"degrade factor must be in "
+                                         f"(0, 1], got {factor!r}")
+                    for w in event["degrade"]:
+                        self._sim_degraded[int(w)] = factor
+                    self.log.warning("[Fault] chips %s degraded to "
+                                     "%.2fx speed", list(event["degrade"]),
+                                     factor)
+                    self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
+                                  action="degrade")
+                if event.get("restore"):
+                    for w in event["restore"]:
+                        self._sim_degraded.pop(int(w), None)
+                    self.log.info("[Fault] chips %s restored to full "
+                                  "speed", list(event["restore"]))
+                    self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
+                                  action="restore")
 
             if not self.acct.jobs and not self._serving_live():
                 if not queued:
@@ -2265,8 +2327,16 @@ class Scheduler:
                     overhead = self._cold_dispatch_overhead(
                         worker_type, job_id) or 0.0
                     drain = self._cold_round_drain(worker_type, job_id)
+                rate_scale = 1.0
+                if self._sim_degraded:
+                    # Injected gray failure: the gang runs at its
+                    # slowest member's speed. Empty dict (every
+                    # canonical path) skips this entirely, so the
+                    # default float math is untouched.
+                    rate_scale = min(self._sim_degraded.get(w, 1.0)
+                                     for w in worker_ids)
                 all_num_steps, finish_time = self._steps_and_finish_time(
-                    job_id, worker_type, overhead)
+                    job_id, worker_type, overhead, rate_scale=rate_scale)
                 # Post-lease dead time shifts the cycle without eating
                 # the step budget (see _round_drain above). It is also
                 # excluded from execution-time accounting — shifting the
@@ -2376,27 +2446,39 @@ class Scheduler:
         return self._round_drain.get(worker_type, 0.0)
 
     def _steps_and_finish_time(self, job_id: JobIdPair, worker_type: str,
-                               overhead: float = 0.0):
+                               overhead: float = 0.0,
+                               rate_scale: float = 1.0):
         """Oracle-throughput step count and finish time for the next round.
 
         With `overhead` > 0 (calibrated cold-dispatch model), the first
         `overhead` seconds of the round are process startup: the step
         budget shrinks and a final partial round's completion is pushed
         back by the startup time — matching what the physical dispatcher
-        actually measures (spawn -> first step)."""
+        actually measures (spawn -> first step).
+
+        `rate_scale` < 1 is an injected gray failure (simulate()'s
+        degrade fault events): the oracle rate is multiplied before any
+        other math, so a degraded round produces proportionally fewer
+        steps in the same wall window. The default of exactly 1.0 skips
+        the multiply — canonical replays stay bit-identical."""
         now = self.get_current_timestamp()
         budget = max(self._time_per_iteration - overhead, 1.0)
         max_finish = now
         all_num_steps = []
         for m in job_id.singletons():
             tput = self._oracle_step_throughput(job_id, worker_type, m)
+            if rate_scale != 1.0:
+                tput *= rate_scale
             if tput <= 0:
                 raise RuntimeError(f"zero throughput for {m} on {worker_type}")
             num_steps = int(tput * budget)
-            if overhead > 0:
-                # Calibrated model only: at least one step per dispatch,
-                # else a near-round-sized overhead would zero the round
-                # and livelock. The default path stays reference-exact.
+            if overhead > 0 or rate_scale != 1.0:
+                # Calibrated / degraded model only: at least one step
+                # per dispatch, else a near-round-sized overhead (or a
+                # deep degrade) would zero the round — a zero-step
+                # completion is the micro-task FAILURE signal, and an
+                # injected slowdown must never charge the job a
+                # failure. The default path stays reference-exact.
                 num_steps = max(num_steps, 1)
             num_steps = min(num_steps, self._get_remaining_steps(m))
             all_num_steps.append(num_steps)

@@ -36,6 +36,16 @@ class WorkerState:
     # Last time each chip's daemon was heard from — stamped at
     # registration and piggybacked on every Done / UpdateLease RPC.
     last_seen: Dict[int, float] = field(default_factory=dict)
+    # Chips held out of capacity by the gray-failure layer: the daemon
+    # is ALIVE (it answers Ping and renews leases) but its host was
+    # classified degraded — thermal throttling, flaky interconnect,
+    # slow disk — so its chips must not anchor another round. Invariant:
+    # quarantined is a subset of dead (quarantine removes capacity
+    # through the same deregister path); the marker distinguishes
+    # "alive, probed, will be released on probation" from "presumed
+    # dead, revived only by rejoin/heal". revive_workers clears the
+    # marker for any id it readmits.
+    quarantined: Set[int] = field(default_factory=set)
 
 
 @dataclass

@@ -387,9 +387,17 @@ class ServingTier:
     def _assign_chips(self) -> "collections.OrderedDict[JobIdPair, Tuple[int, ...]]":
         """Reserve one chip per active replica, sticky where the
         previous chip is still alive and unclaimed. Deterministic order:
-        services by id, replicas by index."""
+        services by id, replicas by index.
+
+        Gray-failure awareness: chips on suspect/degraded hosts
+        (`sched.suspect_worker_ids()`) are placed LAST — a latency-SLO
+        replica pinned to a straggler misses its p99 every round — and
+        sticky reuse of a chip that turned suspect is abandoned. In
+        simulation the suspect set is always empty and placement is
+        unchanged."""
         sched = self._sched
         workers = sched.workers
+        suspect = sched.suspect_worker_ids()
         assignments: "collections.OrderedDict[JobIdPair, Tuple[int, ...]]" = (
             collections.OrderedDict())
         assigned: set = set()
@@ -400,15 +408,26 @@ class ServingTier:
             for wt in sorted(workers.type_to_server_ids)}
         reserved: Dict[str, int] = {}
 
-        def take_chip() -> Optional[int]:
+        def take_chip(allow_suspect: bool) -> Optional[int]:
             for wt in sorted(pools):
                 for server in pools[wt]:
-                    while server:
-                        w = server.pop(0)
-                        if w not in assigned:
-                            reserved[wt] = reserved.get(wt, 0) + 1
-                            return w
+                    for w in list(server):
+                        if w in assigned:
+                            server.remove(w)
+                            continue
+                        if not allow_suspect and w in suspect:
+                            continue  # keep for the fallback pass
+                        server.remove(w)
+                        reserved[wt] = reserved.get(wt, 0) + 1
+                        return w
             return None
+
+        def take_best_chip() -> Optional[int]:
+            chip = take_chip(allow_suspect=False)
+            if chip is None and suspect:
+                # Better a suspect chip than a starved replica.
+                chip = take_chip(allow_suspect=True)
+            return chip
 
         for svc in self._live_services():
             for job_id, _index in sorted(svc.replicas.items(),
@@ -418,12 +437,13 @@ class ServingTier:
                     continue
                 prev = sched.rounds.current_assignments.get(job_id)
                 if (prev and len(prev) == 1 and prev[0] not in assigned
-                        and prev[0] not in workers.dead):
+                        and prev[0] not in workers.dead
+                        and prev[0] not in suspect):
                     chip = prev[0]
                     wt = workers.id_to_type[chip]
                     reserved[wt] = reserved.get(wt, 0) + 1
                 else:
-                    chip = take_chip()
+                    chip = take_best_chip()
                     if chip is None:
                         logger.warning(
                             "[Serving] no chip available for replica %s "

@@ -10,6 +10,12 @@ imports only the runtime control plane.
 no-op (accepted, never executed, never reported) — the deterministic
 "worker wedged mid-round" hook, so tests never depend on racing a
 SIGKILL against the stub's execution sleep.
+
+Gray failures: ``degrade`` rules in $SWTPU_FAULTS (method "execute")
+scale the stub's simulated step rate per RunJob — the worker keeps
+answering Ping and renewing leases while computing at a fraction of its
+speed, which is exactly the straggler the scheduler's health layer must
+catch and quarantine.
 """
 import argparse
 import json
@@ -20,6 +26,7 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from shockwave_tpu.runtime import faults  # noqa: E402
 from shockwave_tpu.runtime.clients import (IteratorToSchedulerClient,  # noqa: E402
                                            WorkerToSchedulerClient)
 from shockwave_tpu.runtime.servers import serve_worker  # noqa: E402
@@ -53,8 +60,12 @@ def main():
                 it = IteratorToSchedulerClient(j["job_id"], worker_id,
                                                "localhost", args.sched_port)
                 max_steps, _, _ = it.init()
+            # Gray-failure hook: a degrade rule scales the simulated
+            # step rate — liveness (this RPC traffic) is untouched.
+            slowdown = faults.get_injector().slowdown("execute")
             time.sleep(args.exec_time)
-            steps = [min(int(args.throughput * box["round_duration"]),
+            steps = [min(int(args.throughput * slowdown
+                             * box["round_duration"]),
                          j["num_steps"], int(max_steps)) for j in jobs]
             client.notify_done([j["job_id"] for j in jobs], worker_id, steps,
                                [args.exec_time] * len(jobs))

@@ -1,0 +1,688 @@
+"""Gray-failure resilience: per-host health scoring, worker quarantine,
+degrade fault injection (physical + sim) and the chaos-campaign
+harness.
+
+The acceptance drive (`TestQuarantineLoopback`) runs the REAL round
+pipeline: two stub worker hosts, one silently degraded to 10% speed
+mid-run while still answering every Ping — the scheduler must
+quarantine it within a bounded number of rounds, finish every job on
+the survivor with exact step budgets and zero failure charges, and
+release the host on probation once it recovers.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from shockwave_tpu.core.job import Job, JobIdPair
+from shockwave_tpu.obs import names as obs_names
+from shockwave_tpu.runtime import faults
+from shockwave_tpu.runtime.resilience import (HEALTH_DEGRADED,
+                                              HEALTH_HEALTHY,
+                                              HEALTH_SUSPECT, HealthConfig,
+                                              HostHealth)
+from shockwave_tpu.sched.physical import PhysicalScheduler
+from shockwave_tpu.sched.scheduler import Scheduler, SchedulerConfig
+from shockwave_tpu.solver import get_policy
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.abspath(os.path.join(TESTS_DIR, ".."))
+DATA = os.path.join(REPO, "data")
+THROUGHPUTS = os.path.join(DATA, "tacc_throughputs.json")
+CHAOS = os.path.join(REPO, "scripts", "drivers", "chaos_campaign.py")
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _job(total_steps=600):
+    return Job(None, "ResNet-18 (batch size 32)",
+               "python3 main.py --batch_size 32",
+               "image_classification/cifar10", "--num_steps",
+               total_steps=total_steps, duration=10000)
+
+
+# ---------------------------------------------------------------------------
+# HostHealth classifier units (pure state machine)
+# ---------------------------------------------------------------------------
+
+class TestHostHealthClassifier:
+    CFG = HealthConfig(ewma_alpha=0.45, suspect_below=0.6,
+                       degraded_below=0.3, recover_above=0.8,
+                       min_samples=3, degraded_consecutive=2,
+                       recover_consecutive=2)
+
+    def test_healthy_stream_never_transitions(self):
+        h = HostHealth(self.CFG)
+        for _ in range(50):
+            assert h.observe(1.0) is None
+        assert h.state == HEALTH_HEALTHY
+        assert h.score == pytest.approx(1.0)
+
+    def test_ten_percent_straggler_degrades_within_bound(self):
+        """A worker at 10% speed must be classified degraded within a
+        handful of observations — the 'bounded number of rounds' in the
+        acceptance criterion."""
+        h = HostHealth(self.CFG)
+        h.observe(1.0)  # one healthy round before the gray failure
+        transitions = []
+        for i in range(8):
+            t = h.observe(0.1)
+            if t:
+                transitions.append((i, t))
+            if h.state == HEALTH_DEGRADED:
+                break
+        assert h.state == HEALTH_DEGRADED
+        assert transitions[-1][0] <= 5, transitions
+
+    def test_min_samples_guards_cold_hosts(self):
+        h = HostHealth(self.CFG)
+        assert h.observe(0.0) is None  # one anomalous first sample
+        assert h.state == HEALTH_HEALTHY
+
+    def test_one_slow_round_does_not_flap(self):
+        h = HostHealth(self.CFG)
+        for _ in range(10):
+            h.observe(1.0)
+        h.observe(0.3)  # single bad sample: EWMA dips to ~0.68
+        assert h.state == HEALTH_HEALTHY
+        for _ in range(3):
+            h.observe(1.0)
+        assert h.state == HEALTH_HEALTHY
+
+    def test_hysteresis_recovery_needs_consecutive_good_scores(self):
+        h = HostHealth(self.CFG)
+        for _ in range(6):
+            h.observe(0.1)
+        assert h.state == HEALTH_DEGRADED
+        h.observe(1.0)
+        assert h.state == HEALTH_DEGRADED  # score still climbing
+        transitions = [h.observe(1.0) for _ in range(6)]
+        assert h.state == HEALTH_HEALTHY
+        assert HEALTH_HEALTHY in transitions
+
+    def test_probation_restarts_as_suspect(self):
+        h = HostHealth(self.CFG)
+        for _ in range(6):
+            h.observe(0.05)
+        assert h.state == HEALTH_DEGRADED
+        h.reset_probation()
+        assert h.state == HEALTH_SUSPECT
+        # Still slow: re-degrades quickly (escalating quarantine).
+        for _ in range(3):
+            h.observe(0.05)
+        assert h.state == HEALTH_DEGRADED
+
+    def test_config_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown worker-health"):
+            HealthConfig.from_dict({"not_a_knob": 1})
+        assert HealthConfig.from_dict(None) == HealthConfig()
+        assert HealthConfig.from_dict(
+            {"ewma_alpha": 0.2}).ewma_alpha == 0.2
+
+
+# ---------------------------------------------------------------------------
+# Degrade fault action (runtime/faults.py)
+# ---------------------------------------------------------------------------
+
+class TestDegradeFaultAction:
+    def setup_method(self):
+        faults.get_injector().clear()
+
+    def teardown_method(self):
+        faults.get_injector().clear()
+
+    def test_slowdown_firing_window_and_recovery(self):
+        inj = faults.get_injector()
+        inj.install([{"method": "execute", "action": "degrade",
+                      "factor": 0.1, "after": 1, "times": 2}])
+        assert inj.slowdown("execute") == 1.0   # before the window
+        assert inj.slowdown("execute") == 0.1
+        assert inj.slowdown("execute") == 0.1
+        assert inj.slowdown("execute") == 1.0   # recovered
+        assert ("execute", "degrade") in inj.fired
+
+    def test_overlapping_rules_compound(self):
+        inj = faults.get_injector()
+        inj.install([
+            {"method": "execute", "action": "degrade", "factor": 0.5},
+            {"method": "*", "action": "degrade", "factor": 0.5},
+        ])
+        assert inj.slowdown("execute") == pytest.approx(0.25)
+
+    def test_degrade_rules_do_not_consume_rpc_hooks(self):
+        """fire()/should_freeze() must skip degrade rules without
+        advancing their window (and vice versa)."""
+        inj = faults.get_injector()
+        inj.install([{"method": "*", "action": "degrade", "factor": 0.5,
+                      "times": 1}])
+        inj.fire("Done")                      # rpc hook: no-op for degrade
+        assert not inj.should_freeze("dispatch")
+        assert inj.slowdown("dispatch") == 0.5  # window still intact
+
+    def test_factor_validation(self):
+        with pytest.raises(ValueError, match="factor"):
+            faults.FaultRule(method="x", action="degrade", factor=0.0)
+        with pytest.raises(ValueError, match="factor"):
+            faults.FaultRule(method="x", action="degrade", factor=1.5)
+
+
+# ---------------------------------------------------------------------------
+# Simulator degrade events
+# ---------------------------------------------------------------------------
+
+class TestSimDegradeEvents:
+    CLUSTER = {"v100": 4}
+
+    def _run(self, fault_events=None, n_jobs=6, seed=0):
+        from shockwave_tpu.core.oracle import read_throughputs
+        from shockwave_tpu.core.profiles import build_profiles
+        from shockwave_tpu.core.trace import parse_trace
+        jobs, arrivals = parse_trace(
+            os.path.join(DATA, "canonical_120job.trace"))
+        jobs, arrivals = jobs[:n_jobs], arrivals[:n_jobs]
+        profiles = build_profiles(
+            jobs, read_throughputs(THROUGHPUTS))
+        sched = Scheduler(
+            get_policy("max_min_fairness", seed=seed), simulate=True,
+            throughputs_file=THROUGHPUTS, profiles=profiles,
+            config=SchedulerConfig(time_per_iteration=120.0, seed=seed))
+        makespan = sched.simulate(dict(self.CLUSTER), arrivals, jobs,
+                                  fault_events=fault_events)
+        return makespan, sched
+
+    def test_degrade_stretches_makespan_and_restore_recovers(self):
+        baseline, _ = self._run()
+        events = [{"at": 0.0, "degrade": [0, 1, 2, 3], "factor": 0.1},
+                  {"at": 40000.0, "restore": [0, 1, 2, 3]}]
+        degraded, sched = self._run(fault_events=events)
+        assert degraded > baseline * 1.5, (baseline, degraded)
+        # Every job still completes with its full budget and no
+        # failure charges (a slowdown is not a failure).
+        assert sched.get_num_completed_jobs() == 6
+        assert all(v == 0 for v in sched.acct.failures.values())
+        counter = sched._obs.registry.value(
+            obs_names.SIM_FAULT_EVENTS_TOTAL, action="degrade")
+        assert counter == 1
+
+    def test_degrade_events_are_deterministic(self):
+        events = [{"at": 5000.0, "degrade": [1, 2], "factor": 0.25},
+                  {"at": 20000.0, "restore": [1, 2]},
+                  {"at": 9000.0, "kill": [3]},
+                  {"at": 26000.0, "revive": [3], "worker_type": "v100"}]
+        events.sort(key=lambda e: e["at"])
+        a, sa = self._run(fault_events=list(events))
+        b, sb = self._run(fault_events=list(events))
+        assert a == b
+        assert sa.acct.total_steps_run == sb.acct.total_steps_run
+        assert (sa.rounds.per_round_schedule
+                == sb.rounds.per_round_schedule)
+
+    def test_no_events_leaves_replay_untouched(self):
+        """fault_events=None and fault_events=[] must equal the
+        canonical path bit for bit."""
+        a, sa = self._run(fault_events=None)
+        b, sb = self._run(fault_events=[])
+        assert a == b
+        assert sa.rounds.per_round_schedule == sb.rounds.per_round_schedule
+
+    def test_bad_factor_raises(self):
+        with pytest.raises(ValueError, match="factor"):
+            self._run(fault_events=[
+                {"at": 0.0, "degrade": [0], "factor": 0.0}])
+
+
+# ---------------------------------------------------------------------------
+# Quarantine acceptance loopback (real round pipeline, stub daemons)
+# ---------------------------------------------------------------------------
+
+class _StubHost:
+    """One stub worker HOST (own port => own liveness/health identity)
+    with a mutable throughput — the gray-failure dial."""
+
+    def __init__(self, sched_port, num_chips=1, throughput=100.0,
+                 execution_time=0.2):
+        from shockwave_tpu.runtime.clients import (
+            IteratorToSchedulerClient, WorkerToSchedulerClient)
+        from shockwave_tpu.runtime.servers import serve_worker
+        self.throughput = throughput
+        self.execution_time = execution_time
+        self.sched_port = sched_port
+        self._iter_client = IteratorToSchedulerClient
+        self._client = WorkerToSchedulerClient("localhost", sched_port)
+        self.port = free_port()
+        self.server = serve_worker(self.port, {
+            "RunJob": self._run_job, "KillJob": lambda j: None,
+            "Reset": lambda: None, "Shutdown": lambda: None,
+        })
+        self.worker_ids, self.round_duration = self._client.register_worker(
+            "v5e", "127.0.0.1", self.port, num_chips)
+
+    def _run_job(self, jobs, worker_id, round_id):
+        def execute():
+            max_steps = 10**9
+            for j in jobs:
+                it = self._iter_client(j["job_id"], worker_id,
+                                       "localhost", self.sched_port)
+                max_steps, _, _ = it.init()
+            time.sleep(self.execution_time)
+            # Read the dial at completion time: a degraded host reports
+            # proportionally fewer steps over the same wall time.
+            steps = [min(int(self.throughput * self.round_duration),
+                         j["num_steps"], int(max_steps)) for j in jobs]
+            self._client.notify_done([j["job_id"] for j in jobs],
+                                     worker_id, steps,
+                                     [self.execution_time] * len(jobs))
+        threading.Thread(target=execute, daemon=True).start()
+
+    def stop(self):
+        self.server.stop(grace=0)
+
+
+@pytest.mark.runtime
+@pytest.mark.faults
+@pytest.mark.timeout(120)
+class TestQuarantineLoopback:
+    """Acceptance: one of two hosts silently drops to 10% speed
+    mid-run while answering every Ping. The scheduler must quarantine
+    it within a bounded number of rounds, complete every job with
+    exact step budgets and zero failure charges, and auto-release the
+    host on probation once it recovers."""
+
+    def test_degraded_host_quarantined_then_released(self):
+        sched_port = free_port()
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"), throughputs_file=THROUGHPUTS,
+            config=SchedulerConfig(
+                time_per_iteration=2.0, heartbeat_interval_s=0.2,
+                worker_timeout_s=3.0, worker_probe_failures=3,
+                first_init_grace_s=0.0,
+                worker_health={"quarantine_backoff_s": 3.0}),
+            expected_num_workers=2, port=sched_port)
+        host_a = _StubHost(sched_port, throughput=100.0)
+        host_b = _StubHost(sched_port, throughput=100.0)
+        b_ids = set(host_b.worker_ids)
+        try:
+            for _ in range(4):
+                sched.add_job(_job(600))
+            runner = threading.Thread(target=sched.run, daemon=True)
+            runner.start()
+
+            # Let at least one healthy round complete, then the gray
+            # failure: B computes at 10% while its RPCs stay healthy.
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                with sched._lock:
+                    if sched.rounds.num_completed_rounds >= 1:
+                        break
+                time.sleep(0.1)
+            host_b.throughput = 10.0
+            degraded_at_round = sched.rounds.num_completed_rounds
+
+            # The scheduler must quarantine B within a bounded number
+            # of rounds (classifier: ~4 bad micro-tasks).
+            deadline = time.time() + 40
+            while time.time() < deadline:
+                with sched._lock:
+                    if b_ids <= sched.workers.quarantined:
+                        break
+                time.sleep(0.1)
+            with sched._lock:
+                assert b_ids <= sched.workers.quarantined, (
+                    "degraded host was never quarantined")
+                quarantined_at_round = sched.rounds.num_completed_rounds
+                # Quarantined = out of assignable capacity, not dead-dead.
+                assert sched.workers.cluster_spec == {"v5e": 1}
+                assert b_ids <= sched.workers.dead
+                assert b_ids <= sched.suspect_worker_ids()
+            assert quarantined_at_round - degraded_at_round <= 10, (
+                f"quarantine took {quarantined_at_round} - "
+                f"{degraded_at_round} rounds")
+
+            # The host recovers (thermal event over); after the 3 s
+            # backoff the next successful probe releases it on
+            # probation.
+            host_b.throughput = 100.0
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                with sched._lock:
+                    if not sched.workers.quarantined:
+                        break
+                time.sleep(0.1)
+            with sched._lock:
+                assert not sched.workers.quarantined, (
+                    "recovered host was never released from quarantine")
+                assert sched.workers.cluster_spec == {"v5e": 2}
+
+            # Every job drains with its exact budget and no failure
+            # charges — the straggler cost rounds, never correctness.
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if len(sched._completed_jobs) == 4:
+                    break
+                time.sleep(0.2)
+            assert len(sched._completed_jobs) == 4, (
+                f"only {sched._completed_jobs} completed")
+            for i in range(4):
+                assert sched.acct.total_steps_run[JobIdPair(i)] == 600
+                assert sched.acct.failures.get(JobIdPair(i), 0) == 0
+
+            reg = sched._obs.registry
+            assert reg.value(obs_names.QUARANTINE_EVENTS_TOTAL,
+                             action="quarantine") >= 1
+            assert reg.value(obs_names.QUARANTINE_EVENTS_TOTAL,
+                             action="release") >= 1
+            assert reg.value(obs_names.WORKER_HEALTH_TRANSITIONS_TOTAL,
+                             to="degraded") >= 1
+        finally:
+            sched._done_event.set()
+            host_a.stop()
+            host_b.stop()
+            sched._server.stop(grace=0)
+
+    def test_health_disabled_never_quarantines(self):
+        sched_port = free_port()
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"), throughputs_file=THROUGHPUTS,
+            config=SchedulerConfig(
+                time_per_iteration=2.0, heartbeat_interval_s=0.2,
+                worker_timeout_s=3.0, first_init_grace_s=0.0,
+                worker_health_enabled=False),
+            expected_num_workers=1, port=sched_port)
+        host = _StubHost(sched_port, throughput=10.0)  # slow from birth
+        try:
+            sched.add_job(_job(100))
+            runner = threading.Thread(target=sched.run, daemon=True)
+            runner.start()
+            deadline = time.time() + 40
+            while time.time() < deadline:
+                if len(sched._completed_jobs) == 1:
+                    break
+                time.sleep(0.2)
+            assert len(sched._completed_jobs) == 1
+            assert not sched.workers.quarantined
+            assert sched.suspect_worker_ids() == frozenset()
+        finally:
+            sched._done_event.set()
+            host.stop()
+            sched._server.stop(grace=0)
+
+
+# ---------------------------------------------------------------------------
+# Stale per-host gauge labels (satellite): retired/quarantined hosts
+# must drop their series from /metrics, not report the last value forever
+# ---------------------------------------------------------------------------
+
+class TestStaleHostGauges:
+    def _sched_with_host(self):
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"), throughputs_file=THROUGHPUTS,
+            config=SchedulerConfig(time_per_iteration=2.0,
+                                   heartbeat_interval_s=0.0),
+            port=free_port())
+        ids, _ = sched._register_worker_rpc("v5e", 1, "127.0.0.1",
+                                            free_port())
+        key = next(iter(sched._worker_hosts))
+        host_label = f"{key[0]}:{key[1]}"
+        # Simulate one liveness-monitor pass having exported the
+        # per-host gauges.
+        sched._obs.set_gauge(obs_names.WORKER_HEARTBEAT_AGE_SECONDS,
+                             1.5, host=host_label)
+        sched._set_breaker_gauge(key, sched._worker_hosts[key])
+        sched._obs.set_gauge(obs_names.WORKER_HEALTH_SCORE, 0.9,
+                             host=host_label)
+        return sched, key, host_label
+
+    def test_retired_host_series_dropped(self):
+        sched, key, host_label = self._sched_with_host()
+        try:
+            text = sched._obs.registry.render_prometheus()
+            assert host_label in text
+            with sched._cv:
+                sched._retire_worker_host(key)
+            text = sched._obs.registry.render_prometheus()
+            for name in ("swtpu_worker_heartbeat_age_seconds",
+                         "swtpu_worker_breaker_state",
+                         "swtpu_worker_health_score"):
+                assert not any(name in line and host_label in line
+                               for line in text.splitlines()), (
+                    f"{name} still exposed for retired host:\n{text}")
+        finally:
+            sched.shutdown()
+
+    def test_quarantined_host_drops_liveness_but_keeps_health(self):
+        sched, key, host_label = self._sched_with_host()
+        try:
+            with sched._cv:
+                sched._quarantine_worker_host(key)
+            text = sched._obs.registry.render_prometheus()
+            lines = text.splitlines()
+            for name in ("swtpu_worker_heartbeat_age_seconds",
+                         "swtpu_worker_breaker_state"):
+                assert not any(name in line and host_label in line
+                               for line in lines), (
+                    f"{name} still exposed for quarantined host")
+            # The health score IS the quarantined host's recovery
+            # signal: it must stay exposed.
+            assert any("swtpu_worker_health_score" in line
+                       and host_label in line for line in lines)
+            assert sched._obs.registry.value(
+                obs_names.QUARANTINED_CHIPS) == 1
+        finally:
+            sched.shutdown()
+
+    def test_dead_in_quarantine_drops_health_series_too(self):
+        """A quarantined host that stops answering probes converts to a
+        plain retirement — its health-score series (kept live during
+        quarantine) must be dropped with it, and the retirement
+        counted."""
+        sched, key, host_label = self._sched_with_host()
+        try:
+            with sched._cv:
+                sched._quarantine_worker_host(key)
+            retirements = sched._obs.registry.value(
+                obs_names.WORKER_RETIREMENTS_TOTAL)
+            with sched._cv:
+                sched._clear_quarantine_marker(key, reason="dead")
+            text = sched._obs.registry.render_prometheus()
+            assert not any("swtpu_worker_health_score" in line
+                           and host_label in line
+                           for line in text.splitlines())
+            assert sched._obs.registry.value(
+                obs_names.WORKER_RETIREMENTS_TOTAL) == retirements + 1
+            assert not sched.workers.quarantined
+            assert sched._obs.registry.value(
+                obs_names.QUARANTINED_CHIPS) == 0
+        finally:
+            sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Serving replica placement skips suspect chips
+# ---------------------------------------------------------------------------
+
+class TestServingSkipsSuspectChips:
+    def _mixed_sched(self, suspect_ids):
+        """Simulation scheduler with a serving service and a patched
+        suspect set (simulating what the physical health layer would
+        report)."""
+        from shockwave_tpu.core import trace as trace_mod
+        sched = Scheduler(
+            get_policy("max_min_fairness"), simulate=True,
+            throughputs_file=THROUGHPUTS,
+            config=SchedulerConfig(time_per_iteration=120.0))
+        for _ in range(4):
+            sched.register_worker("v100", 1)
+        job = trace_mod.make_serving_job(
+            base_rps=5.0, peak_rps=5.0, period_s=86400.0,
+            lifetime_s=40000.0, slo_p99_s=2.0)
+        sched.add_job(job, timestamp=0.0)
+        sched.suspect_worker_ids = lambda: frozenset(suspect_ids)
+        return sched
+
+    def test_replicas_avoid_suspect_chips(self):
+        sched = self._mixed_sched({0, 1})
+        assignments = sched._serving_tier.plan_round()
+        used = {w for ids in assignments.values() for w in ids}
+        assert used, "no replicas placed"
+        assert not used & {0, 1}, (
+            f"replicas placed on suspect chips: {used}")
+
+    def test_suspect_chips_used_as_last_resort(self):
+        sched = self._mixed_sched({0, 1, 2, 3})  # everything suspect
+        assignments = sched._serving_tier.plan_round()
+        used = {w for ids in assignments.values() for w in ids}
+        assert used, "replica starved even though (suspect) chips exist"
+
+    def test_empty_suspect_set_is_default_placement(self):
+        a = self._mixed_sched(set())._serving_tier.plan_round()
+        b = self._mixed_sched(set())._serving_tier.plan_round()
+        assert a == b
+
+
+# ---------------------------------------------------------------------------
+# Sweep degrade knobs (satellite): seeded gray-failure events in the
+# Monte Carlo sweep's scenario draw
+# ---------------------------------------------------------------------------
+
+class TestSweepDegradeKnobs:
+    def _draw(self, seed=3, degrade_rate=2.0):
+        import importlib.util
+        import numpy as np
+        drivers_dir = os.path.join(REPO, "scripts", "drivers")
+        sys.path.insert(0, drivers_dir)  # driver_common sibling import
+        try:
+            spec = importlib.util.spec_from_file_location(
+                "sweep_scenarios",
+                os.path.join(drivers_dir, "sweep_scenarios.py"))
+            sweep = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(sweep)
+        finally:
+            sys.path.remove(drivers_dir)
+        from shockwave_tpu.core.trace import parse_trace
+        jobs, arrivals = parse_trace(
+            os.path.join(DATA, "canonical_120job.trace"))
+        knobs = {"subsample": (0.1, 0.2), "fault_rate": 1.0,
+                 "fault_max_chips": 2, "fault_down_s": 3600.0,
+                 "fault_window_s": 20000.0,
+                 "degrade_rate": degrade_rate,
+                 "degrade_factor": (0.05, 0.5),
+                 "degrade_down_s": 3600.0}
+        rng = np.random.RandomState(seed)
+        return sweep.draw_scenario(rng, jobs, arrivals, knobs,
+                                   {"v100": 32})
+
+    def test_degrade_events_drawn_and_deterministic(self):
+        _, _, events_a, params_a = self._draw()
+        _, _, events_b, params_b = self._draw()
+        assert events_a == events_b and params_a == params_b
+        degrades = [e for e in events_a if "degrade" in e]
+        restores = [e for e in events_a if "restore" in e]
+        assert len(degrades) == params_a["degrade_events"] > 0
+        assert len(degrades) == len(restores)
+        for e in degrades:
+            assert 0.05 <= e["factor"] <= 0.5
+        assert events_a == sorted(events_a, key=lambda e: e["at"])
+
+    def test_degrade_rate_zero_reproduces_historical_draws(self):
+        """degrade_rate=0 must leave the pre-existing seeded scenario
+        content untouched (old sweep configs stay byte-reproducible)."""
+        jobs_a, arr_a, ev_a, params_a = self._draw(degrade_rate=0.0)
+        assert "degrade_events" not in params_a
+        assert not any("degrade" in e for e in ev_a)
+        assert params_a.get("fault_events") is not None
+
+
+# ---------------------------------------------------------------------------
+# Chaos campaign harness
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(300)
+class TestChaosCampaign:
+    def _run(self, out, extra=(), timeout=240):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        return subprocess.run(
+            [sys.executable, CHAOS,
+             "--trace", os.path.join(DATA, "canonical_120job.trace"),
+             "--policy", "max_min_fairness",
+             "--throughputs", THROUGHPUTS,
+             "--cluster_spec", "v100:8", "--round_duration", "120",
+             "--out", out, *extra],
+            capture_output=True, text=True, env=env, timeout=timeout)
+
+    def test_sim_campaign_passes_and_is_byte_reproducible(self, tmp_path):
+        out_a = str(tmp_path / "a.json")
+        out_b = str(tmp_path / "b.json")
+        ra = self._run(out_a, ["--num_schedules", "4"])
+        assert ra.returncode == 0, ra.stdout + ra.stderr
+        rb = self._run(out_b, ["--num_schedules", "4"])
+        assert rb.returncode == 0, rb.stdout + rb.stderr
+        with open(out_a, "rb") as fa, open(out_b, "rb") as fb:
+            assert fa.read() == fb.read(), "artifact not byte-reproducible"
+        with open(out_a) as f:
+            doc = json.load(f)
+        assert doc["summary"]["schedules"] == 4
+        assert doc["summary"]["passed"] == 4
+        assert doc["summary"]["violations"] == []
+        faults_drawn = sum(v["plan"]["kills"] + v["plan"]["degrades"]
+                           for v in doc["sim"].values())
+        assert faults_drawn > 0, "campaign drew no faults at all"
+
+    def test_resume_skips_completed_and_meta_mismatch_refuses(
+            self, tmp_path):
+        out = str(tmp_path / "c.json")
+        r1 = self._run(out, ["--num_schedules", "2"])
+        assert r1.returncode == 0, r1.stdout + r1.stderr
+        with open(out) as f:
+            two = json.load(f)
+        # Resume to 3: seeds 0-1 skipped (byte-identical records), 2 new.
+        r2 = self._run(out, ["--num_schedules", "3"])
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+        with open(out) as f:
+            three = json.load(f)
+        assert {k: three["sim"][k] for k in two["sim"]} == two["sim"]
+        assert len(three["sim"]) == 3
+        # Different knobs, same artifact: refuse without --restart.
+        r3 = self._run(out, ["--num_schedules", "3",
+                             "--kill_rate", "9.0"])
+        assert r3.returncode != 0
+        assert "restart" in (r3.stdout + r3.stderr)
+
+    def test_committed_study_is_clean(self):
+        """The committed >=25-schedule chaos study must exist and pass
+        every invariant (acceptance criterion)."""
+        path = os.path.join(REPO, "reproduce", "chaos",
+                            "chaos_campaign_40.json")
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["summary"]["schedules"] >= 25
+        assert doc["summary"]["violations"] == []
+        assert doc["summary"]["passed"] == doc["summary"]["schedules"]
+        for record in doc["sim"].values():
+            assert all(record["invariants"].values()), record
+
+    @pytest.mark.slow
+    def test_physical_loopback_schedule(self, tmp_path):
+        """One real-control-plane chaos schedule end to end (the CI
+        chaos-smoke runs this same path)."""
+        out = str(tmp_path / "p.json")
+        r = self._run(out, ["--num_schedules", "0",
+                            "--physical_schedules", "1",
+                            "--workdir", str(tmp_path / "work")],
+                      timeout=280)
+        assert r.returncode == 0, r.stdout + r.stderr
+        with open(out) as f:
+            doc = json.load(f)
+        rec = doc["physical"]["0"]
+        assert rec["violations"] == []
+        assert all(rec["invariants"].values())

@@ -165,6 +165,93 @@ class TestPhysicalRestoreAndRequeue:
         finally:
             b.shutdown()
 
+    def test_quarantine_survives_resume_with_exact_capacity(
+            self, tmp_path):
+        """Satellite (gray-failure resilience): quarantine/unquarantine
+        are journaled, so a scheduler killed with a worker quarantined
+        restores the quarantine AND its capacity accounting exactly on
+        --resume. (Every journal append is fsync'd at emit time, so the
+        durable state at shutdown() is byte-identical to a SIGKILL's —
+        the subprocess SIGKILL variant of this path is the chaos
+        campaign's physical mode.)"""
+        d = tmp_path / "state"
+        a = _make_physical(d)
+        try:
+            ids_a, _ = a._register_worker_rpc("v5e", 1, "127.0.0.1",
+                                              free_port())
+            ids_b, _ = a._register_worker_rpc("v5e", 1, "127.0.0.1",
+                                              free_port())
+            a.add_job(_job(300))
+            key_b = next(k for k, h in a._worker_hosts.items()
+                         if set(h["worker_ids"]) == set(ids_b))
+            with a._cv:
+                a._quarantine_worker_host(key_b)
+            assert set(a.workers.quarantined) == set(ids_b)
+            assert a.workers.cluster_spec == {"v5e": 1}
+        finally:
+            a.shutdown()
+
+        b = _make_physical(d, resume=True)
+        try:
+            # Quarantine state and capacity accounting restored exactly.
+            assert set(b.workers.quarantined) == set(ids_b)
+            assert b.workers.cluster_spec == {"v5e": 1}
+            assert set(ids_b) <= b.workers.dead
+            assert set(ids_a) & b.workers.dead == set()
+            # Host-level lifecycle rebuilt: release clock restarted
+            # conservatively, health pinned degraded, serving avoids it.
+            host_b = b._worker_hosts[key_b]
+            assert "quarantined_at" in host_b
+            assert set(ids_b) <= b.suspect_worker_ids()
+            # Probed release restores capacity (backoff forced elapsed),
+            # and is journaled too.
+            with b._cv:
+                host_b["quarantined_at"] -= 10_000.0
+                b._maybe_release_quarantine(key_b)
+            assert not b.workers.quarantined
+            assert b.workers.cluster_spec == {"v5e": 2}
+        finally:
+            b.shutdown()
+
+        # Third incarnation: the RELEASE also survives a restart.
+        c = _make_physical(d, resume=True)
+        try:
+            assert not c.workers.quarantined
+            assert c.workers.cluster_spec == {"v5e": 2}
+        finally:
+            c.shutdown()
+
+    def test_quarantine_restores_from_compacted_snapshot(self, tmp_path):
+        """Quarantine state must survive journal compaction: once the
+        quarantine events are folded into a snapshot, the marker comes
+        back from WorkerState.quarantined alone."""
+        d = tmp_path / "state"
+        a = _make_physical(d)
+        try:
+            a._register_worker_rpc("v5e", 1, "127.0.0.1", free_port())
+            ids_b, _ = a._register_worker_rpc("v5e", 1, "127.0.0.1",
+                                              free_port())
+            key_b = next(k for k, h in a._worker_hosts.items()
+                         if set(h["worker_ids"]) == set(ids_b))
+            with a._cv:
+                a._quarantine_worker_host(key_b)
+                # Force a compacting snapshot AFTER the quarantine so
+                # its journal events are behind the snapshot horizon.
+                a.rounds.num_completed_rounds = 2
+                a._emit("round_ended", round=2)
+                a._maybe_snapshot()
+        finally:
+            a.shutdown()
+
+        b = _make_physical(d, resume=True)
+        try:
+            assert set(b.workers.quarantined) == set(ids_b)
+            assert b.workers.cluster_spec == {"v5e": 1}
+            assert "quarantined_at" in b._worker_hosts[key_b]
+            assert set(ids_b) <= b.suspect_worker_ids()
+        finally:
+            b.shutdown()
+
     def test_fresh_start_refuses_nonempty_state_dir(self, tmp_path):
         d = tmp_path / "state"
         a = _make_physical(d)

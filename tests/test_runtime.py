@@ -135,6 +135,59 @@ class TestLeaseIterator:
         finally:
             server.stop(grace=0)
 
+    def test_degrade_factor_throttles_step_rate(self, tmp_path,
+                                                monkeypatch):
+        """SWTPU_DEGRADE_FACTOR (exported by the dispatcher when a
+        `degrade` fault covers the dispatch) must genuinely slow the
+        job: each step is padded to compute/factor while leases keep
+        renewing — the gray failure made real for actual trainers."""
+        port = free_port()
+        server = serve_scheduler(port, {
+            "RegisterWorker": lambda **kw: ([0], 60.0),
+            "Done": lambda *a: None,
+            "InitJob": lambda job_id: (1000, 1e6, 0.0),
+            "UpdateLease": lambda *a: (1000, 1e6, 0.0, 1e9),
+            "UpdateResourceRequirement": lambda *a: None,
+        })
+        monkeypatch.setenv("SWTPU_JOB_ID", "0")
+        monkeypatch.setenv("SWTPU_WORKER_ID", "0")
+        monkeypatch.setenv("SWTPU_ROUND_ID", "0")
+        monkeypatch.setenv("SWTPU_SCHED_ADDR", "localhost")
+        monkeypatch.setenv("SWTPU_SCHED_PORT", str(port))
+        try:
+            from shockwave_tpu.runtime.iterator import LeaseIterator
+
+            def run_steps(factor, n=12, step_time=0.01):
+                if factor is None:
+                    monkeypatch.delenv("SWTPU_DEGRADE_FACTOR",
+                                       raising=False)
+                else:
+                    monkeypatch.setenv("SWTPU_DEGRADE_FACTOR",
+                                       str(factor))
+                it = LeaseIterator(
+                    data_loader=list(range(1000)),
+                    checkpoint_dir=str(tmp_path),
+                    load_checkpoint_func=lambda p: None,
+                    save_checkpoint_func=lambda p, s: None,
+                    synthetic_data=False, write_on_close=False)
+                iter(it)
+                t0 = time.time()
+                for _ in range(n):
+                    next(it)
+                    time.sleep(step_time)  # the "compute"
+                return time.time() - t0
+
+            full = run_steps(None)
+            slow = run_steps(0.25)
+            # At factor 0.25 each step is padded ~4x; allow generous
+            # slack for timer noise but require a clear slowdown.
+            assert slow > 2.0 * full, (full, slow)
+            # Garbage values fall back to full speed, not a crash.
+            garbage = run_steps("not-a-number")
+            assert garbage < 2.0 * full, (full, garbage)
+        finally:
+            server.stop(grace=0)
+
 
     def test_async_runahead_bounded_and_renewal_timely(self, tmp_path,
                                                        monkeypatch):
@@ -1081,6 +1134,7 @@ class TestInflightTimeAccounting:
 
 import collections
 import json
+import random
 import signal
 import subprocess
 import sys
@@ -1088,6 +1142,7 @@ import sys
 import grpc
 
 from shockwave_tpu.runtime import faults
+from shockwave_tpu.runtime import resilience
 from shockwave_tpu.runtime.clients import SchedulerToWorkerClient as _S2W
 from shockwave_tpu.runtime.resilience import (CircuitBreaker,
                                               CircuitOpenError, RetryPolicy,
@@ -1129,11 +1184,41 @@ class TestResilienceLayer:
             flaky, None, method="t",
             policy=RetryPolicy(deadline_s=1.0, total_budget_s=100.0,
                                max_attempts=5),
-            sleep=sleeps.append)
+            sleep=sleeps.append, rng=random.Random(7))
         assert out == "ok"
         assert len(calls) == 3
-        assert sleeps == [0.25, 0.5]  # deterministic exponential backoff
+        # Full jitter: each sleep is uniform in (0, bounded-exponential]
+        # — bounded above by the deterministic schedule, floored at 1%
+        # of it so retries never fire same-instant.
+        bounds = [0.25, 0.5]
+        assert len(sleeps) == 2
+        for got, bound in zip(sleeps, bounds):
+            assert 0.01 * bound <= got <= bound
         assert all(t is not None and t <= 1.0 for t in calls)  # deadlines
+
+    def test_backoff_jitter_is_seed_deterministic(self):
+        """Satellite: jittered backoff must be reproducible under a
+        seeded RNG (chaos drills assert retry timing), and the ceiling
+        must match the legacy deterministic schedule."""
+        policy = RetryPolicy(deadline_s=1.0, total_budget_s=100.0,
+                            max_attempts=6)
+
+        def draws(seed):
+            rng = random.Random(seed)
+            return [policy.backoff(a, rng) for a in range(5)]
+
+        assert draws(42) == draws(42)  # same seed, same schedule
+        assert draws(42) != draws(43)  # jitter is real
+        for attempt, value in enumerate(draws(42)):
+            bound = policy.backoff_bound(attempt)
+            assert 0.01 * bound <= value <= bound
+        # No RNG: the deterministic ceiling (legacy exact-bound tests).
+        assert [policy.backoff(a) for a in range(3)] == [0.25, 0.5, 1.0]
+        # Process-wide RNG is seedable for end-to-end drills.
+        resilience.seed_backoff_jitter(5)
+        a = policy.backoff(2, resilience._jitter_rng)
+        resilience.seed_backoff_jitter(5)
+        assert policy.backoff(2, resilience._jitter_rng) == a
 
     def test_budget_exhaustion_raises_unavailable(self):
         def dead(request, timeout=None):
