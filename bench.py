@@ -121,6 +121,30 @@ def sweep_phase():
                 "sweep_scenarios_per_min": sweep["scenarios_per_min"]}
 
 
+def whatif_phase():
+    """What-if control-plane overhead: forks/min + rollouts/min on a
+    mid-run canonical scheduler (scripts/microbenchmarks/
+    bench_whatif.py) — the trajectory row that keeps the digital-twin
+    plane's cost visible beside sim_core_wall_s / milp_wall_s."""
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts/microbenchmarks/bench_whatif.py"),
+             "--forks", "20", "--rollouts", "10"],
+            capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        return {"whatif_error": "bench_whatif timeout"}
+    if out.returncode != 0:
+        return {"whatif_error": out.stderr[-300:]}
+    try:
+        row = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001
+        return {"whatif_error": out.stdout[-300:]}
+    return {"whatif_forks_per_min": row["forks_per_min"],
+            "whatif_rollouts_per_min": row["rollouts_per_min"],
+            "whatif_mean_capture_s": row["mean_capture_s"]}
+
+
 def main():
     sim_start = time.monotonic()
     out = subprocess.run(
@@ -157,6 +181,7 @@ def main():
         "milp_wall_s": result.get("milp_wall_s"),
     }
     line.update(sweep_phase())
+    line.update(whatif_phase())
     line.update(tpu_phase())
     print(json.dumps(line))
 

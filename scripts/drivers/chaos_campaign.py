@@ -101,6 +101,8 @@ SIM_INVARIANTS = ("all_jobs_completed", "steps_accounted",
                   "zero_failure_charges")
 PHYS_INVARIANTS = SIM_INVARIANTS + ("journal_fsck_clean",
                                     "sanitizer_clean", "no_stuck_leases")
+TWIN_INVARIANTS = ("twin_all_jobs_completed", "twin_steps_accounted",
+                   "twin_zero_failure_charges", "live_untouched")
 
 
 chip_layout = driver_common.chip_layout
@@ -163,15 +165,17 @@ def run_sim_schedule(seed, cfg):
     jobs, arrivals, events, plan = draw_sim_schedule(
         rng, jobs, arrivals, cluster_spec, cfg["knobs"])
     profiles = build_profiles(jobs, cfg["throughput_table"])
-    shockwave_config, serving_config = driver_common.load_configs(
-        cfg["config"], cfg["policy"], cluster_spec, cfg["round_duration"])
+    shockwave_config, serving_config, whatif_config = (
+        driver_common.load_configs(cfg["config"], cfg["policy"],
+                                   cluster_spec, cfg["round_duration"]))
 
     def build():
         return driver_common.build_scheduler(
             cfg["policy"], cfg["throughputs"], profiles,
             round_duration=cfg["round_duration"], seed=seed,
             shockwave_config=shockwave_config,
-            serving_config=serving_config)
+            serving_config=serving_config,
+            whatif_config=whatif_config)
 
     violations = []
     try:
@@ -256,6 +260,128 @@ def run_sim_schedule(seed, cfg):
                         "failed_microtasks_with_faults":
                             round(failed_microtasks, 1),
                         "deadline_dropped": deadline_dropped}}
+
+
+# ----------------------------------------------------------------------
+# Digital-twin shadow schedules (whatif/fork.py)
+# ----------------------------------------------------------------------
+
+def run_twin_schedule(seed, cfg):
+    """One twin shadow schedule: run a subsampled trace FAULT-FREE with
+    the what-if plane capturing a mid-run fork, then re-target this
+    campaign's seeded fault mix at the DIGITAL TWIN — the same
+    invariants, validated continuously against a fork instead of the
+    live scheduler. Also asserts the live run was untouched by the
+    forking (the twin-isolation contract)."""
+    import pickle
+
+    from shockwave_tpu.obs import names as obs_names
+    from shockwave_tpu.sched.scheduler import DEADLINE_SLACK
+    from shockwave_tpu.whatif import fork as whatif_fork
+
+    rng = np.random.RandomState(seed)
+    jobs, arrivals = parse_trace(cfg["trace"])
+    cluster_spec = parse_cluster_spec(cfg["cluster_spec"])
+    jobs, arrivals, events, plan = draw_sim_schedule(
+        rng, jobs, arrivals, cluster_spec, cfg["knobs"])
+    capture_round = int(rng.randint(3, 12))
+    plan["capture_round"] = capture_round
+    profiles = build_profiles(jobs, cfg["throughput_table"])
+    shockwave_config, serving_config, _ = (
+        driver_common.load_configs(cfg["config"], cfg["policy"],
+                                   cluster_spec, cfg["round_duration"]))
+    sched = driver_common.build_scheduler(
+        cfg["policy"], cfg["throughputs"], profiles,
+        round_duration=cfg["round_duration"], seed=seed,
+        shockwave_config=shockwave_config,
+        serving_config=serving_config,
+        whatif_config={"capture_at_round": capture_round})
+
+    violations = []
+    inv = {k: False for k in TWIN_INVARIANTS}
+    try:
+        sched.simulate(cluster_spec, arrivals, jobs, fault_events=[])
+        if sched._whatif.captured is None:
+            # The subsampled schedule drained before the capture round;
+            # nothing to shadow-validate — record a vacuous pass.
+            return {"seed": seed, "plan": plan,
+                    "invariants": {k: True for k in TWIN_INVARIANTS},
+                    "violations": [],
+                    "summary": {"captured": False}}
+        live_before = pickle.dumps(sched.snapshot_state())
+        blob, queued, remaining = sched._whatif.captured
+
+        # Fault-free baseline twin (fresh obs: its failed-microtask
+        # counter reflects the rollout alone), then the chaos twin.
+        # Each leg gets its OWN deep copy of the queued tail — the sim
+        # mutates admitted Job objects (id assignment, adaptive
+        # batch-size rescales), and a shared copy would leak the
+        # baseline leg's trajectory into the chaos leg.
+        base_twin = whatif_fork.thaw(sched, blob)
+        whatif_fork.rollforward(base_twin,
+                                queued=pickle.loads(pickle.dumps(queued)),
+                                remaining_jobs=remaining)
+        base_failed = base_twin.obs.registry.value(
+            obs_names.MICROTASKS_TOTAL, outcome="failed")
+
+        twin = whatif_fork.thaw(sched, blob)
+        whatif_fork.rollforward(twin,
+                                queued=pickle.loads(pickle.dumps(queued)),
+                                remaining_jobs=remaining,
+                                fault_events=events)
+
+        completed = twin.get_num_completed_jobs()
+        inv["twin_all_jobs_completed"] = completed == len(jobs)
+        if not inv["twin_all_jobs_completed"]:
+            violations.append(
+                f"twin: {completed}/{len(jobs)} jobs completed")
+        short, over = [], []
+        for j in jobs:
+            if j.mode != "static":
+                # Adaptive (accordion/GNS) budgets rescale along the
+                # TWIN's own trajectory; the live `j.total_steps` here
+                # reflects the base run's diverged adaptation history,
+                # so only completion (checked above) is comparable.
+                continue
+            run = twin.acct.total_steps_run.get(j.job_id, 0)
+            if run >= j.total_steps:
+                if run > j.total_steps:
+                    over.append(str(j.job_id))
+                continue
+            run_time = (sum(twin.acct.run_time_per_worker
+                            .get(j.job_id, {}).values())
+                        / max(j.scale_factor, 1))
+            if run_time <= int(j.duration * DEADLINE_SLACK):
+                short.append(str(j.job_id))
+        inv["twin_steps_accounted"] = not short and not over
+        if short:
+            violations.append(f"twin: step budget not covered for "
+                              f"{short} (and not deadline-dropped)")
+        if over:
+            violations.append(f"twin: step budget OVERSHOT for {over}")
+        twin_failed = twin.obs.registry.value(
+            obs_names.MICROTASKS_TOTAL, outcome="failed")
+        inv["twin_zero_failure_charges"] = twin_failed <= base_failed
+        if twin_failed > base_failed:
+            violations.append(
+                f"twin: injected faults added "
+                f"{twin_failed - base_failed:.0f} failure charge(s)")
+        inv["live_untouched"] = (pickle.dumps(sched.snapshot_state())
+                                 == live_before)
+        if not inv["live_untouched"]:
+            violations.append("twin rollouts mutated the live "
+                              "scheduler's state (fork isolation broken)")
+        return {"seed": seed, "plan": plan, "invariants": inv,
+                "violations": violations,
+                "summary": {"captured": True,
+                            "twin_makespan":
+                                round(twin.get_current_timestamp(), 2),
+                            "twin_completed": completed}}
+    except Exception as e:  # noqa: BLE001 - a crash is the worst
+        # violation of all; it must land in the artifact.
+        violations.append(f"twin schedule raised {type(e).__name__}: {e}")
+        return {"seed": seed, "plan": plan, "invariants": inv,
+                "violations": violations}
 
 
 # ----------------------------------------------------------------------
@@ -477,9 +603,12 @@ def run_physical_schedule(seed, cfg, workdir):
 # Artifact plumbing (sweep_scenarios.py contract)
 # ----------------------------------------------------------------------
 
-def write_artifact(path, meta, sim, physical):
+def write_artifact(path, meta, sim, physical, twin=None):
+    twin = twin or {}
+
     def _summary():
-        records = list(sim.values()) + list(physical.values())
+        records = (list(sim.values()) + list(physical.values())
+                   + list(twin.values()))
         bad = [r for r in records if r.get("violations")]
         return {
             "schedules": len(records),
@@ -490,6 +619,8 @@ def write_artifact(path, meta, sim, physical):
            "sim": {str(k): sim[k] for k in sorted(sim)},
            "physical": {str(k): physical[k] for k in sorted(physical)},
            "summary": _summary()}
+    if twin:
+        doc["twin"] = {str(k): twin[k] for k in sorted(twin)}
     write_text_atomic(path, json.dumps(doc, indent=1, sort_keys=True) + "\n")
     return doc
 
@@ -511,6 +642,12 @@ def main():
                    help="seeded physical-loopback schedules (real "
                         "scheduler + stub worker subprocesses; ~15-60s "
                         "each)")
+    p.add_argument("--twin_schedules", type=int, default=0,
+                   help="seeded digital-twin shadow schedules: the "
+                        "fault mix runs against a what-if fork of a "
+                        "mid-run scheduler (whatif/fork.py) instead of "
+                        "the live one, checking the same invariants "
+                        "plus fork isolation")
     p.add_argument("--seed_base", type=int, default=0)
     p.add_argument("--out", required=True, help="results JSON artifact")
     p.add_argument("--restart", action="store_true",
@@ -551,13 +688,14 @@ def main():
                   for k, v in knobs.items()},
     }
 
-    sim, physical = {}, {}
+    sim, physical, twin = {}, {}, {}
     existing = driver_common.load_resumable_artifact(args.out, meta,
                                                      args.restart)
     if existing is not None:
         sim = {int(k): v for k, v in existing.get("sim", {}).items()}
         physical = {int(k): v
                     for k, v in existing.get("physical", {}).items()}
+        twin = {int(k): v for k, v in existing.get("twin", {}).items()}
 
     from shockwave_tpu.core.oracle import read_throughputs
     cfg = {
@@ -581,10 +719,23 @@ def main():
             continue
         record = run_sim_schedule(args.seed_base + i, cfg)
         sim[i] = record
-        write_artifact(args.out, meta, sim, physical)
+        write_artifact(args.out, meta, sim, physical, twin)
         status = "ok" if not record["violations"] else "VIOLATION"
         print(f"[sim {len(sim)}/{args.num_schedules}] seed "
               f"{args.seed_base + i} {status} "
+              f"({_time.monotonic() - t0:.1f}s elapsed)",  # swtpu-check: ignore[determinism]
+              file=sys.stderr, flush=True)
+
+    for i in range(args.twin_schedules):
+        if i in twin:
+            continue
+        # Disjoint seed space (physical uses +10_000).
+        record = run_twin_schedule(args.seed_base + 20_000 + i, cfg)
+        twin[i] = record
+        write_artifact(args.out, meta, sim, physical, twin)
+        status = "ok" if not record["violations"] else "VIOLATION"
+        print(f"[twin {len(twin)}/{args.twin_schedules}] seed "
+              f"{args.seed_base + 20_000 + i} {status} "
               f"({_time.monotonic() - t0:.1f}s elapsed)",  # swtpu-check: ignore[determinism]
               file=sys.stderr, flush=True)
 
@@ -594,14 +745,14 @@ def main():
         record = run_physical_schedule(
             i, cfg, os.path.join(workdir, f"phys{i}"))
         physical[i] = record
-        write_artifact(args.out, meta, sim, physical)
+        write_artifact(args.out, meta, sim, physical, twin)
         status = "ok" if not record["violations"] else "VIOLATION"
         print(f"[physical {len(physical)}/{args.physical_schedules}] "
               f"seed {i} {status} "
               f"({_time.monotonic() - t0:.1f}s elapsed)",  # swtpu-check: ignore[determinism]
               file=sys.stderr, flush=True)
 
-    doc = write_artifact(args.out, meta, sim, physical)
+    doc = write_artifact(args.out, meta, sim, physical, twin)
     summary = doc["summary"]
     wall_s = _time.monotonic() - t0  # swtpu-check: ignore[determinism]
     result = {"artifact": args.out, **summary,

@@ -55,25 +55,28 @@ def load_resumable_artifact(path: str, meta: dict,
 
 def load_configs(config_path: Optional[str], policy: str,
                  cluster_spec: dict, round_duration: float):
-    """(shockwave_config, serving_config) from a driver --config file.
+    """(shockwave_config, serving_config, whatif_config) from a driver
+    --config file.
 
-    The serving tier is policy-agnostic; its autoscaler block rides the
-    same config file but a separate SchedulerConfig field (the planner
-    would reject the unknown keys). A shockwave run without a config
-    file gets the planner defaults.
+    The serving tier and the what-if plane are policy-agnostic; their
+    blocks ride the same config file but separate SchedulerConfig
+    fields (the planner would reject the unknown keys). A shockwave
+    run without a config file gets the planner defaults.
     """
     shockwave_config = None
     serving_config = None
+    whatif_config = None
     if config_path:
         with open(config_path) as f:
             shockwave_config = json.load(f)
         serving_config = shockwave_config.pop("serving", None)
+        whatif_config = shockwave_config.pop("whatif", None)
     if shockwave_config is None and policy == "shockwave":
         shockwave_config = {}  # planner defaults
     if shockwave_config is not None:
         shockwave_config["num_gpus"] = sum(cluster_spec.values())
         shockwave_config["time_per_iteration"] = round_duration
-    return shockwave_config, serving_config
+    return shockwave_config, serving_config, whatif_config
 
 
 def build_scheduler(policy_name: str, throughputs_file: str, profiles,
@@ -81,6 +84,7 @@ def build_scheduler(policy_name: str, throughputs_file: str, profiles,
                     max_rounds: Optional[int] = None,
                     shockwave_config: Optional[dict] = None,
                     serving_config: Optional[dict] = None,
+                    whatif_config: Optional[dict] = None,
                     rate_override: Optional[dict] = None,
                     vectorized: bool = True) -> Scheduler:
     """One simulation-mode scheduler, configured the way every driver
@@ -93,7 +97,7 @@ def build_scheduler(policy_name: str, throughputs_file: str, profiles,
             time_per_iteration=round_duration, seed=seed,
             max_rounds=max_rounds, shockwave=shockwave_config,
             rate_override=rate_override, serving=serving_config,
-            vectorized_sim=vectorized))
+            whatif=whatif_config, vectorized_sim=vectorized))
 
 
 def collect_metrics(sched: Scheduler, makespan: float,
@@ -131,6 +135,17 @@ def collect_metrics(sched: Scheduler, makespan: float,
     serving = sched.serving_summary()
     if serving is not None:
         metrics["serving"] = serving
+    if sched._whatif is not None:
+        # The full decision evidence rides the metrics pickle; only
+        # deterministic counts reach summary lines (status() carries
+        # fork WALL telemetry, which must stay out of byte-reproducible
+        # artifacts).
+        metrics["whatif"] = {
+            "decision_log": sched._whatif.decision_log,
+            "knob_log": sched._whatif.knob_log,
+            "forecast_log": sched._whatif.forecast_log,
+            "shadow_log": sched._whatif.shadow_log,
+        }
     return metrics
 
 
@@ -152,6 +167,12 @@ def summary_core(metrics: dict, sched: Scheduler) -> dict:
         summary["serving_slo_attainment"] = serving["slo_attainment"]
         summary["serving_requests_offered"] = serving["requests_offered"]
         summary["serving_services"] = serving["services"]
+    whatif = metrics.get("whatif")
+    if whatif is not None:
+        decisions = whatif["decision_log"]
+        summary["whatif_decisions"] = len(decisions)
+        summary["whatif_deferrals"] = sum(
+            1 for d in decisions if d["decision"] == "defer")
     return summary
 
 

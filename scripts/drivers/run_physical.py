@@ -104,6 +104,14 @@ def main():
                    help="JSON file (or inline JSON object) of "
                         "runtime/resilience.HealthConfig field overrides "
                         "for the gray-failure classifier")
+    # What-if plane knobs (see README "What-if control plane").
+    p.add_argument("--whatif", default=None, metavar="JSON",
+                   help="JSON file (or inline JSON object) of "
+                        "whatif.WhatIfConfig field overrides — enables "
+                        "the online what-if control plane (digital-twin "
+                        "forks each round: advisory admission verdicts, "
+                        "knob auto-tuning, forecasts). A 'whatif' block "
+                        "in --config does the same; this flag wins")
     # Durability knobs (defaults recorded in configs/durability.json;
     # see README "Scheduler crash recovery").
     p.add_argument("--state_dir", "--state-dir", dest="state_dir",
@@ -153,12 +161,20 @@ def main():
 
     shockwave_config = None
     serving_config = None
+    whatif_config = None
     if args.config:
         with open(args.config) as f:
             shockwave_config = json.load(f)
-        # Serving-tier autoscaler block (policy-agnostic; same file
-        # convention as simulate.py).
+        # Serving-tier autoscaler + what-if blocks (policy-agnostic;
+        # same file convention as simulate.py).
         serving_config = shockwave_config.pop("serving", None)
+        whatif_config = shockwave_config.pop("whatif", None)
+    if args.whatif:
+        if args.whatif.strip().startswith("{"):
+            whatif_config = json.loads(args.whatif)
+        else:
+            with open(args.whatif) as f:
+                whatif_config = json.load(f)
     if shockwave_config is None and args.policy == "shockwave":
         shockwave_config = {}
     if shockwave_config is not None:
@@ -197,7 +213,7 @@ def main():
             snapshot_interval_rounds=args.snapshot_interval,
             pipelined_planning=not args.no_pipelined_solve,
             obs_port=args.obs_port, obs_trace_path=args.obs_trace,
-            serving=serving_config))
+            serving=serving_config, whatif=whatif_config))
     if sched.obs_port is not None:
         # stderr, unconditionally: with --obs_port 0 this line is the
         # ONLY place the resolved ephemeral port appears, and the
@@ -296,6 +312,17 @@ def main():
         "throughput_timeline": sched.get_throughput_timeline(),
         "milp_solve_stats": sched.get_solve_stats(),
     }
+    if sched._whatif is not None:
+        # The plane's full evidence trail (sweeps, forecasts, advisory
+        # admission verdicts) — what the committed loopback-tuning
+        # artifact is built from.
+        metrics["whatif"] = {
+            "status": sched._whatif.status(),
+            "decision_log": sched._whatif.decision_log,
+            "knob_log": sched._whatif.knob_log,
+            "forecast_log": sched._whatif.forecast_log,
+            "shadow_log": sched._whatif.shadow_log,
+        }
     if args.output:
         with open(args.output, "wb") as f:
             pickle.dump(metrics, f)

@@ -95,8 +95,9 @@ def main():
                 f"--cluster_spec {wt}:{count} is not divisible by "
                 f"--chips_per_server {args.chips_per_server}")
 
-    shockwave_config, serving_config = driver_common.load_configs(
-        args.config, args.policy, cluster_spec, args.round_duration)
+    shockwave_config, serving_config, whatif_config = (
+        driver_common.load_configs(args.config, args.policy, cluster_spec,
+                                   args.round_duration))
 
     forced_schedule = None
     if args.replay_schedule:
@@ -119,8 +120,8 @@ def main():
         args.policy, args.throughputs, profiles,
         round_duration=args.round_duration, seed=args.seed,
         max_rounds=args.max_rounds, shockwave_config=shockwave_config,
-        serving_config=serving_config, rate_override=rate_override,
-        vectorized=not args.scalar_sim)
+        serving_config=serving_config, whatif_config=whatif_config,
+        rate_override=rate_override, vectorized=not args.scalar_sim)
 
     profiler = None
     if args.profile_out:

@@ -188,6 +188,97 @@ def draw_scenario(rng, jobs, arrivals, knobs, cluster_spec):
     return jobs, arrivals, fault_events, params
 
 
+def draw_state_faults(rng, twin, knobs, now):
+    """Seeded fault/degrade events for a mid-run twin, targeting the
+    chips the restored cluster actually holds (dead ones excluded) and
+    offset from the twin's frozen clock. Draw order is the scenario
+    contract, mirroring draw_scenario's kill-then-degrade order."""
+    layout = {wt: [w for server in servers for w in server]
+              for wt, servers in twin.workers.type_to_server_ids.items()}
+    layout = {wt: ids for wt, ids in layout.items() if ids}
+    params = {}
+    fault_events = []
+    types = sorted(layout)
+    if not types:
+        return fault_events, params
+    fault_rate = knobs.get("fault_rate", 0.0)
+    if fault_rate > 0:
+        for _ in range(int(rng.poisson(fault_rate))):
+            wt = types[int(rng.randint(len(types)))]
+            k = min(int(rng.randint(1, knobs["fault_max_chips"] + 1)),
+                    len(layout[wt]))
+            ids = sorted(int(i) for i in rng.choice(layout[wt], size=k,
+                                                    replace=False))
+            at = now + float(rng.uniform(0.0, knobs["fault_window_s"]))
+            fault_events.append({"at": round(at, 3), "kill": ids})
+            fault_events.append(
+                {"at": round(at + knobs["fault_down_s"], 3),
+                 "revive": ids, "worker_type": wt})
+        params["fault_events"] = sum(1 for e in fault_events
+                                     if "kill" in e)
+    degrade_rate = knobs.get("degrade_rate", 0.0)
+    if degrade_rate > 0:
+        lo, hi = knobs.get("degrade_factor") or (0.05, 0.5)
+        for _ in range(int(rng.poisson(degrade_rate))):
+            wt = types[int(rng.randint(len(types)))]
+            k = min(int(rng.randint(1, knobs["fault_max_chips"] + 1)),
+                    len(layout[wt]))
+            ids = sorted(int(i) for i in rng.choice(layout[wt], size=k,
+                                                    replace=False))
+            factor = round(float(rng.uniform(lo, hi)), 6)
+            at = now + float(rng.uniform(0.0, knobs["fault_window_s"]))
+            fault_events.append({"at": round(at, 3), "degrade": ids,
+                                 "factor": factor})
+            fault_events.append(
+                {"at": round(at + knobs["degrade_down_s"], 3),
+                 "restore": ids})
+        params["degrade_events"] = sum(1 for e in fault_events
+                                       if "degrade" in e)
+    fault_events.sort(key=lambda e: e["at"])
+    return fault_events, params
+
+
+def run_state_scenario(seed_index, cfg):
+    """One --from_state scenario: restore the journaled mid-run
+    snapshot through the what-if fork loader, perturb with seeded
+    fault/degrade events, roll the admitted workload to drain."""
+    import random as _random
+
+    from shockwave_tpu.sched import SchedulerConfig
+    from shockwave_tpu.solver import get_policy
+    from shockwave_tpu.whatif import fork as whatif_fork
+
+    seed = cfg["seed_base"] + seed_index
+    rng = np.random.RandomState(seed)
+    jobs, _ = parse_trace(cfg["trace"])
+    cluster_spec = parse_cluster_spec(cfg["cluster_spec"])
+    throughputs = read_throughputs(cfg["throughputs"])
+    profiles = build_profiles(jobs, throughputs)
+    shockwave_config, serving_config, _ = (
+        driver_common.load_configs(cfg["config"], cfg["policy"],
+                                   cluster_spec, cfg["round_duration"]))
+    config = SchedulerConfig(
+        time_per_iteration=cfg["round_duration"], seed=seed,
+        shockwave=shockwave_config, serving=serving_config,
+        vectorized_sim=not cfg["scalar_sim"])
+    twin, queued, running, remaining = whatif_fork.load_twin(
+        cfg["from_state"], get_policy(cfg["policy"], seed=seed),
+        profiles, config, throughputs_file=cfg["throughputs"])
+    if cfg["max_rounds"] is not None:
+        twin._config.max_rounds = cfg["max_rounds"]
+    now = twin.get_current_timestamp()
+    fault_events, params = draw_state_faults(rng, twin, cfg["knobs"], now)
+    # Scenario axis beyond faults: reseeded scheduling tie-breaks.
+    twin._rng = np.random.RandomState(seed)
+    twin._worker_type_shuffler = _random.Random(seed + 5)
+    params["from_round"] = twin.rounds.num_completed_rounds
+    params["active_jobs"] = len(twin.acct.jobs)
+    makespan = whatif_fork.rollforward(
+        twin, queued=queued, running=running, remaining_jobs=remaining,
+        fault_events=fault_events)
+    return twin, makespan, params
+
+
 def run_scenario(payload):
     """Process-pool worker: one seeded scenario end to end. Returns
     (seed_index, record) where record is fully deterministic (no wall
@@ -198,27 +289,32 @@ def run_scenario(payload):
     # it — the artifact stays byte-deterministic).
     _t0 = _time.monotonic()  # swtpu-check: ignore[determinism]
     try:
-        rng = np.random.RandomState(cfg["seed_base"] + seed_index)
-        jobs, arrivals = parse_trace(cfg["trace"])
-        cluster_spec = parse_cluster_spec(cfg["cluster_spec"])
-        jobs, arrivals, fault_events, params = draw_scenario(
-            rng, jobs, arrivals, cfg["knobs"], cluster_spec)
+        if cfg.get("from_state"):
+            sched, makespan, params = run_state_scenario(seed_index, cfg)
+        else:
+            rng = np.random.RandomState(cfg["seed_base"] + seed_index)
+            jobs, arrivals = parse_trace(cfg["trace"])
+            cluster_spec = parse_cluster_spec(cfg["cluster_spec"])
+            jobs, arrivals, fault_events, params = draw_scenario(
+                rng, jobs, arrivals, cfg["knobs"], cluster_spec)
 
-        throughputs = read_throughputs(cfg["throughputs"])
-        profiles = build_profiles(jobs, throughputs)
-        shockwave_config, serving_config = driver_common.load_configs(
-            cfg["config"], cfg["policy"], cluster_spec,
-            cfg["round_duration"])
-        sched = driver_common.build_scheduler(
-            cfg["policy"], cfg["throughputs"], profiles,
-            round_duration=cfg["round_duration"],
-            seed=cfg["seed_base"] + seed_index,
-            max_rounds=cfg["max_rounds"],
-            shockwave_config=shockwave_config,
-            serving_config=serving_config,
-            vectorized=not cfg["scalar_sim"])
-        makespan = sched.simulate(cluster_spec, arrivals, jobs,
-                                  fault_events=fault_events)
+            throughputs = read_throughputs(cfg["throughputs"])
+            profiles = build_profiles(jobs, throughputs)
+            shockwave_config, serving_config, whatif_config = (
+                driver_common.load_configs(cfg["config"], cfg["policy"],
+                                           cluster_spec,
+                                           cfg["round_duration"]))
+            sched = driver_common.build_scheduler(
+                cfg["policy"], cfg["throughputs"], profiles,
+                round_duration=cfg["round_duration"],
+                seed=cfg["seed_base"] + seed_index,
+                max_rounds=cfg["max_rounds"],
+                shockwave_config=shockwave_config,
+                serving_config=serving_config,
+                whatif_config=whatif_config,
+                vectorized=not cfg["scalar_sim"])
+            makespan = sched.simulate(cluster_spec, arrivals, jobs,
+                                      fault_events=fault_events)
         metrics = driver_common.collect_metrics(sched, makespan,
                                                 cfg["round_duration"],
                                                 cfg["policy"])
@@ -291,6 +387,16 @@ def main():
     p.add_argument("--round_duration", type=float, default=120.0)
     p.add_argument("--config", default=None,
                    help="scheduler config JSON (shockwave/serving blocks)")
+    p.add_argument("--from_state", default=None, metavar="STATE",
+                   help="seed every scenario from a journaled mid-run "
+                        "snapshot instead of trace time-zero: a "
+                        "scheduler state DIR (snapshot + journal, as "
+                        "written by --state_dir runs) or a simulation "
+                        "checkpoint file, loaded through the what-if "
+                        "fork loader (whatif/fork.load_twin). Only the "
+                        "fault/degrade knobs apply (the admitted "
+                        "workload is already fixed); --trace still "
+                        "names the original run's trace (profiles)")
     p.add_argument("--num_scenarios", type=int, default=200)
     p.add_argument("--seed_base", type=int, default=0)
     p.add_argument("--processes", type=int, default=None,
@@ -341,6 +447,20 @@ def main():
                          "(directly or via --sweep_config)")
     setup_logging("info" if args.verbose else "warning")
 
+    if args.from_state:
+        trace_zero_only = [k for k, v in (
+            ("subsample", args.subsample), ("load_scale", args.load_scale),
+            ("arrival_jitter_s", args.arrival_jitter_s or None),
+            ("serving_spike_seeds", args.serving_spike_seeds or None),
+        ) if v]
+        if trace_zero_only:
+            # These knobs rewrite the trace BEFORE admission; a mid-run
+            # snapshot's workload is already admitted, so silently
+            # accepting them would produce misleading no-op scenarios.
+            raise SystemExit(f"--from_state is incompatible with "
+                             f"{trace_zero_only} (the snapshot's "
+                             "workload is already admitted; use the "
+                             "fault/degrade knobs)")
     knobs = {
         "subsample": parse_range(args.subsample, "subsample"),
         "load_scale": parse_range(args.load_scale, "load_scale"),
@@ -364,6 +484,7 @@ def main():
         "config": args.config,
         "seed_base": args.seed_base,
         "max_rounds": args.max_rounds,
+        "from_state": args.from_state,
         "knobs": {k: (list(v) if isinstance(v, tuple) else v)
                   for k, v in knobs.items()},
     }
@@ -386,6 +507,7 @@ def main():
         "round_duration": args.round_duration, "config": args.config,
         "seed_base": args.seed_base, "max_rounds": args.max_rounds,
         "scalar_sim": bool(args.scalar_sim), "knobs": knobs,
+        "from_state": args.from_state,
     }
 
     import time as _time

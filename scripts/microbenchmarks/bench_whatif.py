@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""What-if control-plane overhead microbenchmark: forks/min +
+rollouts/min on a mid-run canonical scheduler.
+
+Measures the two costs the what-if plane charges the control plane:
+
+- **fork** — `whatif.fork.capture` (the journal-snapshot pickle; the
+  part that runs under the scheduler lock in physical mode) plus
+  `thaw` (twin materialization, off the lock),
+- **rollout** — `fork.rollforward` of one thawed twin over a fixed
+  horizon (the unit of every admission sample / knob candidate /
+  forecast draw).
+
+The subject is the canonical 120-job trace run to a mid-run round
+(like bench_sim_round.py, the round-bookkeeping microbenchmark this
+sits beside), so the forked state carries a realistic active set.
+Prints ONE JSON line; bench.py embeds it as the `whatif_phase` row.
+``--smoke`` exits nonzero when the fork wall exceeds --max_fork_s
+(CI guard: the state copy must stay far under a physical round).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.core.oracle import read_throughputs  # noqa: E402
+from shockwave_tpu.core.profiles import build_profiles  # noqa: E402
+from shockwave_tpu.core.trace import parse_trace  # noqa: E402
+from shockwave_tpu.obs.logconfig import setup_logging  # noqa: E402
+from shockwave_tpu.sched import Scheduler, SchedulerConfig  # noqa: E402
+from shockwave_tpu.solver import get_policy  # noqa: E402
+from shockwave_tpu.whatif import fork  # noqa: E402
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def mid_run_scheduler(args):
+    """The canonical trace advanced to --capture_round, captured via
+    the plane's fork hook (the clean round-boundary fork point)."""
+    jobs, arrivals = parse_trace(args.trace)
+    if args.num_jobs:
+        jobs, arrivals = jobs[:args.num_jobs], arrivals[:args.num_jobs]
+    profiles = build_profiles(jobs, read_throughputs(args.throughputs))
+    sched = Scheduler(
+        get_policy(args.policy, seed=0), simulate=True,
+        throughputs_file=args.throughputs, profiles=profiles,
+        config=SchedulerConfig(
+            time_per_iteration=args.round_duration, seed=0,
+            max_rounds=args.capture_round + 1,
+            whatif={"capture_at_round": args.capture_round}))
+    sched.simulate({"v100": args.num_chips}, arrivals, jobs)
+    if sched._whatif.captured is None:
+        raise SystemExit(f"trace drained before round "
+                         f"{args.capture_round}; lower --capture_round")
+    return sched
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--trace",
+                   default=os.path.join(REPO,
+                                        "data/canonical_120job.trace"))
+    p.add_argument("--throughputs",
+                   default=os.path.join(REPO,
+                                        "data/tacc_throughputs.json"))
+    p.add_argument("--policy", default="max_min_fairness")
+    p.add_argument("--num_jobs", type=int, default=0,
+                   help="trace-head subset (0 = full trace)")
+    p.add_argument("--num_chips", type=int, default=32)
+    p.add_argument("--round_duration", type=float, default=120.0)
+    p.add_argument("--capture_round", type=int, default=40)
+    p.add_argument("--forks", type=int, default=20)
+    p.add_argument("--rollouts", type=int, default=10)
+    p.add_argument("--horizon_rounds", type=int, default=12)
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--max_fork_s", type=float, default=0.5,
+                   help="--smoke: fail when one fork's capture exceeds "
+                        "this (the lock-held cost in physical mode)")
+    args = p.parse_args()
+    setup_logging("warning")
+
+    sched = mid_run_scheduler(args)
+    blob, queued, remaining = sched._whatif.captured
+
+    t0 = time.monotonic()
+    for _ in range(args.forks):
+        fork.thaw(sched, fork.capture(sched))
+    fork_wall = time.monotonic() - t0
+
+    capture_wall = 0.0
+    worst_capture = 0.0
+    for _ in range(args.forks):
+        c0 = time.monotonic()
+        fork.capture(sched)
+        dt = time.monotonic() - c0
+        capture_wall += dt
+        worst_capture = max(worst_capture, dt)
+    t0 = time.monotonic()
+    for k in range(args.rollouts):
+        twin = fork.thaw(sched, blob, seed=k)
+        fork.rollforward(twin, horizon_rounds=args.horizon_rounds,
+                         remaining_jobs=remaining)
+    rollout_wall = time.monotonic() - t0
+
+    mean_capture = capture_wall / max(args.forks, 1)
+    line = {
+        "active_jobs_at_fork": len(sched.acct.jobs),
+        "capture_round": args.capture_round,
+        "forks": args.forks,
+        "fork_wall_s": round(fork_wall, 3),
+        "mean_capture_s": round(mean_capture, 5),
+        "max_capture_s": round(worst_capture, 5),
+        "forks_per_min": round(args.forks / fork_wall * 60.0, 1)
+        if fork_wall > 0 else None,
+        "rollouts": args.rollouts,
+        "horizon_rounds": args.horizon_rounds,
+        "rollout_wall_s": round(rollout_wall, 3),
+        "rollouts_per_min": round(args.rollouts / rollout_wall * 60.0, 1)
+        if rollout_wall > 0 else None,
+    }
+    print(json.dumps(line))
+    if args.smoke and worst_capture > args.max_fork_s:
+        print(f"SMOKE FAIL: worst capture {worst_capture:.3f}s > "
+              f"{args.max_fork_s}s", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -251,12 +251,18 @@ DETERMINISM_SCOPE_GLOBS = (
     "shockwave_tpu/sched/scheduler.py",
     "shockwave_tpu/sched/simcore.py",
     "shockwave_tpu/sched/state.py",
+    # The what-if plane's decisions must replay identically: twin
+    # forks, admission verdicts and knob sweeps are derived only from
+    # scheduler state + seeded RNG (fork-cost wall telemetry is
+    # inline-suppressed).
+    "shockwave_tpu/whatif/*.py",
     # The Monte Carlo sweep's and the chaos campaign's artifacts must
     # be byte-reproducible from their seeds: scenario content is
     # seeded-RNG only, and wall clocks are confined to inline-
     # suppressed throughput telemetry / subprocess babysitting.
     "scripts/drivers/sweep_scenarios.py",
     "scripts/drivers/chaos_campaign.py",
+    "scripts/drivers/whatif_overload_study.py",
 )
 #: Wall-clock measurement utilities (two-point marginal timing) are the
 #: sanctioned home for real clocks.

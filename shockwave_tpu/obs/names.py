@@ -279,6 +279,48 @@ SWEEP_SCENARIO_WALL_SECONDS = _histogram(
     "Per-scenario simulation wall time inside the sweep's process pool")
 
 # ----------------------------------------------------------------------
+# Online what-if control plane (shockwave_tpu/whatif/): digital-twin
+# forks of the live scheduler rolled forward in-memory every round
+# ----------------------------------------------------------------------
+
+WHATIF_FORK_SECONDS = _histogram(
+    "swtpu_whatif_fork_seconds",
+    "Digital-twin state-fork copy time (the pickle of the journal "
+    "snapshot; runs under the scheduler lock in physical mode, so this "
+    "IS the round pipeline's fork hold-time)")
+WHATIF_ROLLOUTS_TOTAL = _counter(
+    "swtpu_whatif_rollouts_total",
+    "Twin rollouts completed, by purpose (admission / tune / forecast "
+    "/ shadow_chaos)", ("purpose",))
+WHATIF_ADMISSION_DECISIONS_TOTAL = _counter(
+    "swtpu_whatif_admission_decisions_total",
+    "Monte-Carlo admission-control verdicts, by decision (admit / "
+    "defer / fast_path / would_defer — fast_path: the cluster-load "
+    "guard admitted without rolling a twin; would_defer: a physical "
+    "ADVISORY verdict, the job was admitted anyway)", ("decision",))
+WHATIF_KNOB_VALUE = _gauge(
+    "swtpu_whatif_knob_value",
+    "Current value of each auto-tuned knob (set at every committed "
+    "sweep)", ("knob",))
+WHATIF_KNOB_COMMITS_TOTAL = _counter(
+    "swtpu_whatif_knob_commits_total",
+    "Knob auto-tuning sweeps that committed a CHANGED value, by knob",
+    ("knob",))
+WHATIF_FORECAST_MAKESPAN_SECONDS = _gauge(
+    "swtpu_whatif_forecast_makespan_seconds",
+    "Forecast projected drain time of the active workload from seeded "
+    "twin rollouts, by quantile (p50 / p99)", ("quantile",))
+WHATIF_FORECAST_ATTAINMENT = _gauge(
+    "swtpu_whatif_forecast_attainment",
+    "Forecast serving SLO attainment over the rollout horizon, by "
+    "quantile (p50 / p99; 1.0 with no serving load)", ("quantile",))
+WHATIF_SHADOW_CHAOS_TOTAL = _counter(
+    "swtpu_whatif_shadow_chaos_total",
+    "Low-rate shadow chaos probes run against the digital twin, by "
+    "outcome (ok / violation — violation: the injected fault added "
+    "failure charges or crashed the twin rollout)", ("outcome",))
+
+# ----------------------------------------------------------------------
 # Offline harnesses (scripts/microbenchmarks, scripts/profiling)
 # ----------------------------------------------------------------------
 
@@ -305,6 +347,11 @@ SPAN_JOURNAL_FSYNC = "journal-fsync"
 SPAN_SNAPSHOT = "snapshot"
 SPAN_ESTIMATE_REFRESH = "estimate-refresh"
 SPAN_SERVING_PLAN = "serving-plan"
+#: The fork's state copy — a round-pipeline phase (it runs under the
+#: scheduler lock in physical mode), so it lands in the phase
+#: histogram AND the trace timeline like solve/dispatch/wait do.
+SPAN_WHATIF_FORK = "whatif_fork"
+SPAN_WHATIF_ROLLOUT = "whatif-rollout"
 SPAN_PLANNER_SOLVE = "planner-solve"
 SPAN_POLICY_SOLVE = "policy-solve"
 SPAN_PROFILE_MEASURE = "profile-measure"

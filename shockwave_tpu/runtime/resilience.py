@@ -396,6 +396,21 @@ class HealthConfig:
                 f"unknown worker-health option(s): {sorted(unknown)}")
         return cls(**config)
 
+    def with_quarantine_backoff(self, backoff_s: float) -> "HealthConfig":
+        """A copy with a retuned quarantine release backoff — the
+        gray-failure knob the what-if plane's auto-tuner commits
+        (whatif/knobs.py). The config is frozen by design, so live
+        retuning goes through replacement; the caller re-points the
+        scheduler's `_health_cfg` AND each HostHealth's `config` so
+        in-flight classifiers score against the new value. Clamped to
+        (0, quarantine_backoff_max_s]."""
+        from dataclasses import replace
+        if backoff_s <= 0:
+            raise ValueError(
+                f"quarantine backoff must be positive, got {backoff_s!r}")
+        return replace(self, quarantine_backoff_s=min(
+            float(backoff_s), self.quarantine_backoff_max_s))
+
 
 class HostHealth:
     """EWMA + hysteresis health classifier for one worker host.

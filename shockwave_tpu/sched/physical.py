@@ -245,6 +245,17 @@ class PhysicalScheduler(Scheduler):
         if self._config.heartbeat_interval_s:
             threading.Thread(target=self._liveness_loop, daemon=True).start()
 
+        # What-if control plane (config.whatif): the round pipeline
+        # captures state forks UNDER the lock (the instrumented
+        # `whatif_fork` phase — a few ms of pickle), and this thread
+        # rolls the detached twins OFF it, re-taking the lock only for
+        # a committed knob value. Admission evaluation in physical mode
+        # is ADVISORY: the verdict is logged/journaled, the job is
+        # admitted regardless (deferral is a simulation-loop mechanism).
+        self._whatif_work: "queue.Queue" = queue.Queue()
+        if self._whatif is not None:
+            threading.Thread(target=self._whatif_loop, daemon=True).start()
+
     # ------------------------------------------------------------------
     # Time / threading
     # ------------------------------------------------------------------
@@ -298,7 +309,7 @@ class PhysicalScheduler(Scheduler):
             f"{addr}:{port}": {"state": h.state,
                                "score": round(h.score, 4)}
             for (addr, port), h in self._host_health.items()}
-        return {
+        payload = {
             "round": self.rounds.num_completed_rounds,
             "active_jobs": len(self.acct.jobs),
             "completed_jobs": len(self._completed_jobs),
@@ -311,12 +322,43 @@ class PhysicalScheduler(Scheduler):
             "recovered": self._recovered,
             "uptime_s": round(time.time() - self._start_time, 3),
         }
+        if self._whatif is not None:
+            # Forecast quantiles + fork/rollout counters + the latest
+            # tuned-knob record, on the same probe the operator already
+            # watches.
+            payload["whatif"] = self._whatif.status()
+        return payload
 
     def add_job(self, job, timestamp=None):
         with self._cv:
+            advisory = None
+            if (self._whatif is not None
+                    and self._whatif.cfg.admission == "gate"
+                    and self.workers.worker_ids
+                    # Gate TRACE admissions only: autoscaler-spawned
+                    # serving replicas arrive through this same method
+                    # from inside the locked round pipeline (a fork +
+                    # rollouts per scale-up would be pure overhead and
+                    # the verdict meaningless), and journal replay must
+                    # not pollute the decision log with replay-time
+                    # verdicts.
+                    and not self._replaying
+                    and "--replica_of" not in job.command):
+                # Advisory Monte-Carlo admission: fork the PRE-admission
+                # state here (the only lock-held cost), evaluate
+                # with-vs-without on the background thread. The job is
+                # admitted either way — physical deferral would mean
+                # holding a real submitter's RPC hostage to K rollouts.
+                import pickle as _pickle
+                from ..whatif import fork as _fork
+                advisory = (_fork.capture(self),
+                            _pickle.dumps(job),
+                            self.get_current_timestamp())
             job_id = super().add_job(job, timestamp)
             self._lease_update_requests[job_id] = []
             self._max_steps_consensus[job_id] = None
+            if advisory is not None:
+                self._whatif_work.put(("advise",) + advisory)
             self._cv.notify_all()
             return job_id
 
@@ -1912,6 +1954,13 @@ class PhysicalScheduler(Scheduler):
         self.rounds.next_assignments = None
         self._emit("round_ended", round=self.rounds.num_completed_rounds)
         self._maybe_snapshot()
+        if self._whatif is not None:
+            # Pay only the state-copy cost under the lock (the
+            # `whatif_fork` phase); twin rollouts run on the what-if
+            # thread against the detached blob.
+            work = self._whatif.maybe_capture_locked()
+            if work is not None:
+                self._whatif_work.put(work)
         self._obs_update_round_gauges()
         self._cv.notify_all()
         self.log.info("*** END ROUND %d ***", self.rounds.num_completed_rounds - 1)
@@ -2107,6 +2156,33 @@ class PhysicalScheduler(Scheduler):
     def _all_done(self):
         with self._lock:
             return not self.acct.jobs and not self._serving_live()
+
+    # ------------------------------------------------------------------
+    # What-if background rollouts
+    # ------------------------------------------------------------------
+
+    def _whatif_loop(self):
+        """Consume captured fork blobs and roll them OFF the scheduler
+        lock; a committed knob value re-takes the lock briefly (the
+        plane's commit_lock). The thread must never die — the round
+        pipeline keeps producing work items either way."""
+        while not self._done_event.is_set():
+            try:
+                work = self._whatif_work.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            try:
+                if work[0] == "advise":
+                    _, blob, job_bytes, now = work
+                    import pickle as _pickle
+                    self._whatif.advise_admission(
+                        blob, _pickle.loads(job_bytes), now)
+                else:
+                    self._whatif.run_background_step(work,
+                                                     commit_lock=self._lock)
+            except Exception:  # noqa: BLE001 - advisory plane: a bad
+                # rollout must never take the control plane with it
+                self.log.exception("what-if background step failed")
 
     @requires_lock
     def _update_shockwave_planner_physical(self, extended_leases):

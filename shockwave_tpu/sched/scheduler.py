@@ -207,6 +207,15 @@ class SchedulerConfig:
     # dispatch_latency_ref_s, rate_ref_decay, quarantine_backoff_s,
     # quarantine_backoff_max_s). None = the recorded defaults.
     worker_health: Optional[dict] = None
+    # ---- online what-if control plane (both modes; see README
+    # "What-if control plane") ----
+    # whatif.WhatIfConfig field overrides: Monte-Carlo admission
+    # control (admission="gate"), knob auto-tuning (tune_knob=...),
+    # rollout forecasts and the twin shadow-chaos validator. None (the
+    # default) constructs no plane at all — zero code on the canonical
+    # replay path; a config with the default admission="always_admit"
+    # keeps every admission decision identical too.
+    whatif: Optional[dict] = None
 
 
 class Scheduler:
@@ -355,6 +364,20 @@ class Scheduler:
 
         # Profiles indexed by integer job id (Shockwave solver input).
         self._profiles = profiles
+        # int job id -> trace position, for runs where admission ORDER
+        # diverges from trace order (what-if admission deferral): ids
+        # are assigned at admission, so a deferred job's id no longer
+        # equals its trace position and the positional profile lookup
+        # must go through this map. Identity (empty) on every
+        # non-deferring path — canonical replays never populate it.
+        self._profile_map: Dict[int, int] = {}
+
+        # Knob values committed by the what-if auto-tuner: mirrored
+        # here (and into every snapshot) because the tuned state
+        # itself may live OUTSIDE the snapshot fields (planner opts,
+        # health config) and the whatif_knob journal event can be
+        # compacted away — restore_state re-applies these.
+        self._whatif_knob_values: Dict[str, float] = {}
 
         self._rng = np.random.RandomState(self._config.seed)
         import random as _random
@@ -422,6 +445,17 @@ class Scheduler:
         self._shockwave_job_completed = False
         self._rounds_since_reopt = 0
 
+        # Online what-if control plane (shockwave_tpu/whatif/): forks
+        # this scheduler's journal-snapshot state into in-memory twin
+        # rollouts for admission control, knob tuning and forecasts.
+        # None (the default) means not even the hook sites execute —
+        # the canonical replay path is untouched. Twins themselves are
+        # built with whatif=None, so forks never recurse.
+        self._whatif = None
+        if self._config.whatif is not None:
+            from ..whatif.plane import WhatIfPlane
+            self._whatif = WhatIfPlane(self, self._config.whatif)
+
     # ------------------------------------------------------------------
     # Time
     # ------------------------------------------------------------------
@@ -466,7 +500,8 @@ class Scheduler:
         "_num_jobs_in_trace", "_bs_flags", "_steps_run_in_current_lease",
         "_scheduled_jobs_in_current_round", "_scheduled_jobs_in_prev_round",
         "_shockwave_job_completed", "_rounds_since_reopt", "_rng",
-        "_worker_type_shuffler", "_run_meta",
+        "_worker_type_shuffler", "_run_meta", "_profile_map",
+        "_whatif_knob_values",
         "_serving_tier", "_serving_job_ids", "_serving_replica_id_counter",
     )
     _PLANNER_SNAPSHOT_FIELDS = (
@@ -543,6 +578,21 @@ class Scheduler:
                     if f in planner_state:
                         setattr(self._shockwave_planner, f,
                                 planner_state[f])
+        # Re-apply what-if-tuned knob values AFTER the planner/tier are
+        # in place: the tuned state may live outside the snapshot
+        # fields (planner opts, health config) and the whatif_knob
+        # journal event may have been compacted behind this snapshot.
+        for name, value in getattr(self, "_whatif_knob_values",
+                                   {}).items():
+            try:
+                from ..whatif.knobs import get_knob
+                knob = get_knob(name)
+            except ValueError:
+                self.log.warning("snapshot carries tuned knob %r unknown "
+                                 "to this build; ignoring", name)
+                continue
+            if knob.applicable(self):
+                knob.set(self, float(value))
 
     def restore_from_durable_state(self, recovered) -> None:
         """Rebuild from a journal.RecoveredState: restore the snapshot,
@@ -598,6 +648,8 @@ class Scheduler:
             priority_weight=spec.get("priority_weight", 1.0),
             SLO=None if slo is None else float(slo),
             needs_data_dir=spec.get("needs_data_dir", False))
+        if "trace_position" in spec:
+            job.trace_position = int(spec["trace_position"])
         job_id = self.add_job(job, timestamp=data.get("ts"))
         if job_id.integer_job_id() != data["int_id"]:
             self.log.warning("replayed job id %s != journaled %s (journal "
@@ -717,6 +769,44 @@ class Scheduler:
             self._serving_tier.force_retire(int(data["int_id"]),
                                             float(data["ts"]))
 
+    def _emit_whatif_knob(self, knob: str, value: float, round: int,
+                          sweep: list) -> None:
+        """Journal a committed what-if knob value (called by the plane;
+        the emit lives here so the journal-coverage invariant sees the
+        emit/replay pair side by side). The value is also mirrored into
+        _whatif_knob_values so snapshots carry it past journal
+        compaction (restore_state re-applies it)."""
+        self._whatif_knob_values[knob] = float(value)
+        self._emit("whatif_knob", knob=knob, value=value, round=round,
+                   sweep=sweep)
+
+    def _emit_whatif_admission(self, record: dict) -> None:
+        """Journal one admission verdict (audit-only; decision evidence
+        for operators — the admission itself rides job_added)."""
+        self._emit_audit("whatif_admission", **record)
+
+    def _replay_whatif_knob(self, data: dict) -> None:
+        """Re-apply a what-if-tuned knob value: the tuning decision is
+        durable scheduler state (an operator-visible config change), so
+        a resumed scheduler must come back with the tuned value, not
+        the config default."""
+        from ..whatif.knobs import get_knob
+        try:
+            knob = get_knob(data["knob"])
+        except ValueError:
+            self.log.warning("journaled what-if knob %r unknown to this "
+                             "build; keeping the configured value",
+                             data.get("knob"))
+            return
+        self._whatif_knob_values[data["knob"]] = float(data["value"])
+        if knob.applicable(self):
+            knob.set(self, float(data["value"]))
+
+    def _replay_whatif_admission(self, data: dict) -> None:
+        pass  # audit record: the decision's effect (the admission
+        # itself / the deferred arrival time) is journaled via the
+        # ordinary job_added events
+
     def _emit_serving_retired(self, int_id: int, ts: float) -> None:
         """Journal a service retirement (called by the serving tier; the
         emit lives here so the journal-coverage invariant sees the
@@ -757,6 +847,13 @@ class Scheduler:
         self._job_id_counter += 1
         job.job_id = job_id
         int_id = job_id.integer_job_id()
+        pos = getattr(job, "trace_position", None)
+        if pos is not None and pos != int_id:
+            # Admission-order remap (see _profile_map): without this, a
+            # reordered service's id would positionally alias a TRAINING
+            # job's profile; mapped, _profile_for resolves to the
+            # service's own (None) profile slot.
+            self._profile_map[int_id] = int(pos)
         self._num_jobs_in_trace += 1
         ts = (timestamp if timestamp is not None
               else self.get_current_timestamp())
@@ -855,9 +952,13 @@ class Scheduler:
         self.rounds.num_queued_rounds[int_id] = 0
         self.rounds.job_start_round[int_id] = self.rounds.num_completed_rounds
 
+        pos = getattr(job, "trace_position", None)
+        if pos is not None and serving_params is None and pos != int_id:
+            self._profile_map[int_id] = int(pos)
+
         if self._shockwave_planner is not None and serving_params is None:
             from ..shockwave.metadata import JobMetadata
-            profile = self._profiles[int_id]
+            profile = self._profile_for(int_id)
             meta = JobMetadata(int_id, profile)
             meta.register_submit(ts)
             self._throughput_timeline[int_id] = collections.OrderedDict()
@@ -881,7 +982,9 @@ class Scheduler:
             num_steps_arg=job.num_steps_arg, total_steps=job.total_steps,
             duration=float(job._duration), scale_factor=job.scale_factor,
             mode=job.mode, priority_weight=job.priority_weight,
-            SLO=job.SLO, needs_data_dir=job.needs_data_dir))
+            SLO=job.SLO, needs_data_dir=job.needs_data_dir,
+            **({"trace_position": int(pos)} if pos is not None
+               and pos != int_id else {})))
         self.log.info("[Job dispatched] job %s (%s, sf=%d, mode=%s)",
                     job_id, job.job_type, job.scale_factor, job.mode)
         return job_id
@@ -1329,6 +1432,17 @@ class Scheduler:
 
     def _get_remaining_steps(self, job_id: JobIdPair) -> int:
         return self.acct.jobs[job_id].total_steps - self.acct.total_steps_run[job_id]
+
+    def _profile_for(self, int_id: int):
+        """The epoch profile for an integer job id, honoring the
+        admission-order remap (see _profile_map). None when no profile
+        exists (serving lines, out-of-range ids)."""
+        if self._profiles is None:
+            return None
+        idx = self._profile_map.get(int_id, int_id)
+        if 0 <= idx < len(self._profiles):
+            return self._profiles[idx]
+        return None
 
     def _select_jobs_for_round(self, worker_types: List[str],
                                reserved: Optional[Dict[str, int]] = None
@@ -1950,10 +2064,12 @@ class Scheduler:
         # _obs is excluded: its clock is a bound method of this
         # scheduler (pickling it would drag a ghost scheduler copy into
         # the checkpoint), and metrics are telemetry, not sim state — a
-        # resumed run keeps its own fresh bundle.
+        # resumed run keeps its own fresh bundle. _whatif likewise (it
+        # holds a scheduler back-reference and its logs are telemetry);
+        # a resumed run reconstructs the plane from config.
         write_durable(path, pickle.dumps({
             "scheduler": {k: v for k, v in self.__dict__.items()
-                          if k != "_obs"},
+                          if k not in ("_obs", "_whatif")},
             "queued": queued,
             "running": running,
             "remaining_jobs": remaining_jobs,
@@ -2064,6 +2180,12 @@ class Scheduler:
                 for _ in range(cluster_spec[worker_type] // chips):
                     self.register_worker(worker_type, num_chips=chips)
 
+            # Stamp trace positions: job ids are assigned at ADMISSION,
+            # and what-if admission deferral can reorder admissions, so
+            # the positional profile lookup rides this stamp (identity
+            # — and the stamp unused — on every non-deferring path).
+            for position, job in enumerate(jobs):
+                job.trace_position = position
             queued = list(zip(arrival_times, jobs))
             if any(b < a for (a, _), (b, _) in zip(queued, queued[1:])):
                 # Ids (and the positional profiles list) follow FILE
@@ -2083,199 +2205,297 @@ class Scheduler:
             running: List[tuple] = []
         num_trace_jobs = remaining_jobs + len(self._completed_jobs)
         checkpoint_saved = resume_from is not None
-        fault_queue = list(fault_events) if fault_events else []
+        return self._sim_event_loop(
+            queued, running, remaining_jobs, current_round,
+            num_trace_jobs=num_trace_jobs,
+            checkpoint_file=checkpoint_file,
+            checkpoint_threshold=checkpoint_threshold,
+            checkpoint_saved=checkpoint_saved,
+            forced_schedule=forced_schedule,
+            fault_queue=list(fault_events) if fault_events else [])
 
+    @staticmethod
+    def _requeue_deferred(queued: list, job, new_arrival: float) -> None:
+        """Re-insert a deferral-gated job keeping `queued` sorted by
+        arrival (stable: it lands AFTER same-arrival entries, so file
+        order among ties is preserved)."""
+        import bisect
+        idx = bisect.bisect_right([a for a, _ in queued], new_arrival)
+        queued.insert(idx, (new_arrival, job))
+
+    def _sim_event_loop(self, queued, running, remaining_jobs,
+                        current_round, num_trace_jobs: int = 0,
+                        checkpoint_file: Optional[str] = None,
+                        checkpoint_threshold: Optional[float] = None,
+                        checkpoint_saved: bool = True,
+                        forced_schedule=None, fault_queue=None,
+                        schedule_first: bool = False) -> float:
+        """The discrete-event loop `simulate()` runs — split out so a
+        what-if twin (whatif/fork.rollforward) can re-enter it from a
+        forked mid-run state. With ``schedule_first`` the first
+        iteration skips the event-advance head (checkpoint, clock,
+        drain, arrivals, faults) and immediately schedules a round at
+        the frozen clock: a twin forked at the simulator's clean round
+        boundary — heap drained, arrivals admitted, next round not yet
+        planned — continues exactly where its parent's loop stood.
+        Returns the final simulated timestamp (makespan semantics as
+        documented on simulate())."""
+        fault_queue = fault_queue or []
         forced_resolve = False
         while remaining_jobs > 0:
-            # Checkpoint at the top of the iteration so a resumed run
-            # re-enters the loop with byte-identical local state.
-            if (not checkpoint_saved and checkpoint_file is not None
-                    and checkpoint_threshold is not None and num_trace_jobs > 0
-                    and (num_trace_jobs - remaining_jobs) / num_trace_jobs
-                    >= checkpoint_threshold):
-                self.save_simulation_checkpoint(
-                    checkpoint_file, queued, running, remaining_jobs,
-                    current_round)
-                checkpoint_saved = True
-
-            next_arrival = queued[0][0] if queued else None
-
-            # Advance the clock to the next event.
-            max_ts = 0.0
-            if running and -running[0][0] > max_ts:
-                max_ts = -running[0][0]
-            if max_ts > 0:
-                if (self._deployment_faithful
-                        and self._sim_round_start is not None):
-                    # Wall-clocked rounds (see _deployment_faithful): a
-                    # round never rolls before its full duration even
-                    # when every micro-task finished early.
-                    max_ts = max(max_ts, self._sim_round_start
-                                 + self._time_per_iteration)
-                self._current_timestamp = max_ts
-                forced_resolve = False
-            elif next_arrival is not None:
-                # max(): a burned replay round may already have pushed
-                # the clock past this arrival — never rewind it.
-                target = max(self._current_timestamp, next_arrival)
-                if self._serving_live():
-                    # A live service must be consulted every round even
-                    # while idle — jumping straight to a far-future
-                    # arrival would skip its load ramp (no scale-up, no
-                    # SLO accounting for the gap). Bound the jump to one
-                    # round; the loop walks the rest round by round.
-                    target = min(target, self._current_timestamp
-                                 + self._time_per_iteration)
-                self._current_timestamp = target
-                forced_resolve = False
-            elif self.acct.jobs and not forced_resolve:
-                # Dead air: jobs are waiting but the allocation-reset
-                # interval hasn't elapsed, so the stale allocation excludes
-                # them all. Force a re-solve rather than deadlocking (the
-                # reference would crash here: its scheduler.py:1913 assigns
-                # a None timestamp).
-                forced_resolve = True
-                self._last_reset_time = (
-                    self._current_timestamp
-                    - self._config.minimum_time_between_allocation_resets)
-                self._need_to_update_allocation = True
-            elif self._serving_live():
-                # Nothing running and no arrivals, but a serving service
-                # is within its lifetime (possibly at zero replicas):
-                # roll the clock one round so the autoscaler keeps being
-                # consulted and the service can scale back up / retire.
-                self._current_timestamp += self._time_per_iteration
-                forced_resolve = False
-            elif fault_queue:
-                # Nothing can run until an injected fault resolves
-                # (e.g. every remaining job needs more chips than the
-                # surviving capacity): advance to the next fault event
-                # (typically a revive) instead of declaring deadlock.
-                self._current_timestamp = max(
-                    self._current_timestamp, float(fault_queue[0]["at"]))
-                forced_resolve = False
+            if schedule_first:
+                # Fork re-entry: the parent already ran this
+                # iteration's head before the fork point.
+                schedule_first = False
             else:
-                self.log.warning("no running jobs and no arrivals; stopping")
-                break
+                # Checkpoint at the top of the iteration so a resumed
+                # run re-enters the loop with byte-identical local
+                # state.
+                if (not checkpoint_saved and checkpoint_file is not None
+                        and checkpoint_threshold is not None
+                        and num_trace_jobs > 0
+                        and (num_trace_jobs - remaining_jobs)
+                        / num_trace_jobs >= checkpoint_threshold):
+                    self.save_simulation_checkpoint(
+                        checkpoint_file, queued, running, remaining_jobs,
+                        current_round)
+                    checkpoint_saved = True
 
-            # Drain jobs finishing this round.
-            while running:
-                neg_finish, job_id, worker_ids, all_num_steps, dispatch_time = running[0]
-                finish_time = -neg_finish
-                if finish_time > self._current_timestamp:
-                    break
-                slowdown = 1.0
-                # Time actually spent this round; using the dispatch timestamp
-                # (not the previous round's end) keeps idle cluster gaps and a
-                # nonzero first arrival from inflating the measurement.
-                execution_time = finish_time - dispatch_time
-                # Reference-parity flat post-preemption charge — replaced
-                # by the measured charges for calibrated worker types; an
-                # uncalibrated type in a partially calibrated oracle
-                # keeps the flat charge rather than costing nothing.
-                if current_round >= 2 and not self._worker_type_calibrated(
-                        self.workers.id_to_type[worker_ids[0]]):
-                    prev_sched = self.rounds.per_round_schedule[current_round - 2]
-                    for m in job_id.singletons():
-                        if not self._in_recorded_round(prev_sched,
-                                                       m.integer_job_id()):
-                            # Preempted last round: charge checkpoint/restore.
-                            if (execution_time != 0 and
-                                    self._time_per_iteration - 5 < execution_time):
-                                slowdown = ((execution_time - PREEMPTION_OVERHEAD_S)
-                                            / execution_time)
-                                execution_time -= PREEMPTION_OVERHEAD_S
-                            break
-                all_execution_times = []
-                for m in job_id.singletons():
-                    all_execution_times.append(execution_time)
-                    self.acct.latest_timestamps[m] = finish_time
-                self._in_progress_updates[job_id] = []
-                scale_factor = len(worker_ids)
-                adj_steps = [int(s * slowdown) for s in all_num_steps]
-                assigned = [0] * len(adj_steps)
-                per_worker_steps = []
-                for i in range(scale_factor):
-                    if i == scale_factor - 1:
-                        per_worker = [adj_steps[j] - assigned[j]
-                                      for j in range(len(adj_steps))]
-                    else:
-                        per_worker = [s // scale_factor for s in adj_steps]
-                    for j in range(len(per_worker)):
-                        assigned[j] += per_worker[j]
-                    per_worker_steps.append(per_worker)
-                if self._vectorized:
-                    simcore.complete_microtask_batch(
-                        self, job_id, worker_ids, per_worker_steps,
-                        all_execution_times)
+                next_arrival = queued[0][0] if queued else None
+
+                # Advance the clock to the next event.
+                max_ts = 0.0
+                if running and -running[0][0] > max_ts:
+                    max_ts = -running[0][0]
+                if max_ts > 0:
+                    if (self._deployment_faithful
+                            and self._sim_round_start is not None):
+                        # Wall-clocked rounds (see _deployment_faithful):
+                        # a round never rolls before its full duration
+                        # even when every micro-task finished early.
+                        max_ts = max(max_ts, self._sim_round_start
+                                     + self._time_per_iteration)
+                    self._current_timestamp = max_ts
+                    forced_resolve = False
+                elif next_arrival is not None:
+                    # max(): a burned replay round may already have
+                    # pushed the clock past this arrival — never rewind
+                    # it.
+                    target = max(self._current_timestamp, next_arrival)
+                    if self._serving_live():
+                        # A live service must be consulted every round
+                        # even while idle — jumping straight to a
+                        # far-future arrival would skip its load ramp
+                        # (no scale-up, no SLO accounting for the gap).
+                        # Bound the jump to one round; the loop walks
+                        # the rest round by round.
+                        target = min(target, self._current_timestamp
+                                     + self._time_per_iteration)
+                    self._current_timestamp = target
+                    forced_resolve = False
+                elif self.acct.jobs and not forced_resolve:
+                    # Dead air: jobs are waiting but the allocation-
+                    # reset interval hasn't elapsed, so the stale
+                    # allocation excludes them all. Force a re-solve
+                    # rather than deadlocking (the reference would
+                    # crash here: its scheduler.py:1913 assigns a None
+                    # timestamp).
+                    forced_resolve = True
+                    self._last_reset_time = (
+                        self._current_timestamp
+                        - self._config
+                        .minimum_time_between_allocation_resets)
+                    self._need_to_update_allocation = True
+                elif self._serving_live():
+                    # Nothing running and no arrivals, but a serving
+                    # service is within its lifetime (possibly at zero
+                    # replicas): roll the clock one round so the
+                    # autoscaler keeps being consulted and the service
+                    # can scale back up / retire.
+                    self._current_timestamp += self._time_per_iteration
+                    forced_resolve = False
+                elif fault_queue:
+                    # Nothing can run until an injected fault resolves
+                    # (e.g. every remaining job needs more chips than
+                    # the surviving capacity): advance to the next
+                    # fault event (typically a revive) instead of
+                    # declaring deadlock.
+                    self._current_timestamp = max(
+                        self._current_timestamp,
+                        float(fault_queue[0]["at"]))
+                    forced_resolve = False
                 else:
-                    for i, worker_id in enumerate(worker_ids):
-                        self.done_callback(job_id, worker_id,
-                                           per_worker_steps[i],
-                                           all_execution_times)
-                for m in job_id.singletons():
-                    if m not in self.acct.jobs:
-                        remaining_jobs -= 1
-                heapq.heappop(running)
-
-            # Adaptation oracles run between rounds.
-            for job_id in list(self.acct.jobs):
-                mode = self.acct.jobs[job_id].mode
-                if mode == "accordion":
-                    self._simulate_accordion(job_id)
-                elif mode == "gns":
-                    self._simulate_gns(job_id)
-
-            if (self._shockwave_planner is not None
-                    and self._current_timestamp != 0.0
-                    and self._scheduled_jobs_in_current_round is not None):
-                self._update_shockwave_planner()
-
-            assert not running
-
-            # Admit arrivals.
-            while queued and queued[0][0] <= self._current_timestamp:
-                arrival_time, job = queued.pop(0)
-                self.add_job(job, timestamp=arrival_time)
-
-            # Apply due fault-injection events (sweep scenarios only;
-            # the queue is empty on the canonical replay path).
-            while (fault_queue
-                   and float(fault_queue[0]["at"]) <= self._current_timestamp):
-                event = fault_queue.pop(0)
-                if event.get("kill"):
-                    self.deregister_workers([int(w) for w in event["kill"]])
-                    self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
-                                  action="kill")
-                if event.get("revive"):
-                    self.revive_workers([int(w) for w in event["revive"]],
-                                        event["worker_type"])
-                    self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
-                                  action="revive")
-                if event.get("degrade"):
-                    factor = float(event.get("factor", 0.1))
-                    if not 0.0 < factor <= 1.0:
-                        raise ValueError(f"degrade factor must be in "
-                                         f"(0, 1], got {factor!r}")
-                    for w in event["degrade"]:
-                        self._sim_degraded[int(w)] = factor
-                    self.log.warning("[Fault] chips %s degraded to "
-                                     "%.2fx speed", list(event["degrade"]),
-                                     factor)
-                    self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
-                                  action="degrade")
-                if event.get("restore"):
-                    for w in event["restore"]:
-                        self._sim_degraded.pop(int(w), None)
-                    self.log.info("[Fault] chips %s restored to full "
-                                  "speed", list(event["restore"]))
-                    self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
-                                  action="restore")
-
-            if not self.acct.jobs and not self._serving_live():
-                if not queued:
+                    self.log.warning("no running jobs and no arrivals; "
+                                     "stopping")
                     break
-                continue
+
+                # Drain jobs finishing this round.
+                while running:
+                    (neg_finish, job_id, worker_ids, all_num_steps,
+                     dispatch_time) = running[0]
+                    finish_time = -neg_finish
+                    if finish_time > self._current_timestamp:
+                        break
+                    slowdown = 1.0
+                    # Time actually spent this round; using the dispatch
+                    # timestamp (not the previous round's end) keeps
+                    # idle cluster gaps and a nonzero first arrival from
+                    # inflating the measurement.
+                    execution_time = finish_time - dispatch_time
+                    # Reference-parity flat post-preemption charge —
+                    # replaced by the measured charges for calibrated
+                    # worker types; an uncalibrated type in a partially
+                    # calibrated oracle keeps the flat charge rather
+                    # than costing nothing.
+                    if (current_round >= 2
+                            and not self._worker_type_calibrated(
+                                self.workers.id_to_type[worker_ids[0]])):
+                        prev_sched = self.rounds.per_round_schedule[
+                            current_round - 2]
+                        for m in job_id.singletons():
+                            if not self._in_recorded_round(
+                                    prev_sched, m.integer_job_id()):
+                                # Preempted last round: charge
+                                # checkpoint/restore. The charge must
+                                # never exceed the round itself (a
+                                # sub-20s round would go NEGATIVE and
+                                # synthesize a failure); at canonical
+                                # 120s rounds the near-full-round guard
+                                # already implies this, so the replay
+                                # math is untouched.
+                                if (execution_time
+                                        > PREEMPTION_OVERHEAD_S and
+                                        self._time_per_iteration - 5
+                                        < execution_time):
+                                    slowdown = ((execution_time
+                                                 - PREEMPTION_OVERHEAD_S)
+                                                / execution_time)
+                                    execution_time -= PREEMPTION_OVERHEAD_S
+                                break
+                    all_execution_times = []
+                    for m in job_id.singletons():
+                        all_execution_times.append(execution_time)
+                        self.acct.latest_timestamps[m] = finish_time
+                    self._in_progress_updates[job_id] = []
+                    scale_factor = len(worker_ids)
+                    adj_steps = [int(s * slowdown) for s in all_num_steps]
+                    assigned = [0] * len(adj_steps)
+                    per_worker_steps = []
+                    for i in range(scale_factor):
+                        if i == scale_factor - 1:
+                            per_worker = [adj_steps[j] - assigned[j]
+                                          for j in range(len(adj_steps))]
+                        else:
+                            per_worker = [s // scale_factor
+                                          for s in adj_steps]
+                        for j in range(len(per_worker)):
+                            assigned[j] += per_worker[j]
+                        per_worker_steps.append(per_worker)
+                    if self._vectorized:
+                        simcore.complete_microtask_batch(
+                            self, job_id, worker_ids, per_worker_steps,
+                            all_execution_times)
+                    else:
+                        for i, worker_id in enumerate(worker_ids):
+                            self.done_callback(job_id, worker_id,
+                                               per_worker_steps[i],
+                                               all_execution_times)
+                    for m in job_id.singletons():
+                        if m not in self.acct.jobs:
+                            remaining_jobs -= 1
+                    heapq.heappop(running)
+
+                # Adaptation oracles run between rounds.
+                for job_id in list(self.acct.jobs):
+                    mode = self.acct.jobs[job_id].mode
+                    if mode == "accordion":
+                        self._simulate_accordion(job_id)
+                    elif mode == "gns":
+                        self._simulate_gns(job_id)
+
+                if (self._shockwave_planner is not None
+                        and self._current_timestamp != 0.0
+                        and self._scheduled_jobs_in_current_round
+                        is not None):
+                    self._update_shockwave_planner()
+
+                assert not running
+
+                # Admit arrivals — through the what-if admission gate
+                # when a plane is configured (mode "gate" may defer a
+                # candidate by re-queueing it at a later arrival; the
+                # default always-admit plane returns 0.0 untouched).
+                while queued and queued[0][0] <= self._current_timestamp:
+                    arrival_time, job = queued.pop(0)
+                    if self._whatif is not None:
+                        defer_s = self._whatif.gate_admission(
+                            job, arrival_time, queued)
+                        if defer_s > 0:
+                            if not hasattr(job, "deferred_from"):
+                                # First deferral: remember the ORIGINAL
+                                # arrival — the job's fairness clock.
+                                job.deferred_from = arrival_time
+                            self._requeue_deferred(
+                                queued, job,
+                                self._current_timestamp + defer_s)
+                            continue
+                    # A deferred job is admitted AT ITS ORIGINAL
+                    # ARRIVAL stamp: start_timestamps (and therefore
+                    # JCT, FTF rho and the SLO deadline) include every
+                    # second the gate made it wait — admission control
+                    # must beat always-admit on honest accounting, not
+                    # by laundering queueing delay out of the metric.
+                    self.add_job(job, timestamp=getattr(
+                        job, "deferred_from", arrival_time))
+
+                # Apply due fault-injection events (sweep scenarios
+                # only; the queue is empty on the canonical replay
+                # path).
+                while (fault_queue and float(fault_queue[0]["at"])
+                        <= self._current_timestamp):
+                    event = fault_queue.pop(0)
+                    if event.get("kill"):
+                        self.deregister_workers(
+                            [int(w) for w in event["kill"]])
+                        self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
+                                      action="kill")
+                    if event.get("revive"):
+                        self.revive_workers(
+                            [int(w) for w in event["revive"]],
+                            event["worker_type"])
+                        self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
+                                      action="revive")
+                    if event.get("degrade"):
+                        factor = float(event.get("factor", 0.1))
+                        if not 0.0 < factor <= 1.0:
+                            raise ValueError(f"degrade factor must be in "
+                                             f"(0, 1], got {factor!r}")
+                        for w in event["degrade"]:
+                            self._sim_degraded[int(w)] = factor
+                        self.log.warning("[Fault] chips %s degraded to "
+                                         "%.2fx speed",
+                                         list(event["degrade"]), factor)
+                        self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
+                                      action="degrade")
+                    if event.get("restore"):
+                        for w in event["restore"]:
+                            self._sim_degraded.pop(int(w), None)
+                        self.log.info("[Fault] chips %s restored to full "
+                                      "speed", list(event["restore"]))
+                        self._obs.inc(obs_names.SIM_FAULT_EVENTS_TOTAL,
+                                      action="restore")
+
+                if not self.acct.jobs and not self._serving_live():
+                    if not queued:
+                        break
+                    continue
+
+                # The clean fork point: heap drained, arrivals
+                # admitted, next round not yet planned. Knob sweeps,
+                # forecasts and the capture hook run here.
+                if self._whatif is not None:
+                    self._whatif.on_round_boundary(current_round, queued,
+                                                   remaining_jobs)
 
             # Schedule the next round.
             if (forced_schedule is not None
@@ -2534,8 +2754,9 @@ class Scheduler:
             if completion_time is None:
                 continue
             int_id = job_id.integer_job_id()
-            exclusive = sum(self._profiles[int_id]["duration_every_epoch"]) \
-                if self._profiles else None
+            profile = self._profile_for(int_id)
+            exclusive = (sum(profile["duration_every_epoch"])
+                         if profile is not None else None)
             if exclusive is None:
                 continue
             static_cf = max(1.0, self._num_jobs_in_trace / num_chips)

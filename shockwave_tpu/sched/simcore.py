@@ -341,6 +341,46 @@ def complete_microtask_batch(sched, job_id, worker_ids: Sequence[int],
     sched._finalize_microtask(job_id, worker_type, scale_factor, updates)
 
 
+def projected_unfairness(sched, now: float,
+                         cf: Optional[float] = None) -> float:
+    """Worst elapsed-so-far finish-time-fairness lower bound over the
+    ACTIVE (non-serving) jobs: elapsed / (exclusive * static contention)
+    — the what-if plane's starvation signal for jobs that have not
+    completed within a rollout horizon (completed jobs carry their real
+    rho, scored in the plane). `cf` pins the contention factor: an
+    admission decision must compare its with/without legs under ONE
+    reference (the candidate-inclusive trace count), not each twin's
+    own drifting count. One vectorized pass; a K-sample admission
+    decision scores this for every candidate rollout, so the per-job
+    Python loop would sit on the decision's critical path at fleet
+    scale."""
+    profiles = sched._profiles
+    num_chips = len(sched.workers.worker_ids)
+    if not profiles or not num_chips:
+        return 0.0
+    serving = sched._serving_job_ids
+    starts = sched.acct.start_timestamps
+    # _profile_for: honors the admission-order remap; None for serving
+    # trace lines (no epoch structure) and out-of-range ids.
+    entries = [(j, sched._profile_for(j.integer_job_id()))
+               for j in sched.acct.jobs if j not in serving]
+    rows = [(starts[j], sum(p["duration_every_epoch"]))
+            for j, p in entries if p is not None]
+    if not rows:
+        return 0.0
+    start = np.fromiter((r[0] for r in rows), dtype=np.float64,
+                        count=len(rows))
+    exclusive = np.fromiter((r[1] for r in rows), dtype=np.float64,
+                            count=len(rows))
+    if cf is None:
+        cf = max(1.0, sched._num_jobs_in_trace / num_chips)
+    valid = exclusive > 0.0
+    if not valid.any():
+        return 0.0
+    rho = (now - start[valid]) / (exclusive[valid] * cf)
+    return float(np.max(rho))
+
+
 def simulate_gns(sched, job_id) -> None:
     """O(1)-per-epoch GNS oracle: same decision as the scalar
     ``_simulate_gns`` (which rebuilds the whole per-epoch schedule every

@@ -247,6 +247,18 @@ class ServingTier:
     def _obs(self):
         return self._sched.obs
 
+    def set_headroom(self, headroom: float) -> None:
+        """Live-retune the autoscaler headroom (the what-if plane's
+        flagship knob, whatif/knobs.py). Every service's Autoscaler
+        holds a reference to this tier's shared AutoscalerConfig, so
+        one assignment changes the NEXT target computation everywhere;
+        committed replica levels and hysteresis counters are untouched
+        (the new headroom phases in through the ordinary scale-down
+        patience window rather than flapping the pools)."""
+        if headroom <= 0:
+            raise ValueError(f"headroom must be positive, got {headroom!r}")
+        self.autoscaler_config.headroom = float(headroom)
+
     # ------------------------------------------------------------------
     # Round planning
     # ------------------------------------------------------------------

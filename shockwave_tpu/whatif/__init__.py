@@ -1,0 +1,14 @@
+"""Online what-if control plane: digital-twin forks of the live
+scheduler rolled forward in-memory (README "What-if control plane").
+
+- fork.py — the one fork primitive (capture / thaw / rollforward /
+  load_twin), reusing the journal snapshot serializer.
+- plane.py — WhatIfPlane: Monte-Carlo admission control, knob
+  auto-tuning, forecasts, shadow chaos.
+- knobs.py — the tunable-knob surface (autoscaler headroom, solver
+  budget, quarantine backoff).
+"""
+from . import fork, knobs
+from .plane import WhatIfConfig, WhatIfPlane
+
+__all__ = ["fork", "knobs", "WhatIfConfig", "WhatIfPlane"]

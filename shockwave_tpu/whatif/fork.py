@@ -1,0 +1,222 @@
+"""State forking: detach a digital twin of a live scheduler.
+
+One fork primitive serves the whole what-if plane (plane.py), the
+mid-run sweep seeding (`sweep_scenarios.py --from_state`) and the twin
+shadow validator (`chaos_campaign.py --twin_schedules`):
+
+- `capture(sched)` pickles the scheduler's journal snapshot
+  (`Scheduler.snapshot_state` — the SAME serializer crash recovery
+  uses, no second one) into a detached blob. This is the only step
+  that must run under the scheduler lock in physical mode; it is
+  instrumented as the `whatif_fork` round phase and the
+  `swtpu_whatif_fork_seconds` histogram so the lock hold-time it adds
+  is first-class telemetry.
+- `thaw(sched, blob)` builds a fresh SIMULATION-mode scheduler and
+  restores the blob into it (`restore_state`). The twin shares the
+  parent's read-only oracle/calibration tables and profiles by
+  reference; everything mutable arrives through the pickle round trip,
+  so the twin cannot write back into the live scheduler.
+- `rollforward(twin, ...)` re-enters the simulator's event loop from
+  the forked round boundary (`Scheduler._sim_event_loop` with
+  ``schedule_first=True``: the first action is scheduling a round at
+  the frozen clock, exactly what the parent would have done next), with
+  an optional horizon bound and fault-event injection.
+- `load_twin(...)` seeds a twin from durable state on disk instead of
+  a live object: a journal state dir (snapshot + replay, conservative
+  round-boundary re-entry, like crash recovery) or a simulation
+  checkpoint file (exact resume, in-flight micro-task heap included).
+
+Twins never journal (no durability layer is attached), never own a
+what-if plane themselves (``whatif=None`` — no recursive forking), and
+carry their own fresh Observability bundle on the virtual clock.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import replace
+from typing import List, Optional, Sequence, Tuple
+
+from ..obs import names as obs_names
+
+#: Attributes the twin shares with its parent BY REFERENCE: read-only
+#: oracle/calibration tables (nothing in the rollforward path mutates
+#: them) and the positional profiles list. Everything mutable rides the
+#: snapshot pickle instead.
+_SHARED_READONLY_ATTRS = (
+    "_oracle_throughputs", "_dispatch_overhead",
+    "_dispatch_overhead_by_type", "_lease_shortfall",
+    "_shortfall_by_type", "_round_drain", "_round_drain_by_type",
+    "_round_drain_by_sf", "_deployment_faithful", "_profiles",
+)
+
+
+def capture(sched) -> bytes:
+    """Freeze the scheduler's durable state into a detached blob.
+
+    Physical callers hold the scheduler lock; the copy is the only
+    lock-held cost of a fork (thawing and rolling happen on detached
+    data). The policy rides along so the twin continues with the exact
+    policy state (internal RNG included) the parent had at the fork.
+    """
+    with sched.obs.phase(obs_names.SPAN_WHATIF_FORK,
+                         round=sched.rounds.num_completed_rounds):
+        t0 = sched.obs.clock()
+        blob = pickle.dumps(
+            {"state": sched.snapshot_state(),
+             "policy": sched._policy,
+             "clock": sched.get_current_timestamp(),
+             "sim_round_start": getattr(sched, "_sim_round_start", None)},
+            protocol=pickle.HIGHEST_PROTOCOL)
+        sched.obs.observe(obs_names.WHATIF_FORK_SECONDS,
+                          max(sched.obs.clock() - t0, 0.0))
+    return blob
+
+
+def twin_config(config):
+    """The twin's SchedulerConfig: the parent's, with everything that
+    would touch the outside world (journal, obs endpoint, trace export)
+    or recurse (the what-if plane itself) stripped, and the horizon
+    bound cleared for the rollforward to set."""
+    return replace(config, whatif=None, state_dir=None, resume=False,
+                   obs_port=None, obs_trace_path=None, max_rounds=None,
+                   snapshot_interval_rounds=0)
+
+
+def thaw(sched, blob: bytes, seed: Optional[int] = None):
+    """Materialize one detached twin from a captured blob.
+
+    `seed` != None reseeds the twin's tie-break RNGs (worker-type
+    shuffler + scheduler RNG) — the Monte-Carlo axis of a K-sample
+    rollout set; None keeps the parent's exact RNG state (the fidelity
+    contract: a seedless twin continues bit-identically).
+    """
+    from ..sched.scheduler import Scheduler
+    payload = pickle.loads(blob)
+    twin = Scheduler(payload["policy"], simulate=True,
+                     profiles=sched._profiles,
+                     config=twin_config(sched._config))
+    for attr in _SHARED_READONLY_ATTRS:
+        setattr(twin, attr, getattr(sched, attr))
+    twin.restore_state(payload["state"])
+    # A physical parent's clock is wall time (the `_current_timestamp`
+    # field it snapshots is stale); every parent's live clock rides the
+    # blob explicitly, so the twin's virtual clock continues from the
+    # fork instant in either mode.
+    twin._current_timestamp = payload["clock"]
+    twin._sim_round_start = payload["sim_round_start"]
+    if not sched._simulate:
+        # Physical parent: the restored allocation is whatever the
+        # async allocation thread last committed, and the reset stamp
+        # is wall-clock — a short twin horizon would never re-solve
+        # even as the twin's own decisions free or claim capacity.
+        # Re-enter conservatively (the crash-recovery stance): re-plan
+        # on the first round and whenever twin-side state changes
+        # demand it. Simulation parents keep their exact fields — the
+        # fidelity contract requires the twin to re-solve exactly when
+        # the parent would have.
+        twin._need_to_update_allocation = True
+        twin._last_reset_time = 0.0
+        # The live physical scheduler re-solves continuously on its
+        # allocation thread; the virtual twin only re-solves at the
+        # reset interval, which can exceed a whole rollout horizon.
+        # Round-granularity resets keep the twin's allocation tracking
+        # its own capacity decisions (serving scale-ups/downs) the way
+        # the live allocation thread would.
+        twin._config = replace(
+            twin._config,
+            minimum_time_between_allocation_resets=twin
+            ._time_per_iteration)
+    if seed is not None:
+        import random as _random
+
+        import numpy as _np
+        twin._rng = _np.random.RandomState(seed)
+        twin._worker_type_shuffler = _random.Random(seed + 5)
+    return twin
+
+
+def fork_twin(sched, seed: Optional[int] = None):
+    """capture + thaw in one call (simulation-mode callers; physical
+    callers split the two around the lock)."""
+    return thaw(sched, capture(sched), seed=seed)
+
+
+def default_remaining_jobs(twin, queued: Sequence = ()) -> int:
+    """A remaining-work count for re-entering the event loop: active
+    non-serving jobs, live services, and not-yet-admitted arrivals.
+    The loop only needs it positive while work exists — the
+    empty-system break is the real exit — but an exact count keeps the
+    deployment-faithful exit-clock rewind armed."""
+    active = sum(1 for j in twin.acct.jobs if j not in twin._serving_job_ids)
+    services = (sum(1 for s in twin._serving_tier.services.values()
+                    if not s.retired)
+                if twin._serving_tier is not None else 0)
+    return active + services + len(queued)
+
+
+def rollforward(twin, queued: Sequence[Tuple[float, object]] = (),
+                running: Optional[List[tuple]] = None,
+                horizon_rounds: Optional[int] = None,
+                fault_events: Optional[Sequence[dict]] = None,
+                remaining_jobs: Optional[int] = None,
+                schedule_first: Optional[bool] = None) -> float:
+    """Roll a thawed twin forward on the virtual clock.
+
+    With `horizon_rounds` the rollout stops after that many additional
+    rounds; None runs the twin's workload to drain. `queued` is the
+    not-yet-admitted arrival tail (deep-copy it first if the caller
+    reuses the jobs — ``simulate`` mutates Job objects). `running` is a
+    checkpoint's in-flight micro-task heap (exact resume); with the
+    default empty heap the first action is scheduling a fresh round at
+    the frozen clock (``schedule_first``), which is exactly what the
+    parent's loop would do next at a fork point. Returns the twin's
+    clock at exit (the horizon end, or the drain makespan).
+    """
+    if horizon_rounds is not None:
+        twin._config.max_rounds = (twin.rounds.num_completed_rounds
+                                   + int(horizon_rounds))
+    running = list(running or [])
+    if schedule_first is None:
+        # An exact checkpoint resume re-enters at the loop head (its
+        # heap drains first); a boundary fork schedules immediately.
+        schedule_first = not running
+    if remaining_jobs is None:
+        remaining_jobs = default_remaining_jobs(twin, queued)
+    if remaining_jobs <= 0:
+        return twin.get_current_timestamp()
+    with twin.obs.span(obs_names.SPAN_WHATIF_ROLLOUT):
+        return twin._sim_event_loop(
+            list(queued), running, remaining_jobs,
+            twin.rounds.num_completed_rounds,
+            fault_queue=list(fault_events or []),
+            schedule_first=schedule_first)
+
+
+def load_twin(path: str, policy, profiles, config,
+              throughputs_file: Optional[str] = None
+              ) -> Tuple[object, list, list, Optional[int]]:
+    """Seed a twin from durable state on disk.
+
+    `path` is either a journal state DIR (snapshot.pkl + journal
+    segments — restored via ``restore_from_durable_state``, then
+    re-entered conservatively at a round boundary, the same contract
+    crash recovery honors) or a simulation CHECKPOINT file (the full
+    pickled simulator, resumed exactly — in-flight heap included).
+    Returns ``(twin, queued, running, remaining_jobs)``;
+    `remaining_jobs` is None for state dirs (derive from the twin).
+    """
+    from ..sched.scheduler import Scheduler
+    # Unlike thaw() there is no live parent to share oracle tables
+    # with, so the twin reads the throughputs file itself (replayed
+    # job_added events re-derive initial throughputs from it).
+    twin = Scheduler(policy, simulate=True, profiles=profiles,
+                     throughputs_file=throughputs_file,
+                     config=twin_config(config))
+    if os.path.isdir(path):
+        from ..sched import journal
+        twin.restore_from_durable_state(journal.load_state(path))
+        return twin, [], [], None
+    queued, running, remaining_jobs, _ = (
+        twin._load_simulation_checkpoint(path))
+    return twin, queued, running, remaining_jobs

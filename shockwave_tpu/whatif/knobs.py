@@ -1,0 +1,113 @@
+"""Live-scheduler knobs the what-if plane may auto-tune.
+
+One tiny interface — ``applicable`` / ``get`` / ``set`` over a
+scheduler (live or twin) — so the tuning sweep in plane.py is generic:
+it forks a twin per candidate value, sets the knob ON THE TWIN, rolls
+the horizon, and commits the winner to the live scheduler through the
+same ``set``. The committed value is journaled (`whatif_knob`) so a
+resumed scheduler re-applies it.
+
+Shipped knobs:
+
+- ``autoscaler_headroom`` — the serving autoscaler's peak-rate
+  multiplier (serving/autoscaler.py). The flagship: serving dynamics
+  are fully modeled in the twin, so the sweep sees real SLO/capacity
+  trade-offs.
+- ``solver_budget_rounds`` — the Shockwave MILP budget cap
+  (shockwave/milp.MilpOptions.budget_cap_rounds). Behind the same
+  interface; note the solve budget is a WALL-clock bound, which the
+  virtual-clock twin cannot price — sweeps over it measure schedule
+  quality only.
+- ``quarantine_backoff_s`` — the gray-failure quarantine release
+  backoff (runtime/resilience.HealthConfig). Physical-only state; on a
+  simulation twin ``set`` is a recorded no-op (the sim has no health
+  layer), so twin sweeps cannot differentiate it yet — the knob exists
+  so the physical plane can journal operator-visible changes through
+  one mechanism.
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Dict, Sequence
+
+
+class Knob:
+    """One tunable: pure accessors, no state."""
+
+    name: str = ""
+    #: Default candidate grid (config may override).
+    candidates: Sequence[float] = ()
+
+    def applicable(self, sched) -> bool:
+        raise NotImplementedError
+
+    def get(self, sched) -> float:
+        raise NotImplementedError
+
+    def set(self, sched, value: float) -> None:
+        raise NotImplementedError
+
+
+class AutoscalerHeadroomKnob(Knob):
+    name = "autoscaler_headroom"
+    candidates = (1.0, 1.15, 1.3, 1.6, 2.0)
+
+    def applicable(self, sched) -> bool:
+        return sched._serving_tier is not None
+
+    def get(self, sched) -> float:
+        return float(sched._serving_tier.autoscaler_config.headroom)
+
+    def set(self, sched, value: float) -> None:
+        sched._serving_tier.set_headroom(float(value))
+
+
+class SolverBudgetKnob(Knob):
+    name = "solver_budget_rounds"
+    candidates = (0.5, 1.0, 2.0)
+
+    def applicable(self, sched) -> bool:
+        return sched._shockwave_planner is not None
+
+    def get(self, sched) -> float:
+        return float(sched._shockwave_planner.opts.budget_cap_rounds)
+
+    def set(self, sched, value: float) -> None:
+        planner = sched._shockwave_planner
+        planner.opts = replace(planner.opts,
+                               budget_cap_rounds=float(value))
+
+
+class QuarantineBackoffKnob(Knob):
+    name = "quarantine_backoff_s"
+    candidates = (60.0, 120.0, 300.0)
+
+    def applicable(self, sched) -> bool:
+        # Live physical schedulers carry the health config; a sim twin
+        # does not (set() below is then a no-op by construction).
+        return getattr(sched, "_health_enabled", False)
+
+    def get(self, sched) -> float:
+        return float(sched._health_cfg.quarantine_backoff_s)
+
+    def set(self, sched, value: float) -> None:
+        if not hasattr(sched, "_health_cfg"):
+            return  # simulation twin: no health layer to retune
+        sched._health_cfg = sched._health_cfg.with_quarantine_backoff(
+            float(value))
+        # Existing classifiers keep scoring against the updated config.
+        for health in sched._host_health.values():
+            health.config = sched._health_cfg
+
+
+KNOBS: Dict[str, Knob] = {k.name: k for k in (
+    AutoscalerHeadroomKnob(), SolverBudgetKnob(), QuarantineBackoffKnob())}
+
+
+def get_knob(name: str) -> Knob:
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown what-if knob {name!r}; known: {sorted(KNOBS)}"
+        ) from None

@@ -1,0 +1,687 @@
+"""The online what-if control plane: a scheduler that simulates itself.
+
+`WhatIfPlane` hangs off one scheduler (simulated or physical) and turns
+the PR 7 fast sim core into a live decision aid, all on the single fork
+primitive in fork.py:
+
+- **Monte-Carlo admission control** (``gate_admission``): at every
+  trace admission (training job or serving-service registration), fork
+  K seeded twins with and without the candidate, roll the horizon, and
+  admit/defer on an FTF-unfairness + serving-SLO envelope. The default
+  mode is ``always_admit`` — the gate never rolls a twin and the
+  canonical replays stay bit-identical.
+- **Knob auto-tuning** (``tune_knob``): every ``tune_interval_rounds``,
+  sweep one live knob (knobs.py) across candidate values on twin
+  rollouts and commit the winner; the sweep evidence is journaled as
+  the ``whatif_knob`` event, so a resumed scheduler re-applies the
+  chosen value.
+- **Forecasts** (``forecast_interval_rounds``): p50/p99 projected
+  drain-time and serving-attainment quantiles from K seeded rollouts,
+  exported as gauges and surfaced on /healthz.
+- **Shadow chaos** (``shadow_chaos``): each forecast cycle also rolls
+  one twin under a seeded injected fault (the PR 8 chaos action set)
+  and checks the zero-failure-charge invariant — a low-rate continuous
+  validator against the digital twin instead of the live cluster.
+
+Everything the plane decides is recorded in ``decision_log`` (drivers
+persist it into byte-reproducible artifacts) and is derived only from
+scheduler state + seeded RNG — no wall clocks, so identical runs make
+identical decisions (the determinism analyzer pass covers this
+package).
+"""
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import names as obs_names
+from . import fork
+from .knobs import get_knob
+
+#: Projected-rho cap: an active job with zero exclusive-duration data
+#: (or a stalled rollout) must not produce inf/nan in artifacts.
+RHO_CAP = 100.0
+
+
+@dataclass
+class WhatIfConfig:
+    """Plane knobs (SchedulerConfig.whatif block; unknown keys refuse
+    loudly, same contract as the serving/health configs)."""
+
+    #: Base seed of every twin-reseeding draw.
+    seed: int = 0
+    # ---- admission control ----
+    #: "always_admit" (default: never rolls a twin, bit-identical
+    #: replays) or "gate" (roll with/without the candidate and defer
+    #: over the envelope below).
+    admission: str = "always_admit"
+    admission_horizon_rounds: int = 12
+    #: Seeded rollout samples per decision leg (the Monte-Carlo width).
+    admission_samples: int = 2
+    #: Defer when the with-candidate worst projected rho exceeds this...
+    admission_rho_limit: float = 1.10
+    #: ...AND beats the without-candidate worst by at least this margin.
+    admission_min_gain: float = 0.02
+    #: Serving floor: defer when admitting drops projected horizon
+    #: attainment below this while deferring keeps it at or above.
+    admission_slo_floor: float = 0.999
+    #: Deferral granularity (rounds of the live round duration).
+    admission_defer_rounds: float = 2.0
+    #: A candidate deferred this many times is admitted regardless —
+    #: admission control trades queueing delay, never starvation.
+    admission_max_defers: int = 8
+    #: Candidate-slack guard: a candidate is only deferrable while its
+    #: accumulated wait (including the prospective deferral) stays
+    #: under this fraction of its fair-share budget (exclusive x
+    #: contention). Deferral wait counts INSIDE the deferred job's own
+    #: JCT/rho (the scheduler admits it at its ORIGINAL arrival), so
+    #: the gate must pick victims whose rho barely moves — large jobs —
+    #: rather than laundering small jobs' wait into the tail it is
+    #: trying to cut.
+    admission_wait_budget: float = 0.35
+    #: Fast path: admit without a rollout while requested chips
+    #: (active + candidate) stay at or under load_guard * cluster.
+    admission_load_guard: float = 1.0
+    # ---- knob auto-tuning ----
+    tune_knob: Optional[str] = None
+    tune_interval_rounds: int = 25
+    tune_horizon_rounds: int = 12
+    tune_samples: int = 1
+    #: Candidate grid override (default: the knob's own grid).
+    tune_candidates: Optional[Sequence[float]] = None
+    # ---- forecasts + shadow chaos ----
+    forecast_interval_rounds: int = 0
+    forecast_horizon_rounds: int = 15
+    forecast_samples: int = 3
+    shadow_chaos: bool = False
+    # ---- validation/test hook ----
+    #: Capture a detached (blob, queued, remaining) triple at this round
+    #: boundary (fork-fidelity tests and the chaos twin validator).
+    capture_at_round: Optional[int] = None
+
+    @classmethod
+    def from_dict(cls, config: Optional[dict]) -> "WhatIfConfig":
+        if not config:
+            return cls()
+        config = {k: v for k, v in config.items()
+                  if not k.startswith("_")}  # _comment keys, config-file
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(
+                f"unknown what-if option(s): {sorted(unknown)}")
+        cfg = cls(**config)
+        if cfg.admission not in ("always_admit", "gate"):
+            raise ValueError("whatif.admission must be 'always_admit' "
+                             f"or 'gate', got {cfg.admission!r}")
+        return cfg
+
+
+@dataclass
+class RolloutScore:
+    """One twin rollout, scored. Pure numbers (artifact-safe)."""
+
+    worst_rho: float
+    attainment: float
+    progress_steps: int
+    projected_drain_s: Optional[float]
+    completed: int
+
+    def as_dict(self) -> dict:
+        return {"worst_rho": round(self.worst_rho, 6),
+                "attainment": round(self.attainment, 6),
+                "progress_steps": int(self.progress_steps),
+                "projected_drain_s": (None if self.projected_drain_s is None
+                                      else round(self.projected_drain_s, 2)),
+                "completed": int(self.completed)}
+
+
+class WhatIfPlane:
+    """One scheduler's what-if plane. Simulation drives it through the
+    event loop's hooks; the physical scheduler captures under its lock
+    and rolls on a background thread (sched/physical.py)."""
+
+    def __init__(self, sched, config: Optional[dict] = None):
+        self._sched = sched
+        self.cfg = WhatIfConfig.from_dict(config)
+        self.decision_log: List[dict] = []
+        self.knob_log: List[dict] = []
+        self.forecast_log: List[dict] = []
+        self.shadow_log: List[dict] = []
+        self.max_fork_s = 0.0
+        self.forks = 0
+        self.rollouts = 0
+        #: capture_at_round output: (blob, queued_copy, remaining_jobs).
+        self.captured: Optional[Tuple[bytes, list, int]] = None
+        self._defer_counts: dict = {}
+        self._last_tune_round = -(10 ** 9)
+        self._last_forecast_round = -(10 ** 9)
+
+    # The plane never rides into snapshots/checkpoints (the scheduler
+    # excludes it, like _obs); nothing to __getstate__.
+
+    # ------------------------------------------------------------------
+    # Fork plumbing
+    # ------------------------------------------------------------------
+
+    def _capture(self) -> bytes:
+        import time as _time  # fork wall cost is telemetry, not state
+        t0 = _time.monotonic()  # swtpu-check: ignore[determinism]
+        blob = fork.capture(self._sched)
+        self.max_fork_s = max(
+            self.max_fork_s,
+            _time.monotonic() - t0)  # swtpu-check: ignore[determinism]
+        self.forks += 1
+        return blob
+
+    def _roll(self, blob: bytes, *, seed: Optional[int], purpose: str,
+              horizon: int, add_job=None, knob=None, knob_value=None,
+              fault_events=None,
+              cf: Optional[float] = None) -> RolloutScore:
+        sched = self._sched
+        twin = fork.thaw(sched, blob, seed=seed)
+        if knob is not None:
+            knob.set(twin, knob_value)
+        now0 = twin.get_current_timestamp()
+        steps0 = self._training_steps(twin)
+        completed0 = len(twin._completed_jobs)
+        serving0 = self._serving_totals(twin)
+        if add_job is not None:
+            # Detached candidate copy: the twin's add_job mutates it.
+            twin.add_job(pickle.loads(pickle.dumps(add_job)),
+                         timestamp=now0)
+        fork.rollforward(twin, horizon_rounds=horizon,
+                         fault_events=fault_events)
+        self.rollouts += 1
+        sched.obs.inc(obs_names.WHATIF_ROLLOUTS_TOTAL, purpose=purpose)
+        return self._score(twin, now0, steps0, completed0, serving0,
+                           cf=cf)
+
+    @staticmethod
+    def _training_steps(twin) -> int:
+        """Total training steps across ALL jobs ever admitted —
+        total_steps_run entries survive job completion, so a job
+        finishing mid-horizon keeps contributing to the progress/rate
+        deltas instead of making them go negative."""
+        return sum(steps for j, steps in twin.acct.total_steps_run.items()
+                   if j not in twin._serving_job_ids)
+
+    @staticmethod
+    def _serving_totals(twin) -> Tuple[float, float]:
+        if twin._serving_tier is None:
+            return (0.0, 0.0)
+        offered = sum(s.requests_offered
+                      for s in twin._serving_tier.services.values())
+        ok = sum(s.requests_ok
+                 for s in twin._serving_tier.services.values())
+        return (offered, ok)
+
+    def _score(self, twin, now0: float, steps0: int, completed0: int,
+               serving0: Tuple[float, float],
+               cf: Optional[float] = None) -> RolloutScore:
+        now1 = twin.get_current_timestamp()
+        # Worst-case FTF over the horizon: completed jobs by their real
+        # rho, still-active jobs by elapsed-so-far against their
+        # exclusive budget (a lower bound that catches starvation).
+        # `cf` pins one contention reference across a decision's
+        # with/without legs — each twin's own trace count differs by
+        # exactly the candidate, which would otherwise bias the
+        # comparison toward admitting.
+        from ..sched import simcore
+        num_chips = len(twin.workers.worker_ids)
+        if cf is None:
+            cf = (max(1.0, twin._num_jobs_in_trace / num_chips)
+                  if num_chips else 1.0)
+        worst = 0.0
+        if twin._profiles:
+            for j, ct in twin.acct.completion_times.items():
+                if ct is None or j in twin._serving_job_ids:
+                    continue
+                profile = twin._profile_for(j.integer_job_id())
+                if profile is None:
+                    continue  # serving lines carry no epoch profile
+                exclusive = sum(profile["duration_every_epoch"])
+                if exclusive > 0:
+                    worst = max(worst, ct / (exclusive * cf))
+        worst = max(worst, simcore.projected_unfairness(twin, now1,
+                                                        cf=cf))
+        worst = min(worst, RHO_CAP)
+        # Serving attainment over the horizon window only.
+        offered1, ok1 = self._serving_totals(twin)
+        d_offered = offered1 - serving0[0]
+        attainment = ((ok1 - serving0[1]) / d_offered
+                      if d_offered > 0 else 1.0)
+        active = [j for j in twin.acct.jobs
+                  if j not in twin._serving_job_ids]
+        steps1 = self._training_steps(twin)
+        remaining = sum(twin._get_remaining_steps(j) for j in active)
+        progress = max(steps1 - steps0, 0)
+        elapsed = now1 - now0
+        rate = (steps1 - steps0) / elapsed if elapsed > 0 else 0.0
+        if not active:
+            projected = twin._last_completion_time or now1
+        elif rate > 0:
+            projected = now1 + remaining / rate
+        else:
+            projected = None
+        return RolloutScore(
+            worst_rho=worst, attainment=attainment,
+            progress_steps=progress,
+            projected_drain_s=projected,
+            completed=len(twin._completed_jobs) - completed0)
+
+    def _seed(self, *parts: int) -> int:
+        out = self.cfg.seed & 0x7FFFFFFF
+        for p in parts:
+            out = (out * 1_000_003 + int(p)) & 0x7FFFFFFF
+        return out
+
+    # ------------------------------------------------------------------
+    # Monte-Carlo admission control (simulation event-loop hook)
+    # ------------------------------------------------------------------
+
+    def gate_admission(self, job, arrival_time: float, queued) -> float:
+        """Verdict for one candidate admission. Returns deferral
+        seconds (0.0 = admit now). Called from the simulator's arrival
+        loop; the heap is empty there, so the fork point is a clean
+        round boundary."""
+        sched = self._sched
+        cfg = self.cfg
+        if cfg.admission != "gate":
+            return 0.0
+        key = id(job)
+        now = sched.get_current_timestamp()
+        defers = self._defer_counts.get(key, 0)
+        if defers >= cfg.admission_max_defers:
+            self._log_admission(job, now, "admit", defers,
+                                reason="max_defers")
+            return 0.0
+        defer_s = cfg.admission_defer_rounds * sched._time_per_iteration
+        if not self._within_wait_budget(job, arrival_time, now, defer_s):
+            # Candidate-slack guard: another deferral would spend more
+            # of this job's own fair-share budget on waiting than the
+            # envelope could ever win back.
+            self._log_admission(job, now, "admit", defers,
+                                reason="wait_budget")
+            return 0.0
+        # Fast path: plenty of room — admit without paying a rollout.
+        chips = sum(sched.workers.cluster_spec.values())
+        demand = job.scale_factor + sum(
+            sched.acct.jobs[j].scale_factor for j in sched.acct.jobs
+            if j not in sched._serving_job_ids)
+        demand += sched._serving_tier.reserved_total() \
+            if sched._serving_tier is not None else 0
+        if chips <= 0 or demand <= cfg.admission_load_guard * chips:
+            self._log_admission(job, now, "fast_path", defers)
+            return 0.0
+
+        defer, reason, scores = self._evaluate_admission(
+            self._capture(), job, now)
+        decision = "defer" if defer else "admit"
+        self._log_admission(job, now, decision, defers, reason=reason,
+                            scores=scores)
+        if defer:
+            self._defer_counts[key] = defers + 1
+            return defer_s
+        return 0.0
+
+    def _within_wait_budget(self, job, arrival_time: float, now: float,
+                            defer_s: float) -> bool:
+        """Whether one more deferral keeps the candidate's accumulated
+        wait under admission_wait_budget of its fair-share budget.
+        Serving services carry no epoch profile — their deferral is
+        bounded by admission_max_defers alone."""
+        sched = self._sched
+        pos = getattr(job, "trace_position", None)
+        profiles = sched._profiles
+        if (pos is None or not profiles or pos >= len(profiles)
+                or profiles[pos] is None):
+            return True
+        exclusive = sum(profiles[pos]["duration_every_epoch"])
+        if exclusive <= 0:
+            return True
+        chips = len(sched.workers.worker_ids)
+        cf = (max(1.0, (sched._num_jobs_in_trace + 1) / chips)
+              if chips else 1.0)
+        waited = now - getattr(job, "deferred_from", arrival_time)
+        return ((waited + defer_s) / (exclusive * cf)
+                <= self.cfg.admission_wait_budget)
+
+    def _evaluate_admission(self, blob: bytes, job, now: float):
+        """The with-vs-without Monte-Carlo core shared by the
+        simulator's gate and the physical advisory path. Returns
+        (defer, reason, scores)."""
+        cfg = self.cfg
+        horizon = cfg.admission_horizon_rounds
+        # One candidate-inclusive contention reference for BOTH legs
+        # (see _score).
+        chips = len(self._sched.workers.worker_ids)
+        cf = (max(1.0, (self._sched._num_jobs_in_trace + 1) / chips)
+              if chips else 1.0)
+        with_c, without_c = [], []
+        for k in range(max(cfg.admission_samples, 1)):
+            seed = self._seed(round(now), k)
+            without_c.append(self._roll(blob, seed=seed,
+                                        purpose="admission",
+                                        horizon=horizon, cf=cf))
+            with_c.append(self._roll(blob, seed=seed, purpose="admission",
+                                     horizon=horizon, add_job=job, cf=cf))
+        worst_with = max(s.worst_rho for s in with_c)
+        worst_without = max(s.worst_rho for s in without_c)
+        att_with = min(s.attainment for s in with_c)
+        att_without = min(s.attainment for s in without_c)
+        defer = False
+        reason = None
+        if (worst_with > cfg.admission_rho_limit
+                and worst_with > worst_without + cfg.admission_min_gain):
+            defer, reason = True, "ftf_envelope"
+        elif (att_with < cfg.admission_slo_floor
+                and att_without >= cfg.admission_slo_floor):
+            defer, reason = True, "serving_slo"
+        scores = {"worst_rho_with": round(worst_with, 6),
+                  "worst_rho_without": round(worst_without, 6),
+                  "attainment_with": round(att_with, 6),
+                  "attainment_without": round(att_without, 6),
+                  "samples": len(with_c)}
+        return defer, reason, scores
+
+    def advise_admission(self, blob: bytes, job, now: float) -> dict:
+        """Physical-mode advisory verdict: the job was already admitted
+        (deferral is a simulation-loop mechanism); `blob` is the
+        PRE-admission fork its add_job captured, so the with/without
+        comparison means the same thing it does in the simulator. The
+        verdict lands in the decision log + journal as evidence."""
+        defer, reason, scores = self._evaluate_admission(blob, job, now)
+        decision = "would_defer" if defer else "admit"
+        record = {"t": round(now, 3), "job_type": job.job_type,
+                  "scale_factor": job.scale_factor, "mode": job.mode,
+                  "decision": decision, "advisory": True}
+        if reason:
+            record["reason"] = reason
+        record["scores"] = scores
+        self.decision_log.append(record)
+        self._sched.obs.inc(obs_names.WHATIF_ADMISSION_DECISIONS_TOTAL,
+                            decision=decision)
+        self._sched._emit_whatif_admission(record)
+        return record
+
+    def _log_admission(self, job, now: float, decision: str, defers: int,
+                       reason: Optional[str] = None,
+                       scores: Optional[dict] = None) -> None:
+        sched = self._sched
+        sched.obs.inc(obs_names.WHATIF_ADMISSION_DECISIONS_TOTAL,
+                      decision=decision)
+        record = {"t": round(now, 3), "job_type": job.job_type,
+                  "scale_factor": job.scale_factor,
+                  "mode": job.mode, "decision": decision,
+                  "defers_so_far": defers}
+        if reason:
+            record["reason"] = reason
+        if scores:
+            record["scores"] = scores
+        self.decision_log.append(record)
+        sched._emit_whatif_admission(record)
+
+    # ------------------------------------------------------------------
+    # Round-boundary work (knob tuning, forecasts, capture hook)
+    # ------------------------------------------------------------------
+
+    def on_round_boundary(self, current_round: int, queued,
+                          remaining_jobs: int) -> None:
+        """Simulation hook: runs in the event loop at the clean fork
+        point (heap drained, arrivals admitted, next round not yet
+        scheduled). Physical mode drives the same work through
+        maybe_capture_locked + run_background_step instead."""
+        cfg = self.cfg
+        if cfg.capture_at_round is not None \
+                and current_round == cfg.capture_at_round \
+                and self.captured is None:
+            self.captured = (self._capture(),
+                             pickle.loads(pickle.dumps(list(queued))),
+                             remaining_jobs)
+        if cfg.tune_knob is not None and (
+                current_round - self._last_tune_round
+                >= cfg.tune_interval_rounds):
+            self._last_tune_round = current_round
+            self.tune_once(current_round)
+        if cfg.forecast_interval_rounds and (
+                current_round - self._last_forecast_round
+                >= cfg.forecast_interval_rounds):
+            self._last_forecast_round = current_round
+            self.forecast_once(current_round)
+
+    def tune_once(self, current_round: int,
+                  blob: Optional[bytes] = None,
+                  commit_lock=None) -> Optional[dict]:
+        """One knob sweep: score every candidate on twin rollouts,
+        commit the winner to the live scheduler, journal the evidence.
+        Returns the sweep record (None when the knob does not apply
+        yet, e.g. headroom before any serving service exists).
+        `commit_lock` (physical mode) is taken around the live-state
+        commit only — rollouts run on detached twins."""
+        import contextlib
+        sched = self._sched
+        cfg = self.cfg
+        knob = get_knob(cfg.tune_knob)
+        if not knob.applicable(sched):
+            return None
+        if blob is None:
+            blob = self._capture()
+        current = knob.get(sched)
+        candidates = [float(v) for v in
+                      (cfg.tune_candidates or knob.candidates)]
+        if current not in candidates:
+            candidates = sorted(candidates + [current])
+        sweep = []
+        for value in candidates:
+            scores = [self._roll(blob,
+                                 seed=self._seed(current_round, i,
+                                                 int(value * 1000)),
+                                 purpose="tune",
+                                 horizon=cfg.tune_horizon_rounds,
+                                 knob=knob, knob_value=value)
+                      for i in range(max(cfg.tune_samples, 1))]
+            sweep.append({
+                "value": value,
+                # Worst case across samples: tuning must not commit a
+                # value whose tail behavior regresses.
+                "attainment": round(min(s.attainment for s in scores), 6),
+                "worst_rho": round(max(s.worst_rho for s in scores), 6),
+                "progress_steps": min(s.progress_steps for s in scores),
+            })
+
+        def objective(entry):
+            # Serve the SLO first, then keep training fair, then fast.
+            # Fairness compares at coarse (1%) granularity: sub-percent
+            # rho noise between candidate rollouts must not outrank a
+            # material training-progress difference.
+            return (entry["attainment"], -round(entry["worst_rho"], 2),
+                    entry["progress_steps"])
+
+        best = max(sweep, key=objective)
+        current_entry = next(e for e in sweep if e["value"] == current)
+        # Hysteresis: commit a CHANGE only on a strict objective win —
+        # ties keep the current value (no flapping between equals).
+        chosen = (best["value"]
+                  if objective(best) > objective(current_entry)
+                  else current)
+        changed = chosen != current
+        with (commit_lock if commit_lock is not None
+              else contextlib.nullcontext()):
+            if changed:
+                knob.set(sched, chosen)
+                sched.obs.inc(obs_names.WHATIF_KNOB_COMMITS_TOTAL,
+                              knob=knob.name)
+            sched.obs.set_gauge(obs_names.WHATIF_KNOB_VALUE, chosen,
+                                knob=knob.name)
+            record = {"round": current_round, "knob": knob.name,
+                      "previous": current, "chosen": chosen,
+                      "changed": changed, "sweep": sweep}
+            self.knob_log.append(record)
+            # Durable (replayed) event: a resumed scheduler re-applies
+            # the chosen value before its first round.
+            sched._emit_whatif_knob(knob=knob.name, value=chosen,
+                                    round=current_round, sweep=sweep)
+        return record
+
+    def forecast_once(self, current_round: int,
+                      blob: Optional[bytes] = None) -> dict:
+        """K seeded rollouts -> p50/p99 drain-time + attainment
+        quantiles, exported as gauges (and /healthz via status())."""
+        sched = self._sched
+        cfg = self.cfg
+        if blob is None:
+            blob = self._capture()
+        scores = [self._roll(blob, seed=self._seed(current_round, 7000 + k),
+                             purpose="forecast",
+                             horizon=cfg.forecast_horizon_rounds)
+                  for k in range(max(cfg.forecast_samples, 1))]
+        drains = [s.projected_drain_s for s in scores
+                  if s.projected_drain_s is not None]
+        attainments = [s.attainment for s in scores]
+        record = {"round": current_round, "samples": len(scores)}
+        if drains:
+            record["makespan_p50"] = round(
+                float(np.percentile(drains, 50)), 2)
+            record["makespan_p99"] = round(
+                float(np.percentile(drains, 99)), 2)
+            sched.obs.set_gauge(obs_names.WHATIF_FORECAST_MAKESPAN_SECONDS,
+                                record["makespan_p50"], quantile="p50")
+            sched.obs.set_gauge(obs_names.WHATIF_FORECAST_MAKESPAN_SECONDS,
+                                record["makespan_p99"], quantile="p99")
+        record["attainment_p50"] = round(
+            float(np.percentile(attainments, 50)), 6)
+        # "p99" in SLO terms = the bad tail: the attainment only 1% of
+        # sampled futures fall below.
+        record["attainment_p99"] = round(
+            float(np.percentile(attainments, 1)), 6)
+        sched.obs.set_gauge(obs_names.WHATIF_FORECAST_ATTAINMENT,
+                            record["attainment_p50"], quantile="p50")
+        sched.obs.set_gauge(obs_names.WHATIF_FORECAST_ATTAINMENT,
+                            record["attainment_p99"], quantile="p99")
+        self.forecast_log.append(record)
+        if cfg.shadow_chaos:
+            self._shadow_chaos_once(current_round, blob)
+        return record
+
+    def _shadow_chaos_once(self, current_round: int, blob: bytes) -> None:
+        """One seeded chaos probe against the twin: kill a random chip
+        for part of the horizon and check the zero-failure-charge
+        invariant (the PR 8 campaign's sharpest check), without ever
+        touching the live cluster."""
+        sched = self._sched
+        rng = np.random.RandomState(self._seed(current_round, 424242))
+        ids = sorted(sched.workers.worker_ids)
+        if not ids:
+            return
+        victim = ids[int(rng.randint(len(ids)))]
+        wt = sched.workers.id_to_type[victim]
+        now = sched.get_current_timestamp()
+        round_s = sched._time_per_iteration
+        events = [
+            {"at": now + round_s, "kill": [victim]},
+            {"at": now + round_s * max(
+                2, self.cfg.forecast_horizon_rounds // 2),
+             "revive": [victim], "worker_type": wt}]
+        outcome = "ok"
+        detail = None
+        try:
+            # Differential, like the chaos campaign's sharpest check: a
+            # fault-free baseline rollout of the SAME seed establishes
+            # how many failed aggregates the workload accrues on its
+            # own, and the injected fault must add ZERO on top. (Each
+            # thawed twin carries a fresh obs bundle, so the counters
+            # reflect the rollouts alone.)
+            seed = self._seed(current_round, 515151)
+            baseline = fork.thaw(sched, blob, seed=seed)
+            fork.rollforward(
+                baseline, horizon_rounds=self.cfg.forecast_horizon_rounds)
+            base_failed = baseline.obs.registry.value(
+                obs_names.MICROTASKS_TOTAL, outcome="failed")
+            twin = fork.thaw(sched, blob, seed=seed)
+            fork.rollforward(
+                twin, horizon_rounds=self.cfg.forecast_horizon_rounds,
+                fault_events=events)
+            self.rollouts += 2
+            sched.obs.inc(obs_names.WHATIF_ROLLOUTS_TOTAL, amount=2,
+                          purpose="shadow_chaos")
+            failed = twin.obs.registry.value(
+                obs_names.MICROTASKS_TOTAL, outcome="failed")
+            if failed > base_failed:
+                outcome = "violation"
+                detail = (f"injected kill added {failed - base_failed:.0f}"
+                          " failure charge(s) over the fault-free "
+                          "baseline")
+        except Exception as e:  # noqa: BLE001 - a twin crash IS the finding
+            outcome = "violation"
+            detail = f"twin rollout raised {type(e).__name__}: {e}"
+        sched.obs.inc(obs_names.WHATIF_SHADOW_CHAOS_TOTAL, outcome=outcome)
+        record = {"round": current_round, "victim": victim,
+                  "outcome": outcome}
+        if detail:
+            record["detail"] = detail
+        self.shadow_log.append(record)
+
+    # ------------------------------------------------------------------
+    # Physical-mode split (capture under lock; roll on a thread)
+    # ------------------------------------------------------------------
+
+    def maybe_capture_locked(self) -> Optional[Tuple[str, int, bytes]]:
+        """Called from the physical round pipeline UNDER the scheduler
+        lock: decide whether this round owes background work and, if
+        so, pay only the state-copy cost here. Returns (kind, round,
+        blob) for the background thread, or None."""
+        cfg = self.cfg
+        current_round = self._sched.rounds.num_completed_rounds
+        if cfg.tune_knob is not None and (
+                current_round - self._last_tune_round
+                >= cfg.tune_interval_rounds):
+            self._last_tune_round = current_round
+            return ("tune", current_round, self._capture())
+        if cfg.forecast_interval_rounds and (
+                current_round - self._last_forecast_round
+                >= cfg.forecast_interval_rounds):
+            self._last_forecast_round = current_round
+            return ("forecast", current_round, self._capture())
+        return None
+
+    def run_background_step(self, work: Tuple[str, int, bytes],
+                            commit_lock=None) -> None:
+        """Physical background thread body: roll the captured blob OFF
+        the lock; only tune_once's live-state commit re-takes
+        `commit_lock` (see PhysicalScheduler._whatif_loop)."""
+        kind, current_round, blob = work
+        if kind == "tune":
+            self.tune_once(current_round, blob=blob,
+                           commit_lock=commit_lock)
+        elif kind == "forecast":
+            self.forecast_once(current_round, blob=blob)
+
+    # ------------------------------------------------------------------
+    # Status (drivers, /healthz)
+    # ------------------------------------------------------------------
+
+    def status(self) -> dict:
+        out = {
+            "admission": self.cfg.admission,
+            "forks": self.forks,
+            "rollouts": self.rollouts,
+            "max_fork_s": round(self.max_fork_s, 6),
+            "decisions": len(self.decision_log),
+            # Physical advisory verdicts count too (would_defer).
+            "deferrals": sum(1 for d in self.decision_log
+                             if d["decision"] in ("defer", "would_defer")),
+        }
+        if self.knob_log:
+            out["knob"] = self.knob_log[-1]
+        if self.forecast_log:
+            out["forecast"] = self.forecast_log[-1]
+        if self.shadow_log:
+            out["shadow_chaos"] = self.shadow_log[-1]
+        return out
+
+
+__all__ = ["WhatIfPlane", "WhatIfConfig", "RolloutScore"]
