@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Committed control-plane HA failover study: 20 seeded leader-kill /
+# leader-freeze schedules (real HA leader + hot-standby scheduler
+# subprocesses, stub workers, SWTPU_SANITIZE=1), every invariant
+# re-derived from the durable journal. Byte-reproducible and resumable:
+# re-running against the committed artifact skips completed schedules;
+# --restart redoes everything.
+#
+#   bash reproduce/ha/leader_kill_campaign.sh
+#
+# Wall time ~3-5 min on a laptop-class CPU host (schedules run
+# sequentially; each is a full failover drive).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+python scripts/drivers/chaos_campaign.py \
+    --trace data/canonical_120job.trace \
+    --policy max_min_fairness \
+    --throughputs data/tacc_throughputs.json \
+    --cluster_spec v100:8 --round_duration 120 \
+    --num_schedules 0 --ha_schedules 20 \
+    --out reproduce/ha/leader_kill_campaign.json \
+    --workdir "${SWTPU_HA_WORKDIR:-/tmp/swtpu_ha_campaign}" \
+    --timing_out reproduce/ha/leader_kill_campaign.timing.json \
+    "$@"

@@ -103,6 +103,22 @@ PHYS_INVARIANTS = SIM_INVARIANTS + ("journal_fsck_clean",
                                     "sanitizer_clean", "no_stuck_leases")
 TWIN_INVARIANTS = ("twin_all_jobs_completed", "twin_steps_accounted",
                    "twin_zero_failure_charges", "live_untouched")
+#: Control-plane HA schedules (leader SIGKILLed or SIGSTOPped
+#: mid-round; the hot standby must promote and finish the trace):
+#: - promoted_clean: the standby exited 0 under SWTPU_SANITIZE=1 with
+#:   every job completed (its exit gates the sanitizer too),
+#: - exactly_one_writer: the journal's epoch chain has one contiguous
+#:   writer span per epoch (a frozen zombie's post-fencing appends are
+#:   discarded by the supersede rule, never interleaved),
+#: - failover_within_budget: promotion landed within one round budget
+#:   of the lease expiring,
+#: - old_leader_fenced: a SIGCONTed frozen leader stood down with the
+#:   fenced exit code instead of double-dispatching (vacuous for kill
+#:   schedules — a SIGKILLed leader cannot misbehave).
+HA_INVARIANTS = ("all_jobs_completed", "steps_accounted",
+                 "zero_failure_charges", "journal_fsck_clean",
+                 "exactly_one_writer", "failover_within_budget",
+                 "promoted_clean", "old_leader_fenced")
 
 
 chip_layout = driver_common.chip_layout
@@ -600,15 +616,334 @@ def run_physical_schedule(seed, cfg, workdir):
 
 
 # ----------------------------------------------------------------------
+# Control-plane HA schedules (leader-kill / leader-freeze failover)
+# ----------------------------------------------------------------------
+
+HA_ROUND_DURATION_S = 2.0
+HA_KNOBS = {"lease_interval_s": 0.15, "lease_ttl_s": 0.8,
+            "standby_poll_interval_s": 0.1, "failover_budget_s": 20.0}
+
+
+def draw_ha_schedule(rng):
+    """One seeded failover schedule: SIGKILL (dead leader) or SIGSTOP
+    (wedged-but-ALIVE leader — the fenced split-brain drill, where the
+    zombie is later SIGCONTed and must stand down) at a seeded point
+    after real progress is journaled."""
+    return {
+        "mode": "freeze" if rng.uniform() < 0.5 else "kill",
+        # Extra runway past the first journaled micro-task before the
+        # fault lands, so schedules fail at varied round phases.
+        "extra_runway_s": round(float(rng.uniform(0.0, 2.5)), 2),
+        # Freeze only: how long after promotion the zombie stays
+        # frozen before SIGCONT wakes it into its fencing.
+        "thaw_after_promote_s": round(float(rng.uniform(0.3, 1.5)), 2),
+        "num_workers": 2,
+    }
+
+
+def _journal_progress(state_dir):
+    """(microtask_done count, job_removed count) from the live journal;
+    (0, 0) while it is still unreadable/absent."""
+    from shockwave_tpu.sched import journal as journal_mod
+    try:
+        rec = journal_mod.load_state(state_dir)
+    except (journal_mod.JournalError, OSError):
+        return 0, 0
+    types = [e.get("type") for e in rec.events]
+    if rec.snapshot is not None:
+        # Compaction may have folded early micro-tasks into the
+        # snapshot; the snapshot itself proves progress.
+        return max(1, types.count("microtask_done")), types.count(
+            "job_removed")
+    return types.count("microtask_done"), types.count("job_removed")
+
+
+def run_ha_schedule(seed, cfg, workdir):
+    """One leader-kill/leader-freeze failover drive: HA leader +
+    hot-standby run_physical subprocesses and stub workers, the leader
+    faulted mid-round, every invariant re-derived from the durable
+    journal afterwards. Deterministic record (plan + invariant booleans
+    + exact journal accounting); wall telemetry stays on stderr."""
+    import pickle
+    import time as _time  # wall-clock is subprocess babysitting only,
+    # never in the record  # swtpu-check: ignore[determinism]
+
+    sys.path.insert(0, os.path.join(REPO, "scripts", "utils"))
+    import fsck_journal as fsck_mod  # noqa: E402
+
+    rng = np.random.RandomState(cfg["seed_base"] + 30_000 + seed)
+    plan = draw_ha_schedule(rng)
+    os.makedirs(workdir, exist_ok=True)
+    trace = os.path.join(workdir, "loopback.trace")
+    num_jobs, steps = _write_loopback_trace(trace)
+    state_dir = os.path.join(workdir, "state")
+    out_standby = os.path.join(workdir, "standby_metrics.pkl")
+    p_leader, p_standby = free_port(), free_port()
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SWTPU_SANITIZE"] = "1"
+    env["SWTPU_RPC_JITTER_SEED"] = str(seed)
+    env["SWTPU_HA_ENDPOINT_FILE"] = os.path.join(state_dir,
+                                                 "leader.lease")
+    # The dead-leader window must fail fast so reports re-resolve
+    # inside the failover budget instead of burning 90s retry budgets.
+    env["SWTPU_RPC_DEADLINE_S"] = "5"
+    env["SWTPU_RPC_BUDGET_S"] = "8"
+
+    def sched_cmd(port, out, standby=False):
+        cmd = [sys.executable, RUN_PHYSICAL, "--trace", trace,
+               "--policy", "max_min_fairness",
+               "--throughputs", cfg["throughputs"],
+               "--expected_num_workers", str(plan["num_workers"]),
+               "--round_duration", str(HA_ROUND_DURATION_S),
+               "--port", str(port), "--state_dir", state_dir,
+               "--snapshot_interval", "2", "--output", out,
+               "--ha", json.dumps(HA_KNOBS),
+               "--heartbeat_interval", "0.2", "--worker_timeout", "1.0",
+               "--probe_failures", "2", "--kill_wait", "0.5",
+               "--completion_buffer", "5", "--first_init_grace", "0",
+               "--quarantine_backoff", "3", "--verbose"]
+        if standby:
+            cmd.append("--ha_standby")
+        return cmd
+
+    leader_log = open(os.path.join(workdir, "leader.log"), "w")
+    leader = subprocess.Popen(
+        sched_cmd(p_leader, os.path.join(workdir, "leader_metrics.pkl")),
+        stdout=leader_log, stderr=subprocess.STDOUT, env=env)
+    standby_log = open(os.path.join(workdir, "standby.log"), "w")
+    standby = subprocess.Popen(
+        sched_cmd(p_standby, out_standby, standby=True),
+        stdout=standby_log, stderr=subprocess.STDOUT, env=env)
+
+    deadline = _time.time() + 30  # swtpu-check: ignore[determinism]
+    while _time.time() < deadline:  # swtpu-check: ignore[determinism]
+        with socket.socket() as s:
+            s.settimeout(0.2)
+            try:
+                s.connect(("127.0.0.1", p_leader))
+                break
+            except OSError:
+                _time.sleep(0.1)
+    workers = []
+    for w in range(plan["num_workers"]):
+        wlog = open(os.path.join(workdir, f"worker{w}.log"), "w")
+        workers.append((subprocess.Popen(
+            [sys.executable, STUB_WORKER,
+             "--sched_port", str(p_leader),
+             "--worker_port", str(free_port()), "--num_chips", "1",
+             "--state_file", os.path.join(workdir, f"w{w}.json")],
+            stdout=wlog, stderr=subprocess.STDOUT, env=wenv_ha(env)),
+            wlog))
+
+    violations = []
+    inv = {k: False for k in HA_INVARIANTS}
+    promo = None
+    try:
+        # Fault the leader only after real progress is journaled (and
+        # before the trace drains), at a seeded extra offset.
+        progress_deadline = _time.time() + 60  # swtpu-check: ignore[determinism]
+        while _time.time() < progress_deadline:  # swtpu-check: ignore[determinism]
+            if leader.poll() is not None:
+                violations.append(
+                    f"leader exited prematurely (rc {leader.returncode})")
+                return {"seed": seed, "plan": plan, "invariants": inv,
+                        "violations": violations}
+            done, removed = _journal_progress(state_dir)
+            if done >= 1 and removed < num_jobs:
+                break
+            _time.sleep(0.05)
+        else:
+            violations.append("no journaled progress within 60s")
+            return {"seed": seed, "plan": plan, "invariants": inv,
+                    "violations": violations}
+        try:
+            leader.wait(timeout=plan["extra_runway_s"])
+            violations.append("leader finished before the fault landed")
+            return {"seed": seed, "plan": plan, "invariants": inv,
+                    "violations": violations}
+        except subprocess.TimeoutExpired:
+            pass
+        fault_signal = (signal.SIGSTOP if plan["mode"] == "freeze"
+                        else signal.SIGKILL)
+        os.kill(leader.pid, fault_signal)
+        if plan["mode"] == "kill":
+            leader.wait(timeout=10)
+
+        # The standby must promote unattended...
+        promo_path = os.path.join(state_dir, "promotion.json")
+        promo_deadline = _time.time() + 30  # swtpu-check: ignore[determinism]
+        while _time.time() < promo_deadline:  # swtpu-check: ignore[determinism]
+            if os.path.exists(promo_path):
+                with open(promo_path) as f:
+                    promo = json.load(f)
+                break
+            _time.sleep(0.1)
+        if promo is None:
+            violations.append("standby never promoted within 30s")
+
+        if plan["mode"] == "freeze" and promo is not None:
+            # ...and the thawed zombie must stand down FENCED (exit 7),
+            # never double-dispatch.
+            _time.sleep(plan["thaw_after_promote_s"])
+            os.kill(leader.pid, signal.SIGCONT)
+            try:
+                rc_old = leader.wait(timeout=60)
+                inv["old_leader_fenced"] = rc_old == 7
+                if rc_old != 7:
+                    violations.append(
+                        f"SIGCONTed old leader exited {rc_old}, not the "
+                        "fenced code 7")
+            except subprocess.TimeoutExpired:
+                violations.append("SIGCONTed old leader never exited "
+                                  "(wedged past its fencing)")
+                leader.kill()
+        else:
+            # A SIGKILLed leader cannot misbehave: vacuously fenced.
+            inv["old_leader_fenced"] = plan["mode"] == "kill"
+
+        try:
+            rc = standby.wait(timeout=cfg["physical_timeout_s"])
+        except subprocess.TimeoutExpired:
+            violations.append("promoted standby did not finish within "
+                              f"{cfg['physical_timeout_s']}s")
+            standby.kill()
+            rc = standby.wait(timeout=10)
+        all_done = False
+        if os.path.exists(out_standby):
+            with open(out_standby, "rb") as f:
+                all_done = bool(pickle.load(f).get("all_jobs_completed"))
+        inv["promoted_clean"] = rc == 0 and all_done
+        inv["all_jobs_completed"] = all_done
+        if rc != 0:
+            violations.append(f"promoted standby exited {rc} under "
+                              "SWTPU_SANITIZE=1")
+        if not all_done:
+            violations.append("not all jobs completed after failover")
+        if promo is not None:
+            inv["failover_within_budget"] = (
+                promo["from_lease_expiry_s"] <= HA_ROUND_DURATION_S)
+            if not inv["failover_within_budget"]:
+                violations.append(
+                    f"promotion took {promo['from_lease_expiry_s']:.2f}s "
+                    f"past lease expiry (> {HA_ROUND_DURATION_S}s round "
+                    "budget)")
+
+        # Durable-record invariants: exact accounting + fsck + the
+        # exactly-one-writer epoch chain.
+        accounting = {}
+        fsck = subprocess.run(
+            [sys.executable, FSCK, state_dir], env=env,
+            capture_output=True, text=True, timeout=60)
+        inv["journal_fsck_clean"] = fsck.returncode == 0
+        if fsck.returncode != 0:
+            violations.append(
+                f"fsck_journal exit {fsck.returncode}: "
+                f"{fsck.stdout.strip().splitlines()[-1:]}")
+        from shockwave_tpu.sched import journal as journal_mod
+        records = []
+        for path in journal_mod.list_segments(state_dir):
+            try:
+                segment_records, _ = journal_mod.read_journal(path)
+                records.extend(segment_records)
+            except journal_mod.JournalError as e:
+                violations.append(f"unreadable segment: {e}")
+        notes = []
+        epochs_ok, stale = fsck_mod.check_epoch_chain(records,
+                                                      out=notes.append)
+        inv["exactly_one_writer"] = epochs_ok
+        if not epochs_ok:
+            violations.extend(notes)
+        check = subprocess.run(
+            [sys.executable, "-c", (
+                "import sys; sys.path.insert(0, sys.argv[1])\n"
+                "from shockwave_tpu.sched import journal\n"
+                "from shockwave_tpu.sched.scheduler import Scheduler\n"
+                "from shockwave_tpu.solver import get_policy\n"
+                "s = Scheduler(get_policy('max_min_fairness'),"
+                " throughputs_file=sys.argv[3])\n"
+                "s.restore_from_durable_state("
+                "journal.load_state(sys.argv[2]))\n"
+                "import json\n"
+                "print(json.dumps({str(k.integer_job_id()): v for k, v"
+                " in s.acct.total_steps_run.items()}))\n"
+                "print(json.dumps({str(k.integer_job_id()): v for k, v"
+                " in s.acct.failures.items()}))"),
+             REPO, state_dir, cfg["throughputs"]],
+            env=env, capture_output=True, text=True, timeout=120)
+        if check.returncode == 0:
+            lines = check.stdout.strip().splitlines()
+            accounting = json.loads(lines[-2])
+            failures = json.loads(lines[-1])
+            wrong = {j: s for j, s in accounting.items() if s != steps}
+            inv["steps_accounted"] = (len(accounting) == num_jobs
+                                      and not wrong)
+            if wrong or len(accounting) != num_jobs:
+                violations.append(
+                    f"journal step accounting {accounting} != "
+                    f"{num_jobs}x{steps} exactly across the failover")
+            charged = {j: c for j, c in failures.items() if c > 0}
+            inv["zero_failure_charges"] = not charged
+            if charged:
+                violations.append(
+                    f"failure charges across the failover: {charged}")
+        else:
+            violations.append("journal replay cross-check failed: "
+                              + check.stderr.strip()[-200:])
+        if promo is not None:
+            print(f"[ha {seed}] {plan['mode']}: promotion "
+                  f"{promo['from_lease_expiry_s']:.2f}s past lease "
+                  f"expiry, applied_seq {promo['applied_seq']}, "
+                  f"stale dropped {stale}", file=sys.stderr)
+        # `stale` (how many zombie writes the supersede rule discarded)
+        # is a RACE OUTCOME, not a schedule property — it stays on
+        # stderr so the artifact remains byte-reproducible.
+        return {"seed": seed, "plan": plan, "invariants": inv,
+                "violations": violations,
+                "summary": {"accounting": accounting,
+                            "promoted_epoch": (promo or {}).get("epoch")}}
+    finally:
+        for proc in [leader, standby] + [w for w, _ in workers]:
+            try:
+                if proc.poll() is None:
+                    # A still-frozen leader cannot act on SIGKILL.
+                    try:
+                        os.kill(proc.pid, signal.SIGCONT)
+                    except OSError:
+                        pass
+                    proc.kill()
+                    proc.wait(timeout=10)
+            except (subprocess.TimeoutExpired, OSError) as e:
+                print(f"[ha {seed}] cleanup of pid {proc.pid} "
+                      f"failed: {e}", file=sys.stderr)
+        leader_log.close()
+        standby_log.close()
+        for _, wlog in workers:
+            wlog.close()
+
+
+def wenv_ha(env):
+    """Worker env for HA schedules: no injected RPC faults — the
+    kill/freeze IS the fault (keeps the invariant booleans a pure
+    function of the seed)."""
+    wenv = dict(env)
+    wenv.pop("SWTPU_FAULTS", None)
+    return wenv
+
+
+# ----------------------------------------------------------------------
 # Artifact plumbing (sweep_scenarios.py contract)
 # ----------------------------------------------------------------------
 
-def write_artifact(path, meta, sim, physical, twin=None):
+def write_artifact(path, meta, sim, physical, twin=None, ha=None):
     twin = twin or {}
+    ha = ha or {}
 
     def _summary():
         records = (list(sim.values()) + list(physical.values())
-                   + list(twin.values()))
+                   + list(twin.values()) + list(ha.values()))
         bad = [r for r in records if r.get("violations")]
         return {
             "schedules": len(records),
@@ -621,6 +956,8 @@ def write_artifact(path, meta, sim, physical, twin=None):
            "summary": _summary()}
     if twin:
         doc["twin"] = {str(k): twin[k] for k in sorted(twin)}
+    if ha:
+        doc["ha"] = {str(k): ha[k] for k in sorted(ha)}
     write_text_atomic(path, json.dumps(doc, indent=1, sort_keys=True) + "\n")
     return doc
 
@@ -648,6 +985,13 @@ def main():
                         "mid-run scheduler (whatif/fork.py) instead of "
                         "the live one, checking the same invariants "
                         "plus fork isolation")
+    p.add_argument("--ha_schedules", type=int, default=0,
+                   help="seeded control-plane failover schedules: an HA "
+                        "leader + hot-standby pair of real scheduler "
+                        "subprocesses, the leader SIGKILLed or frozen "
+                        "(SIGSTOP -> fenced SIGCONT) mid-round; gated "
+                        "on exact accounting, exactly-one-writer-per-"
+                        "epoch, and bounded failover (~20-40s each)")
     p.add_argument("--seed_base", type=int, default=0)
     p.add_argument("--out", required=True, help="results JSON artifact")
     p.add_argument("--restart", action="store_true",
@@ -688,7 +1032,7 @@ def main():
                   for k, v in knobs.items()},
     }
 
-    sim, physical, twin = {}, {}, {}
+    sim, physical, twin, ha = {}, {}, {}, {}
     existing = driver_common.load_resumable_artifact(args.out, meta,
                                                      args.restart)
     if existing is not None:
@@ -696,6 +1040,7 @@ def main():
         physical = {int(k): v
                     for k, v in existing.get("physical", {}).items()}
         twin = {int(k): v for k, v in existing.get("twin", {}).items()}
+        ha = {int(k): v for k, v in existing.get("ha", {}).items()}
 
     from shockwave_tpu.core.oracle import read_throughputs
     cfg = {
@@ -719,7 +1064,7 @@ def main():
             continue
         record = run_sim_schedule(args.seed_base + i, cfg)
         sim[i] = record
-        write_artifact(args.out, meta, sim, physical, twin)
+        write_artifact(args.out, meta, sim, physical, twin, ha)
         status = "ok" if not record["violations"] else "VIOLATION"
         print(f"[sim {len(sim)}/{args.num_schedules}] seed "
               f"{args.seed_base + i} {status} "
@@ -732,7 +1077,7 @@ def main():
         # Disjoint seed space (physical uses +10_000).
         record = run_twin_schedule(args.seed_base + 20_000 + i, cfg)
         twin[i] = record
-        write_artifact(args.out, meta, sim, physical, twin)
+        write_artifact(args.out, meta, sim, physical, twin, ha)
         status = "ok" if not record["violations"] else "VIOLATION"
         print(f"[twin {len(twin)}/{args.twin_schedules}] seed "
               f"{args.seed_base + 20_000 + i} {status} "
@@ -745,14 +1090,26 @@ def main():
         record = run_physical_schedule(
             i, cfg, os.path.join(workdir, f"phys{i}"))
         physical[i] = record
-        write_artifact(args.out, meta, sim, physical, twin)
+        write_artifact(args.out, meta, sim, physical, twin, ha)
         status = "ok" if not record["violations"] else "VIOLATION"
         print(f"[physical {len(physical)}/{args.physical_schedules}] "
               f"seed {i} {status} "
               f"({_time.monotonic() - t0:.1f}s elapsed)",  # swtpu-check: ignore[determinism]
               file=sys.stderr, flush=True)
 
-    doc = write_artifact(args.out, meta, sim, physical, twin)
+    for i in range(args.ha_schedules):
+        if i in ha:
+            continue
+        record = run_ha_schedule(i, cfg, os.path.join(workdir, f"ha{i}"))
+        ha[i] = record
+        write_artifact(args.out, meta, sim, physical, twin, ha)
+        status = "ok" if not record["violations"] else "VIOLATION"
+        print(f"[ha {len(ha)}/{args.ha_schedules}] seed {i} "
+              f"({record['plan']['mode']}) {status} "
+              f"({_time.monotonic() - t0:.1f}s elapsed)",  # swtpu-check: ignore[determinism]
+              file=sys.stderr, flush=True)
+
+    doc = write_artifact(args.out, meta, sim, physical, twin, ha)
     summary = doc["summary"]
     wall_s = _time.monotonic() - t0  # swtpu-check: ignore[determinism]
     result = {"artifact": args.out, **summary,

@@ -112,6 +112,20 @@ def main():
                         "forks each round: advisory admission verdicts, "
                         "knob auto-tuning, forecasts). A 'whatif' block "
                         "in --config does the same; this flag wins")
+    # Control-plane HA knobs (defaults recorded in configs/ha.json;
+    # see README "Control-plane HA").
+    p.add_argument("--ha", default=None, metavar="JSON",
+                   help="JSON file (or inline JSON object) of "
+                        "sched/ha.HAConfig field overrides — enables "
+                        "the HA control plane (fenced leader epoch, "
+                        "liveness lease, hot-standby failover). "
+                        "Requires --state_dir")
+    p.add_argument("--ha_standby", action="store_true",
+                   help="run as the HOT STANDBY: tail the leader's "
+                        "journal into a warm twin, promote "
+                        "automatically when its lease lapses, then "
+                        "continue this driver as the new leader "
+                        "(implies --resume at promotion)")
     # Durability knobs (defaults recorded in configs/durability.json;
     # see README "Scheduler crash recovery").
     p.add_argument("--state_dir", "--state-dir", dest="state_dir",
@@ -193,27 +207,90 @@ def main():
         worker_health = dict(worker_health or {})
         worker_health["quarantine_backoff_s"] = args.quarantine_backoff
 
+    ha_config = None
+    if args.ha:
+        if args.ha.strip().startswith("{"):
+            ha_config = json.loads(args.ha)
+        else:
+            with open(args.ha) as f:
+                ha_config = json.load(f)
+        if not args.state_dir:
+            p.error("--ha requires --state_dir (the lease, epoch claims "
+                    "and shipped journal all live there)")
+    if args.ha_standby and ha_config is None:
+        p.error("--ha_standby requires --ha (the standby needs the "
+                "lease/epoch knobs to watch the leader)")
+
     policy = get_policy(args.policy, seed=args.seed)
+    config = SchedulerConfig(
+        time_per_iteration=args.round_duration, seed=args.seed,
+        max_rounds=args.max_rounds, shockwave=shockwave_config,
+        watchdog_interval=args.watchdog,
+        job_completion_buffer_s=args.completion_buffer,
+        first_init_grace_s=args.first_init_grace,
+        heartbeat_interval_s=args.heartbeat_interval,
+        worker_timeout_s=args.worker_timeout,
+        worker_probe_failures=args.probe_failures,
+        kill_wait_s=args.kill_wait,
+        worker_health_enabled=not args.no_worker_health,
+        worker_health=worker_health,
+        state_dir=args.state_dir, resume=args.resume,
+        snapshot_interval_rounds=args.snapshot_interval,
+        pipelined_planning=not args.no_pipelined_solve,
+        obs_port=args.obs_port, obs_trace_path=args.obs_trace,
+        serving=serving_config, whatif=whatif_config, ha=ha_config)
+
+    if args.ha_standby:
+        # Hot-standby phase: tail the leader's journal into a warm twin
+        # until its lease lapses and this process wins the promotion
+        # CAS — then fall through to the normal driver path as the new
+        # leader, re-entering through the conservative --resume
+        # recovery (load_state + in-flight requeue + orphan gates).
+        from shockwave_tpu.obs import get_observability
+        from shockwave_tpu.sched.ha import HAConfig, HotStandby
+        from shockwave_tpu.sched.scheduler import Scheduler
+        from shockwave_tpu.whatif.fork import twin_config
+
+        ha_cfg = HAConfig.from_dict(ha_config)
+
+        def _twin_factory():
+            return Scheduler(get_policy(args.policy, seed=args.seed),
+                             simulate=True, profiles=profiles,
+                             throughputs_file=args.throughputs,
+                             config=twin_config(config))
+
+        standby = HotStandby(args.state_dir, ha_cfg,
+                             twin_factory=_twin_factory)
+        standby_obs = None
+        if args.obs_port is not None:
+            from shockwave_tpu.obs.exporter import ObsHttpServer
+            standby_obs = ObsHttpServer(
+                get_observability().registry, health_fn=standby.health,
+                port=args.obs_port).start()
+            print(f"standby obs endpoint: "
+                  f"http://0.0.0.0:{standby_obs.port}/metrics and "
+                  "/healthz", file=sys.stderr, flush=True)
+        # Blocks through lost promotion races too (the standby resumes
+        # following until it wins one); returns only with a record.
+        record = standby.run_until_promoted(port=args.port)
+        if standby_obs is not None:
+            # The promoted scheduler re-binds its own endpoint.
+            standby_obs.stop()
+        print(json.dumps({
+            "ha_promoted": True, "epoch": record.epoch,
+            "applied_seq": record.applied_seq,
+            "replication_lag_s": round(record.replication_lag_s, 4),
+        }), file=sys.stderr, flush=True)
+        ha_config = dict(ha_config)
+        ha_config["claimed_epoch"] = record.epoch
+        from dataclasses import replace as _replace
+        config = _replace(config, resume=True, ha=ha_config)
+        args.resume = True
+
     sched = PhysicalScheduler(
         policy, throughputs_file=args.throughputs, profiles=profiles,
         expected_num_workers=args.expected_num_workers, port=args.port,
-        config=SchedulerConfig(
-            time_per_iteration=args.round_duration, seed=args.seed,
-            max_rounds=args.max_rounds, shockwave=shockwave_config,
-            watchdog_interval=args.watchdog,
-            job_completion_buffer_s=args.completion_buffer,
-            first_init_grace_s=args.first_init_grace,
-            heartbeat_interval_s=args.heartbeat_interval,
-            worker_timeout_s=args.worker_timeout,
-            worker_probe_failures=args.probe_failures,
-            kill_wait_s=args.kill_wait,
-            worker_health_enabled=not args.no_worker_health,
-            worker_health=worker_health,
-            state_dir=args.state_dir, resume=args.resume,
-            snapshot_interval_rounds=args.snapshot_interval,
-            pipelined_planning=not args.no_pipelined_solve,
-            obs_port=args.obs_port, obs_trace_path=args.obs_trace,
-            serving=serving_config, whatif=whatif_config))
+        config=config)
     if sched.obs_port is not None:
         # stderr, unconditionally: with --obs_port 0 this line is the
         # ONLY place the resolved ephemeral port appears, and the
@@ -273,6 +350,16 @@ def main():
                         "reporting recovered metrics", len(jobs))
     else:
         sched.run()
+    if getattr(sched, "ha_fenced", False):
+        # Deposed by a promoted standby: the successor owns the run
+        # (and the journal). Exit distinctly — a fenced stand-down is
+        # the HA design working, not a failure, and the chaos driver
+        # asserts this exact code for the SIGCONTed old leader.
+        print(json.dumps({"ha_fenced": True,
+                          "epoch": sched._ha.epoch if sched._ha else None}),
+              file=sys.stderr, flush=True)
+        sched.shutdown()
+        sys.exit(7)
     # Last completion, not teardown: run() returning includes the final
     # round's drain + shutdown, which the reference's makespan (stamped
     # as soon as is_done polls true) does not contain. The physical

@@ -9,24 +9,129 @@ Checks, without touching the live scheduler:
 - sequence-number sanity: strictly increasing, and the post-snapshot
   event stream starts at snapshot.last_seq + 1 or earlier (gaps below
   the snapshot horizon are expected — compaction deletes covered
-  segments).
+  segments),
+- leader-epoch chain sanity (control-plane HA): along the surviving
+  sequence chain, epochs are non-decreasing and each epoch owns one
+  contiguous span — EXACTLY ONE WRITER PER EPOCH. Stale-writer records
+  a deposed leader appended after its fencing are reported (they are
+  expected fallout of a leader-freeze failover; recovery discards them
+  deterministically) but do NOT fail the check.
+
+``--follow`` streams instead of scanning: the journal is validated
+WHILE the leader is writing it, using the same tail-tolerant
+`JournalFollower` the hot standby replicates through — a torn tail is
+WAIT (the writer is mid-append), never corruption. Each poll prints the
+applied sequence and the replication lag (now minus the newest
+record's wall stamp), giving operators a live lag check with zero
+scheduler involvement. Follow mode exits 0 when --max_wait_s elapses
+with a clean tail (or runs until interrupted without it).
 
 Exit codes: 0 = clean, 1 = recoverable damage (torn tail / snapshot
 fell back to .prev), 2 = state unusable or not found.
 
 Usage:
     python scripts/utils/fsck_journal.py <state_dir> [--verbose]
+    python scripts/utils/fsck_journal.py <state_dir> --follow \
+        [--max_wait_s 30] [--poll_interval_s 0.5]
 """
 import argparse
 import collections
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
-from shockwave_tpu.sched.journal import (SNAPSHOT_NAME, TAIL_CLEAN,  # noqa: E402
-                                         JournalError, _read_snapshot_file,
-                                         list_segments, read_journal)
+from shockwave_tpu.sched.journal import (FOLLOW_BEHIND, SNAPSHOT_NAME,  # noqa: E402
+                                         TAIL_CLEAN, JournalError,
+                                         JournalFollower,
+                                         _read_snapshot_file,
+                                         filter_epoch_chain, list_segments,
+                                         read_journal)
+
+
+def check_epoch_chain(records, out=print):
+    """Validate the exactly-one-writer-per-epoch invariant over
+    seq-sorted records. Returns (ok, num_stale_orphans): `ok` is False
+    only on a REAL violation (an epoch re-appearing after a higher one
+    inside the SURVIVING chain — two live writers interleaved); stale
+    orphans that the supersede rule cleanly discards are counted but
+    expected."""
+    kept, orphans = filter_epoch_chain(sorted(
+        records, key=lambda r: int(r.get("seq", 0))))
+    seen_epochs = []
+    for rec in kept:
+        epoch = rec.get("epoch")
+        if epoch is None:
+            continue
+        epoch = int(epoch)
+        if not seen_epochs or seen_epochs[-1] != epoch:
+            seen_epochs.append(epoch)
+    ok = True
+    if seen_epochs != sorted(set(seen_epochs)):
+        out(f"EPOCH CHAIN VIOLATION: epochs interleave along the "
+            f"surviving chain ({seen_epochs}) — two writers shared an "
+            "epoch or a fenced writer's records survived")
+        ok = False
+    untagged = [r for r in orphans if r.get("epoch") is None]
+    if untagged:
+        # A superseded record WITHOUT an epoch cannot be a fenced
+        # ex-leader's (those are always tagged): an untagged writer
+        # duplicated sequence numbers — real structural damage.
+        out(f"SEQ DUPLICATION: {len(untagged)} untagged record(s) "
+            f"duplicate sequences (seqs "
+            f"{sorted({int(r.get('seq', 0)) for r in untagged})[:10]}) "
+            "— two writers without epoch fencing?")
+        ok = False
+    if orphans:
+        by_epoch = collections.Counter(
+            r.get("epoch") for r in orphans)
+        out(f"stale-writer orphans discarded by the epoch supersede "
+            f"rule: {dict(by_epoch)} (expected after a leader-freeze "
+            "failover; recovery ignores them)")
+    if seen_epochs:
+        out(f"epoch chain: {seen_epochs} (one writer per epoch)")
+    return ok, len(orphans)
+
+
+def follow(args):
+    """--follow: validate the live journal + report replication lag."""
+    follower = JournalFollower(args.state_dir)
+    deadline = (time.time() + args.max_wait_s
+                if args.max_wait_s is not None else None)
+    total = 0
+    clean_at_eof = False
+    try:
+        while True:
+            events, status = follower.poll()
+            total += len(events)
+            now = time.time()
+            lag = (now - follower.last_record_walltime
+                   if follower.last_record_walltime is not None else None)
+            state = {TAIL_CLEAN: "clean",
+                     FOLLOW_BEHIND: "BEHIND COMPACTION"}.get(status,
+                                                             "WAIT (torn "
+                                                             "tail)")
+            print(f"applied_seq={follower.last_seq} new={len(events)} "
+                  f"tail={state} lag_s="
+                  f"{'n/a' if lag is None else f'{lag:.3f}'} "
+                  f"stale_dropped={follower.stale_dropped}", flush=True)
+            if status == FOLLOW_BEHIND:
+                # Not corruption: the writer compacted past us. A fresh
+                # follower (or recovery) starts from the snapshot.
+                follower = JournalFollower(
+                    args.state_dir,
+                    start_after_seq=follower.snapshot_horizon())
+            clean_at_eof = status == TAIL_CLEAN
+            if deadline is not None and time.time() >= deadline:
+                break
+            time.sleep(args.poll_interval_s)
+    except KeyboardInterrupt:
+        pass
+    tail = ("clean" if clean_at_eof
+            else "pending (torn tail is WAIT, not corruption)")
+    print(f"followed {total} records; tail {tail}")
+    return 0 if clean_at_eof else 1
 
 
 def main():
@@ -34,12 +139,22 @@ def main():
     p.add_argument("state_dir")
     p.add_argument("--verbose", action="store_true",
                    help="print every record type histogram per segment")
+    p.add_argument("--follow", action="store_true",
+                   help="stream-validate a journal WHILE it is written "
+                        "(tail-tolerant; prints live replication lag)")
+    p.add_argument("--max_wait_s", type=float, default=None,
+                   help="--follow: stop after this many seconds "
+                        "(default: run until interrupted)")
+    p.add_argument("--poll_interval_s", type=float, default=0.5,
+                   help="--follow: poll cadence")
     args = p.parse_args()
 
     rc = 0
     if not os.path.isdir(args.state_dir):
         print(f"ERROR: {args.state_dir} is not a directory")
         return 2
+    if args.follow:
+        return follow(args)
 
     # -- snapshot ------------------------------------------------------
     snap_path = os.path.join(args.state_dir, SNAPSHOT_NAME)
@@ -72,47 +187,82 @@ def main():
 
     total = 0
     replayable = 0
-    prev_seq = None
     prev_replayable_seq = None
     types: collections.Counter = collections.Counter()
+    all_records = []
+    parsed = []
     for path in segments:
         try:
-            records, tail = read_journal(path)
+            parsed.append((path,) + read_journal(path))
         except JournalError as e:
             print(f"{os.path.basename(path)}: UNREADABLE ({e})")
             rc = 2
-            continue
+    global_max_epoch = max(
+        (int(r["epoch"]) for _, records, _ in parsed for r in records
+         if r.get("epoch") is not None), default=None)
+    for path, records, tail in parsed:
         seg_types = collections.Counter(r.get("type", "?") for r in records)
         types.update(seg_types)
         total += len(records)
+        all_records.extend(records)
+        prev_seq = None
         for r in records:
+            # WITHIN a segment, seqs must strictly increase (one writer
+            # per file). Across segments they may overlap: a deposed
+            # leader's stale tail duplicates seqs the successor re-
+            # claimed in its own segment — judged by the epoch chain
+            # check below, not flagged as structural damage here.
             seq = int(r.get("seq", 0))
             if prev_seq is not None and seq <= prev_seq:
                 print(f"{os.path.basename(path)}: seq {seq} not "
                       f"increasing (prev {prev_seq})")
                 rc = 2
             prev_seq = seq
-            if seq > last_seq:
-                # The replayable stream must be gapless: sequences are
-                # allocated one at a time, so a jump means a lost
-                # segment (or manual deletion) — recovery would
-                # silently skip the missing events.
-                expected = (last_seq if prev_replayable_seq is None
-                            else prev_replayable_seq) + 1
-                if seq != expected:
-                    print(f"{os.path.basename(path)}: GAP in replayable "
-                          f"stream — expected seq {expected}, found "
-                          f"{seq} (events lost?)")
-                    rc = 2
-                prev_replayable_seq = seq
-                replayable += 1
-        status = "OK" if tail == TAIL_CLEAN else "TORN TAIL (recoverable)"
+        status = "OK"
         if tail != TAIL_CLEAN:
-            rc = max(rc, 1)
+            # A torn tail on a SUPERSEDED writer's segment is expected
+            # debris of a fenced failover: the dead/deposed leader's
+            # file is never reopened (each HA incarnation rotates to a
+            # fresh segment), so nothing ever truncates it — and even
+            # if the torn record parsed, the epoch supersede rule would
+            # discard it. Only the CURRENT writer chain's torn tail is
+            # recoverable damage (exit 1).
+            seg_epoch = max((int(r["epoch"]) for r in records
+                             if r.get("epoch") is not None), default=None)
+            superseded = (seg_epoch is not None
+                          and global_max_epoch is not None
+                          and seg_epoch < global_max_epoch)
+            if superseded:
+                status = ("TORN TAIL (superseded epoch "
+                          f"{seg_epoch} writer; ignorable)")
+            else:
+                status = "TORN TAIL (recoverable)"
+                rc = max(rc, 1)
         print(f"{os.path.basename(path)}: {len(records)} records, {status}")
         if args.verbose and seg_types:
             for etype, count in sorted(seg_types.items()):
                 print(f"    {etype}: {count}")
+
+    # The replayable stream — what recovery actually applies — is the
+    # SURVIVING chain after the epoch supersede rule; it must be
+    # gapless past the snapshot horizon (sequences are allocated one at
+    # a time, so a jump means a lost segment or manual deletion).
+    epochs_ok, _ = check_epoch_chain(all_records)
+    if not epochs_ok:
+        rc = 2
+    kept, _ = filter_epoch_chain(sorted(
+        all_records, key=lambda r: int(r.get("seq", 0))))
+    for r in kept:
+        seq = int(r.get("seq", 0))
+        if seq > last_seq:
+            expected = (last_seq if prev_replayable_seq is None
+                        else prev_replayable_seq) + 1
+            if seq != expected:
+                print(f"GAP in replayable stream — expected seq "
+                      f"{expected}, found {seq} (events lost?)")
+                rc = 2
+            prev_replayable_seq = seq
+            replayable += 1
 
     print(f"total: {total} journal records, {replayable} replayable past "
           f"the snapshot horizon")

@@ -178,6 +178,18 @@ DURABILITY_ALLOW_GLOBS = (
     "shockwave_tpu/core/durable_io.py",
     "shockwave_tpu/sched/journal.py",
 )
+#: Modules allowed to use the rename/delete primitives, where every
+#: use must pair with a containing-directory fsync (the durability-
+#: pass dir-fsync rule): the durable-io core plus the HA lease/epoch
+#: store, whose O_EXCL claim files are fencing decisions a crash must
+#: not un-happen.
+DURABILITY_DIR_FSYNC_GLOBS = DURABILITY_ALLOW_GLOBS + (
+    "shockwave_tpu/sched/ha.py",
+)
+#: Directory-entry mutations that POSIX only makes durable after an
+#: fsync of the containing directory.
+_DIR_MUTATION_CALLS = frozenset({"os.rename", "os.replace", "os.remove",
+                                 "os.unlink"})
 _WRITE_MODE_CHARS = set("wax+")
 
 
@@ -199,18 +211,62 @@ def _open_write_mode(node: ast.Call) -> Optional[str]:
     return mode if _WRITE_MODE_CHARS & set(mode) else None
 
 
+def _check_dir_fsync_pairing(src: SourceFile,
+                             findings: List[Finding]) -> None:
+    """Dir-fsync rule for the durable-io modules themselves: every
+    function that renames/deletes a durable file must also fsync the
+    containing directory in that same function — a rename a crash can
+    lose (the dirent never became durable) silently un-rotates a
+    journal segment or un-promotes a snapshot ``.prev`` on some
+    filesystems, and recovery then replays against the wrong
+    generation."""
+    pass_id = "durability"
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        mutations = []
+        has_dir_fsync = False
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = call_name(sub)
+            if name in _DIR_MUTATION_CALLS:
+                mutations.append((sub, name))
+            # fsync_dir / _fsync_dir, bare or module-qualified — and
+            # write_durable / write_text_atomic, which fsync the
+            # directory internally.
+            tail = name.rsplit(".", 1)[-1]
+            if tail in ("fsync_dir", "_fsync_dir", "write_durable",
+                        "write_text_atomic"):
+                has_dir_fsync = True
+        if mutations and not has_dir_fsync:
+            for sub, name in mutations:
+                f = finding(src, sub, pass_id,
+                            f"{name} in a durable-io function with no "
+                            "containing-directory fsync: the rename/"
+                            "delete may not survive a crash (call "
+                            "fsync_dir in the same function)")
+                if f is not None:
+                    findings.append(f)
+
+
 def check_durability(index: RepoIndex,
                      state_globs: Iterable[str] = DURABILITY_STATE_GLOBS,
-                     allow_globs: Iterable[str] = DURABILITY_ALLOW_GLOBS
-                     ) -> List[Finding]:
+                     allow_globs: Iterable[str] = DURABILITY_ALLOW_GLOBS,
+                     dir_fsync_globs: Iterable[str]
+                     = DURABILITY_DIR_FSYNC_GLOBS) -> List[Finding]:
     """State/checkpoint bytes must reach disk only through
     ``core/durable_io.write_durable`` (CRC footer + fsync + atomic
     rename + dir fsync). Flags raw write-mode ``open`` calls in
     state-owning modules, and the rename/replace primitives anywhere in
-    the indexed tree outside durable_io."""
+    the indexed tree outside durable_io. Inside the durable-io modules
+    themselves, every rename/delete must pair with a directory fsync
+    (`_check_dir_fsync_pairing`)."""
     pass_id = "durability"
     findings: List[Finding] = []
     for src in index.files:
+        if src.matches(dir_fsync_globs):
+            _check_dir_fsync_pairing(src, findings)
         if src.matches(allow_globs):
             continue
         in_state_scope = src.matches(state_globs)

@@ -47,6 +47,16 @@ def write_durable(path: str, payload: bytes, magic: bytes,
         os.fsync(f.fileno())
     if keep_prev and os.path.exists(path):
         os.replace(path, path + ".prev")
+        # Make the .prev promotion durable BEFORE the new generation
+        # lands at `path`: POSIX does not order two renames in one
+        # directory across a crash, and a journal replay that persists
+        # the second rename but loses the first would leave the new
+        # generation current with a stale .prev fallback — recovery
+        # after a subsequent corruption would then replay against the
+        # wrong horizon. (Audited by the swtpu-check durability pass:
+        # rename/delete of durable files must pair with a directory
+        # fsync in the same function.)
+        fsync_dir(os.path.dirname(path) or ".")
     os.replace(tmp, path)
     fsync_dir(os.path.dirname(path) or ".")
     return path

@@ -194,6 +194,46 @@ JOURNAL_LAG_EVENTS = _gauge(
     "Journal events appended since the last compacting snapshot")
 
 # ----------------------------------------------------------------------
+# Control-plane HA (sched/ha.py: journal-shipping hot standby, fenced
+# automatic failover)
+# ----------------------------------------------------------------------
+
+HA_ROLE = _gauge(
+    "swtpu_ha_role",
+    "This process's control-plane role (0=standby, 1=leader, 2=fenced "
+    "ex-leader)")
+HA_LEADER_EPOCH = _gauge(
+    "swtpu_ha_leader_epoch",
+    "Fenced leader epoch this process claimed (leaders only; every "
+    "journal record and scheduler->worker RPC carries it)")
+HA_LEASE_RENEWALS_TOTAL = _counter(
+    "swtpu_ha_lease_renewals_total",
+    "Leader liveness-lease rewrites (one per lease_interval_s while "
+    "healthy)")
+HA_FAILOVERS_TOTAL = _counter(
+    "swtpu_ha_failovers_total",
+    "Promotions this process won (standby -> leader transitions)")
+HA_PROMOTION_SECONDS = _histogram(
+    "swtpu_ha_promotion_seconds",
+    "Wall time from lease-lapse detection to the promotion claim being "
+    "durable (scheduler reconstruction adds its recovery time on top)")
+HA_FENCED_RPCS_TOTAL = _counter(
+    "swtpu_ha_fenced_rpcs_total",
+    "RPCs rejected by epoch fencing, by side (worker: a stale leader's "
+    "dispatch refused; scheduler: a fenced ex-leader refusing reports "
+    "so workers re-resolve)", ("side",))
+HA_REPLICATION_APPLIED_SEQ = _gauge(
+    "swtpu_ha_replication_applied_seq",
+    "Highest journal sequence the standby's warm twin has applied")
+HA_REPLICATION_RECORDS_TOTAL = _counter(
+    "swtpu_ha_replication_records_total",
+    "Journal records shipped into the standby's warm twin")
+HA_REPLICATION_LAG_SECONDS = _gauge(
+    "swtpu_ha_replication_lag_seconds",
+    "Standby replication lag: now minus the wall stamp of the last "
+    "journal record applied to the warm twin")
+
+# ----------------------------------------------------------------------
 # RPC resilience (runtime/resilience.py)
 # ----------------------------------------------------------------------
 

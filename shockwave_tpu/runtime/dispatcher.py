@@ -214,11 +214,15 @@ class Dispatcher:
                 execution_times=[r[2] for r in results],
                 iterator_logs=[r[3] for r in results])
         except (RpcUnavailableError, grpc.RpcError) as e:
-            # The scheduler stayed unreachable through the retry budget.
-            # Progress is durable in the iterator log / checkpoint; the
-            # scheduler's round watchdog synthesizes a failed micro-task
-            # and requeues the job, so dropping the report is safe — and
-            # far better than a dispatch thread wedged forever.
+            # The scheduler stayed unreachable through the retry budget
+            # — and, under control-plane HA, through the whole failover
+            # window too (notify_done holds the report and redelivers
+            # to a promoted leader re-resolved from the lease file
+            # before this path is reached). Progress is durable in the
+            # iterator log / checkpoint; the scheduler's round watchdog
+            # synthesizes a failed micro-task and requeues the job, so
+            # dropping the report is safe — and far better than a
+            # dispatch thread wedged forever.
             logger.error("dropping Done report for jobs %s (round %d): %s",
                          [r[0] for r in results], round_id, e)
 

@@ -231,6 +231,23 @@ class CircuitBreaker:
             get_observability().inc(obs_names.BREAKER_TRANSITIONS_TOTAL,
                                     to="closed")
 
+    def reset(self) -> None:
+        """Forget all failure history — for ENDPOINT CHANGES, not for
+        recoveries. A breaker's failure count is evidence about one
+        peer incarnation; when the peer's address or leader epoch
+        changes (scheduler failover, worker re-registration), carrying
+        an open circuit forward would fail the first calls to the NEW,
+        healthy incarnation fast — the stale-breaker pile-up that
+        turned every failover into a round of spurious retirements."""
+        with self._lock:
+            was_open = self._opened_at is not None
+            self._consecutive_failures = 0
+            self._opened_at = None
+            self._half_open_probe_inflight = False
+        if was_open:
+            get_observability().inc(obs_names.BREAKER_TRANSITIONS_TOTAL,
+                                    to="closed")
+
     def record_failure(self) -> None:
         with self._lock:
             was_open = self._opened_at is not None
@@ -253,12 +270,53 @@ class CircuitBreaker:
                                     to="open")
 
 
+#: gRPC metadata key carrying the fenced leader epoch on every
+#: scheduler->worker RPC (control-plane HA; see sched/ha.py).
+EPOCH_METADATA_KEY = "swtpu-leader-epoch"
+
+#: Fence verdicts (EpochFence.observe).
+EPOCH_OK = "ok"
+EPOCH_ADVANCED = "advanced"
+EPOCH_STALE = "stale"
+
+
+class EpochFence:
+    """Monotonic leader-epoch tracker — the worker-side half of fenced
+    failover. Every dispatch-effecting RPC carries the sender's epoch;
+    the fence remembers the highest ever seen and classifies each
+    arrival: ``ok`` (current leader), ``advanced`` (a new leader's
+    first contact — the observer should re-resolve endpoints and reset
+    breakers), ``stale`` (a deposed leader that has not noticed its
+    fencing — the server MUST reject, or a wedged-but-alive old leader
+    could double-dispatch work the new leader also placed)."""
+
+    def __init__(self, initial: int = 0):
+        self._lock = threading.Lock()
+        self._epoch = int(initial)
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def observe(self, epoch: int) -> str:
+        epoch = int(epoch)
+        with self._lock:
+            if epoch < self._epoch:
+                return EPOCH_STALE
+            if epoch > self._epoch:
+                self._epoch = epoch
+                return EPOCH_ADVANCED
+            return EPOCH_OK
+
+
 def call_with_retry(callable_, request, *, method: str,
                     policy: RetryPolicy,
                     breaker: CircuitBreaker | None = None,
                     retryable=RETRYABLE_CODES,
                     clock=time.monotonic, sleep=time.sleep,
-                    rng: Optional[random.Random] = None):
+                    rng: Optional[random.Random] = None,
+                    metadata=None):
     """Invoke a gRPC unary callable under deadline/retry/breaker policy.
 
     Raises `CircuitOpenError` without touching the network when the
@@ -291,8 +349,13 @@ def call_with_retry(callable_, request, *, method: str,
             raise RpcUnavailableError(method, attempt, last_code)
         deadline = (min(policy.deadline_s, remaining) if attempt > 0
                     else policy.deadline_s)
+        kwargs = {"timeout": max(deadline, 0.001)}
+        if metadata is not None:
+            # Only pass the kwarg when set: fault-test fakes (and some
+            # instrumented stubs) accept (request, timeout=...) only.
+            kwargs["metadata"] = metadata
         try:
-            response = callable_(request, timeout=max(deadline, 0.001))
+            response = callable_(request, **kwargs)
         except grpc.RpcError as e:
             if not (isinstance(e, grpc.RpcError) and e.code() in retryable):
                 # The peer ANSWERED (application-level error): transport

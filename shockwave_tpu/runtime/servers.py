@@ -18,10 +18,56 @@ from typing import Callable, Dict
 import grpc
 
 from ..core.job import JobIdPair
+from ..obs import get_observability
+from ..obs import names as obs_names
 from .proto import control_pb2 as pb
+from .resilience import EPOCH_ADVANCED, EPOCH_METADATA_KEY, EPOCH_STALE
 from .rpc import generic_handler
 
 logger = logging.getLogger("shockwave_tpu.runtime")
+
+
+def _metadata_epoch(context) -> int | None:
+    """The sender's leader epoch from invocation metadata, or None when
+    absent (HA disabled — every RPC passes unfenced)."""
+    for key, value in (context.invocation_metadata() or ()):
+        if key == EPOCH_METADATA_KEY:
+            try:
+                return int(value)
+            except ValueError:
+                return None
+    return None
+
+
+def _fenced(fn, fence, on_epoch_advance=None):
+    """Wrap a dispatch-effecting worker handler with the epoch fence:
+    a stale leader epoch is REJECTED (FAILED_PRECONDITION — the deposed
+    leader treats it as its own fencing signal), an advanced one is
+    adopted (and the observer re-resolves its scheduler endpoint /
+    resets breakers before the new leader's work runs)."""
+
+    def handler(request, context):
+        epoch = _metadata_epoch(context)
+        if epoch is not None:
+            verdict = fence.observe(epoch)
+            if verdict == EPOCH_STALE:
+                get_observability().inc(obs_names.HA_FENCED_RPCS_TOTAL,
+                                        side="worker")
+                logger.warning(
+                    "rejecting RPC from stale leader epoch %d (current "
+                    "epoch %d)", epoch, fence.epoch)
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"stale leader epoch {epoch} (worker has seen "
+                    f"{fence.epoch}); you have been superseded")
+            if verdict == EPOCH_ADVANCED and on_epoch_advance is not None:
+                try:
+                    on_epoch_advance(epoch)
+                except Exception:  # noqa: BLE001 - the refresh is an
+                    # optimization; the RPC itself must still run
+                    logger.exception("epoch-advance callback failed")
+        return fn(request, context)
+    return handler
 
 
 def get_host_ip() -> str:
@@ -32,8 +78,30 @@ def get_host_ip() -> str:
 
 
 def serve_scheduler(port: int, callbacks: Dict[str, Callable],
-                    max_workers: int = 32) -> grpc.Server:
-    """Start the scheduler-side server (non-blocking); returns the server."""
+                    max_workers: int = 32,
+                    fenced_check: Callable[[], bool] = None) -> grpc.Server:
+    """Start the scheduler-side server (non-blocking); returns the server.
+
+    `fenced_check` (control-plane HA): when it returns True, every
+    handler aborts with FAILED_PRECONDITION before touching scheduler
+    state — a fenced ex-leader must refuse reports rather than swallow
+    them, so workers re-resolve the endpoint and deliver to the real
+    leader instead."""
+
+    def _guard(fn):
+        if fenced_check is None:
+            return fn
+
+        def handler(request, context):
+            if fenced_check():
+                get_observability().inc(obs_names.HA_FENCED_RPCS_TOTAL,
+                                        side="scheduler")
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    "leader fenced: a higher epoch was claimed; "
+                    "re-resolve the scheduler endpoint")
+            return fn(request, context)
+        return handler
 
     def register_worker(request, context):
         try:
@@ -81,13 +149,13 @@ def serve_scheduler(port: int, callbacks: Dict[str, Callable],
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers((
         generic_handler("shockwave_tpu.WorkerToScheduler", {
-            "RegisterWorker": register_worker,
-            "Done": done,
+            "RegisterWorker": _guard(register_worker),
+            "Done": _guard(done),
         }),
         generic_handler("shockwave_tpu.IteratorToScheduler", {
-            "InitJob": init_job,
-            "UpdateLease": update_lease,
-            "UpdateResourceRequirement": update_resource_requirement,
+            "InitJob": _guard(init_job),
+            "UpdateLease": _guard(update_lease),
+            "UpdateResourceRequirement": _guard(update_resource_requirement),
         }),
     ))
     server.add_insecure_port(f"[::]:{port}")
@@ -97,8 +165,18 @@ def serve_scheduler(port: int, callbacks: Dict[str, Callable],
 
 
 def serve_worker(port: int, callbacks: Dict[str, Callable],
-                 max_workers: int = 16) -> grpc.Server:
-    """Start the worker-side server (non-blocking); returns the server."""
+                 max_workers: int = 16, fence=None,
+                 on_epoch_advance: Callable[[int], None] = None
+                 ) -> grpc.Server:
+    """Start the worker-side server (non-blocking); returns the server.
+
+    With a `fence` (resilience.EpochFence), every dispatch-effecting
+    handler (RunJob / KillJob / Reset / Shutdown) rejects RPCs carrying
+    a leader epoch lower than the highest this worker has seen —
+    fencing a deposed leader out of double-dispatching. Ping stays
+    unfenced: liveness probes must answer whoever asks (a fenced old
+    leader probing the fleet is harmless; a standby probing before its
+    first dispatch is essential)."""
 
     def run_job(request, context):
         jobs = [
@@ -132,13 +210,15 @@ def serve_worker(port: int, callbacks: Dict[str, Callable],
             cb()
         return pb.Empty()
 
+    guard = ((lambda fn: _fenced(fn, fence, on_epoch_advance))
+             if fence is not None else (lambda fn: fn))
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers((
         generic_handler("shockwave_tpu.SchedulerToWorker", {
-            "RunJob": run_job,
-            "KillJob": kill_job,
-            "Reset": reset,
-            "Shutdown": shutdown,
+            "RunJob": guard(run_job),
+            "KillJob": guard(kill_job),
+            "Reset": guard(reset),
+            "Shutdown": guard(shutdown),
             "Ping": ping,
         }),
     ))

@@ -56,13 +56,21 @@ class WorkerDaemon:
         self._last_dispatch_time = 0.0
         self._rpc_client = WorkerToSchedulerClient(sched_addr, sched_port)
 
+        # Control-plane HA: reject dispatches from a deposed leader
+        # (stale epoch -> FAILED_PRECONDITION via the server fence) and
+        # chase a promoted one (advanced epoch -> re-resolve the
+        # scheduler endpoint / reset breakers before its work runs).
+        self._fence = resilience.EpochFence()
+
         callbacks = {
             "RunJob": self._run_job,
             "KillJob": self._kill_job,
             "Reset": self._reset,
             "Shutdown": self._shutdown,
         }
-        self._server = serve_worker(worker_port, callbacks)
+        self._server = serve_worker(worker_port, callbacks,
+                                    fence=self._fence,
+                                    on_epoch_advance=self._on_epoch_advance)
 
         # Daemons race the scheduler at cluster bring-up (and the
         # scheduler may spend a minute importing before its server
@@ -103,10 +111,21 @@ class WorkerDaemon:
             sched_port=sched_port, run_dirs=run_dirs, data_dir=data_dir,
             checkpoint_dir=checkpoint_dir)
 
+    def _on_epoch_advance(self, epoch: int) -> None:
+        """A new leader's first dispatch reached this daemon: point the
+        report channel at it before the dispatched work needs to Done
+        (the client also self-heals lazily on its next failure, but the
+        eager refresh saves the first post-failover report a full
+        failover-retry loop)."""
+        logger.warning("leader epoch advanced to %d; re-resolving "
+                       "scheduler endpoint", epoch)
+        self._rpc_client.refresh_endpoint()
+
     def _obs_health(self) -> dict:
         return {
             "worker_type": self._worker_type,
             "worker_ids": list(getattr(self, "_worker_ids", [])),
+            "leader_epoch_seen": self._fence.epoch,
             "last_dispatch_age_s": round(
                 time.time() - self._last_dispatch_time, 3)
             if self._last_dispatch_time else None,

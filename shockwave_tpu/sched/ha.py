@@ -1,0 +1,532 @@
+"""Control-plane high availability: journal-shipping hot standby with
+fenced automatic failover.
+
+The scheduler process itself was the last single point of failure:
+every worker, job and serving replica already survives a crash, but
+recovering the control plane meant a human running ``--resume`` (PR 2).
+This module closes that gap with the standard lease-and-epoch recipe,
+built entirely on machinery the tree already trusts:
+
+- **Liveness lease** (``<state_dir>/leader.lease``): the leader rewrites
+  a small JSON lease (epoch, endpoint, wall stamp) every
+  ``lease_interval_s`` via the crash-safe ``write_text_atomic`` path.
+  A standby that sees the stamp age past ``lease_ttl_s`` declares the
+  leader dead and tries to promote. The same file doubles as the
+  **endpoint registry**: worker-side clients re-resolve the scheduler
+  address from it across a failover (``SWTPU_HA_ENDPOINT_FILE``).
+
+- **Fenced epochs** (``<state_dir>/epoch.<n>.claim``): leadership of
+  epoch *n* is claimed by creating the claim file with
+  ``O_CREAT|O_EXCL`` — the filesystem's compare-and-swap, so exactly
+  one process can ever win an epoch. The epoch rides every
+  scheduler->worker RPC as gRPC metadata (``swtpu-leader-epoch``) and
+  every journal record; workers reject lower epochs
+  (FAILED_PRECONDITION), recovery discards a deposed leader's
+  post-fencing journal writes (``journal.filter_epoch_chain``), and a
+  leader that observes a higher claim **self-fences** (stops
+  journaling and dispatching, exits). A wedged-but-alive old leader —
+  the gray case PR 8 taught us to fear — can therefore never
+  double-dispatch: its RPCs are refused at every worker and its writes
+  are superseded on disk.
+
+- **Hot standby** (`HotStandby`): a second scheduler process tails the
+  leader's journal with the streaming `journal.JournalFollower` and
+  keeps a warm, near-current in-memory twin (the what-if ``thaw``
+  replay path: ``restore_from_durable_state`` + incremental
+  ``_apply_journal_event``). The twin is ADVISORY — it powers the
+  replication-lag metrics and instant read-only answers — while
+  promotion itself re-enters through the conservative PR 2 recovery
+  path (`load_state` + in-flight requeue with no failure charge +
+  orphan gates), so correctness never rests on the incremental feed.
+
+Split-brain windows are bounded, not wished away: between a standby's
+claim and its first dispatch, the old leader may still be running. The
+guarantees that hold REGARDLESS of timing are (a) workers execute
+dispatches from at most the highest epoch they have seen, and (b) the
+surviving journal chain contains exactly one writer per epoch. Both are
+asserted by the leader-kill/leader-freeze chaos schedules
+(``scripts/drivers/chaos_campaign.py --ha_schedules``).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..core.durable_io import fsync_dir, write_text_atomic
+from ..obs import names as obs_names
+
+logger = logging.getLogger("shockwave_tpu.sched.ha")
+
+LEASE_NAME = "leader.lease"
+PROMOTION_NAME = "promotion.json"
+_CLAIM_RE = re.compile(r"^epoch\.(\d{12})\.claim$")
+
+#: Role gauge values (swtpu_ha_role).
+ROLE_STANDBY = 0.0
+ROLE_LEADER = 1.0
+ROLE_FENCED = 2.0
+
+
+class EpochClaimError(RuntimeError):
+    """Another process won the epoch this one tried to claim."""
+
+
+@dataclass(frozen=True)
+class HAConfig:
+    """Knobs of the control-plane HA layer. Defaults suit the loopback
+    drives (sub-second rounds); production deployments scale the lease
+    knobs with their round duration. README "Control-plane HA"
+    documents each knob."""
+    #: Leader lease rewrite cadence. Must be well under lease_ttl_s or
+    #: a busy leader's late renewal reads as death.
+    lease_interval_s: float = 0.5
+    #: Lease stamp age at which a standby declares the leader dead and
+    #: attempts promotion. The failover detection floor.
+    lease_ttl_s: float = 2.5
+    #: Standby journal-tail / lease-watch cadence.
+    standby_poll_interval_s: float = 0.25
+    #: How long worker-side clients keep re-resolving + retrying a
+    #: report (Done / lease RPC) across a failover window before
+    #: dropping it (the round watchdog then requeues the job).
+    failover_budget_s: float = 30.0
+    #: Address the leader advertises in the lease (workers re-resolve
+    #: to it). Loopback drives use 127.0.0.1.
+    advertise_addr: str = "127.0.0.1"
+    #: Epoch already claimed by the promoting standby (set internally
+    #: by the --ha_standby driver path; fresh leaders claim their own).
+    claimed_epoch: Optional[int] = None
+
+    @classmethod
+    def from_dict(cls, config: Optional[dict]) -> "HAConfig":
+        if not config:
+            return cls()
+        config = {k: v for k, v in config.items()
+                  if not k.startswith("_")}  # config-file comments
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(config) - known
+        if unknown:
+            raise ValueError(f"unknown ha option(s): {sorted(unknown)}")
+        return cls(**config)
+
+
+# ----------------------------------------------------------------------
+# Lease + epoch-claim files
+# ----------------------------------------------------------------------
+
+def lease_path(state_dir: str) -> str:
+    return os.path.join(state_dir, LEASE_NAME)
+
+
+def write_lease(state_dir: str, epoch: int, addr: str, port: int,
+                stamp: Optional[float] = None,
+                failover_budget_s: Optional[float] = None) -> None:
+    """Atomically rewrite the leader lease (tmp + fsync + rename + dir
+    fsync — a crash leaves whole-old or whole-new, never torn, so a
+    standby's JSON parse can only fail on a genuinely foreign file).
+    The lease doubles as the worker-side config channel: clients read
+    `failover_budget_s` (how long to hold reports across a failover)
+    from it, so the operator tunes ONE --ha block, not every daemon's
+    environment."""
+    lease = {
+        "epoch": int(epoch), "addr": addr, "port": int(port),
+        "pid": os.getpid(),
+        "stamp": time.time() if stamp is None else stamp,
+    }
+    if failover_budget_s is not None:
+        lease["failover_budget_s"] = float(failover_budget_s)
+    write_text_atomic(lease_path(state_dir),
+                      json.dumps(lease, sort_keys=True) + "\n")
+
+
+def read_lease(state_dir: str) -> Optional[dict]:
+    """The current lease, or None when absent/unparseable (a torn
+    foreign file is treated as no lease — the TTL clock, not the parse,
+    decides liveness)."""
+    try:
+        with open(lease_path(state_dir)) as f:
+            lease = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return lease if isinstance(lease, dict) else None
+
+
+def _claim_path(state_dir: str, epoch: int) -> str:
+    return os.path.join(state_dir, f"epoch.{epoch:012d}.claim")
+
+
+def max_claimed_epoch(state_dir: str) -> int:
+    """Highest epoch any process has ever claimed in this state dir
+    (0 when none)."""
+    try:
+        names = os.listdir(state_dir)
+    except OSError:
+        return 0
+    epochs = [int(m.group(1)) for name in names
+              for m in (_CLAIM_RE.match(name),) if m]
+    return max(epochs, default=0)
+
+
+def try_claim_epoch(state_dir: str, epoch: int, role: str) -> bool:
+    """Atomically claim leadership of `epoch` — the fencing CAS.
+
+    ``O_CREAT|O_EXCL`` guarantees exactly one winner per epoch number
+    even when several standbys race a promotion. The claim file (and
+    the directory entry making it durable) is fsync'd before returning
+    True: a claim a crash can un-happen would let two processes each
+    believe they won."""
+    path = _claim_path(state_dir, epoch)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+    except FileExistsError:
+        return False
+    try:
+        os.write(fd, json.dumps({
+            "epoch": int(epoch), "pid": os.getpid(), "role": role,
+            "time": time.time()}, sort_keys=True).encode() + b"\n")
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    fsync_dir(state_dir)
+    return True
+
+
+def claim_next_epoch(state_dir: str, role: str,
+                     attempts: int = 64) -> int:
+    """Claim the next free epoch (fresh-leader startup, where losing a
+    race just means taking the next number). Promotion paths use
+    `try_claim_epoch` on exactly max+1 instead — there, losing the race
+    means someone ELSE is promoting and this process must stand down."""
+    for _ in range(attempts):
+        epoch = max_claimed_epoch(state_dir) + 1
+        if try_claim_epoch(state_dir, epoch, role):
+            return epoch
+    raise EpochClaimError(
+        f"{state_dir}: could not claim an epoch in {attempts} attempts "
+        "(claim churn — is a promotion storm running?)")
+
+
+# ----------------------------------------------------------------------
+# Leader side
+# ----------------------------------------------------------------------
+
+class HAController:
+    """Leader-side HA duties: own a claimed epoch, renew the liveness
+    lease, and self-fence the moment a higher claim appears.
+
+    The renewal thread is the leader's deadman switch: every interval
+    it (a) checks `max_claimed_epoch` — a higher number means a standby
+    promoted over us (we were frozen, partitioned, or wedged) and the
+    `on_fenced` callback fires exactly once; (b) rewrites the lease.
+    A SIGSTOPped leader renews nothing; when SIGCONTed, the very next
+    tick discovers the successor's claim and fences — bounding the
+    zombie's write window to one renewal interval plus whatever the
+    worker-side epoch rejection already refused.
+    """
+
+    def __init__(self, state_dir: str, cfg: HAConfig, port: int,
+                 obs=None, on_fenced: Optional[Callable[[int], None]] = None):
+        self.state_dir = state_dir
+        self.cfg = cfg
+        self.port = int(port)
+        if obs is None:
+            from ..obs import get_observability
+            obs = get_observability()
+        self._obs = obs
+        self._on_fenced = on_fenced
+        self._fenced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if cfg.claimed_epoch is not None:
+            self.epoch = int(cfg.claimed_epoch)
+        else:
+            self.epoch = claim_next_epoch(state_dir, role="leader")
+        self._obs.set_gauge(obs_names.HA_LEADER_EPOCH, self.epoch)
+        self._obs.set_gauge(obs_names.HA_ROLE, ROLE_LEADER)
+        logger.info("HA leader epoch %d claimed (state dir %s)",
+                    self.epoch, state_dir)
+
+    @property
+    def fenced(self) -> bool:
+        return self._fenced.is_set()
+
+    def epoch_value(self) -> Optional[int]:
+        """Current epoch for outbound RPC metadata (clients call this
+        per RPC; it is immutable for the incarnation's lifetime)."""
+        return self.epoch
+
+    def start(self) -> "HAController":
+        """Write the first lease and start the renewal thread (call
+        once the gRPC port is bound — the lease advertises it)."""
+        self._renew_once()
+        self._thread = threading.Thread(target=self._renew_loop,
+                                        name="ha-lease", daemon=True)
+        self._thread.start()
+        return self
+
+    def _renew_once(self) -> bool:
+        """One deadman tick. Returns False once fenced."""
+        highest = max_claimed_epoch(self.state_dir)
+        if highest > self.epoch:
+            if not self._fenced.is_set():
+                self._fenced.set()
+                self._obs.set_gauge(obs_names.HA_ROLE, ROLE_FENCED)
+                logger.warning(
+                    "HA leader epoch %d FENCED: epoch %d was claimed by "
+                    "a successor; ceasing journal writes and dispatch",
+                    self.epoch, highest)
+                if self._on_fenced is not None:
+                    self._on_fenced(highest)
+            return False
+        write_lease(self.state_dir, self.epoch,
+                    self.cfg.advertise_addr, self.port,
+                    failover_budget_s=self.cfg.failover_budget_s)
+        self._obs.inc(obs_names.HA_LEASE_RENEWALS_TOTAL)
+        return True
+
+    def _renew_loop(self) -> None:
+        while not self._stop.wait(self.cfg.lease_interval_s):
+            try:
+                if not self._renew_once():
+                    return
+            except Exception:  # noqa: BLE001 - the deadman must not die
+                logger.exception("HA lease renewal tick failed")
+
+    def fence_now(self) -> None:
+        """Fence from the dispatch path (a worker rejected our epoch):
+        same transition as the renewal thread's discovery, callable from
+        under the scheduler lock."""
+        if not self._fenced.is_set():
+            self._fenced.set()
+            self._obs.set_gauge(obs_names.HA_ROLE, ROLE_FENCED)
+            logger.warning("HA leader epoch %d fenced by a worker's "
+                           "stale-epoch rejection", self.epoch)
+            if self._on_fenced is not None:
+                self._on_fenced(self.epoch + 1)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+# ----------------------------------------------------------------------
+# Standby side
+# ----------------------------------------------------------------------
+
+@dataclass
+class PromotionRecord:
+    """What a successful promotion measured (mirrored to
+    ``<state_dir>/promotion.json`` for the chaos driver)."""
+    epoch: int
+    #: Wall time the standby declared the lease lapsed.
+    detected_at: float
+    #: Wall stamp of the last lease the dead leader wrote (failover
+    #: latency is measured from stamp + ttl, the earliest any standby
+    #: could have acted).
+    last_lease_stamp: float
+    #: Journal seq the warm twin had applied at promotion.
+    applied_seq: int
+    #: Twin replication lag at promotion (now - last record walltime).
+    replication_lag_s: float
+
+
+class HotStandby:
+    """The standby process: tail the leader's journal, keep a warm twin,
+    promote when the lease lapses.
+
+    ``twin_factory`` builds a detached simulation-mode `Scheduler`
+    (typically via ``whatif.fork.twin_config``) that journal events are
+    replayed into; pass None to follow without a twin (fsck --follow
+    style lag watching). The twin is rebuilt from `load_state` whenever
+    the follower falls behind compaction.
+    """
+
+    def __init__(self, state_dir: str, cfg: HAConfig,
+                 twin_factory: Optional[Callable[[], object]] = None,
+                 obs=None, clock=time.time):
+        from .journal import JournalFollower, load_state
+        self.state_dir = state_dir
+        self.cfg = cfg
+        self._twin_factory = twin_factory
+        self._clock = clock
+        if obs is None:
+            from ..obs import get_observability
+            obs = get_observability()
+        self._obs = obs
+        self._load_state = load_state
+        self._follower_cls = JournalFollower
+        self.twin = None
+        self.follower: Optional[JournalFollower] = None
+        self._last_seen_stamp: Optional[float] = None
+        self._obs.set_gauge(obs_names.HA_ROLE, ROLE_STANDBY)
+        self._rebuild_twin()
+
+    # -- twin maintenance ---------------------------------------------
+
+    def _rebuild_twin(self) -> None:
+        """(Re)seed the twin and follower from durable state — initial
+        warm-up, and the behind-compaction recovery path."""
+        start_seq = 0
+        if self._twin_factory is not None:
+            self.twin = self._twin_factory()
+            try:
+                recovered = self._load_state(self.state_dir)
+                self.twin.restore_from_durable_state(recovered)
+                start_seq = recovered.last_seq
+            except Exception:  # noqa: BLE001 - an empty/new state dir is
+                # normal at bring-up; the follower starts from seq 0 and
+                # the twin warms as the leader writes.
+                logger.info("standby twin starts empty (no recoverable "
+                            "state yet)", exc_info=True)
+        else:
+            snapshot_seq = self._follower_cls(self.state_dir
+                                              ).snapshot_horizon()
+            start_seq = snapshot_seq
+        self.follower = self._follower_cls(self.state_dir,
+                                           start_after_seq=start_seq)
+
+    def _apply(self, events) -> None:
+        if self.twin is None:
+            return
+        # Same suspension contract as restore_from_durable_state: the
+        # twin must never re-journal (it has no layer anyway) nor gate
+        # replayed admissions through a what-if plane.
+        self.twin._replaying = True
+        try:
+            for event in events:
+                self.twin._apply_journal_event(event.get("type", "?"),
+                                               event.get("data", {}))
+        finally:
+            self.twin._replaying = False
+
+    def poll_once(self) -> str:
+        """One standby tick: ship new journal records into the twin and
+        refresh the replication gauges. Returns the follower status."""
+        from .journal import FOLLOW_BEHIND
+        events, status = self.follower.poll()
+        if events:
+            self._apply(events)
+            self._obs.inc(obs_names.HA_REPLICATION_RECORDS_TOTAL,
+                          amount=len(events))
+        if status == FOLLOW_BEHIND:
+            logger.warning("standby fell behind journal compaction at "
+                           "seq %d; rebuilding twin from snapshot",
+                           self.follower.last_seq)
+            self._rebuild_twin()
+        self._obs.set_gauge(obs_names.HA_REPLICATION_APPLIED_SEQ,
+                            self.follower.last_seq)
+        if self.follower.last_record_walltime is not None:
+            self._obs.set_gauge(
+                obs_names.HA_REPLICATION_LAG_SECONDS,
+                max(self._clock() - self.follower.last_record_walltime,
+                    0.0))
+        return status
+
+    # -- liveness / promotion -----------------------------------------
+
+    def leader_lapsed(self) -> bool:
+        """Whether the leader's lease is past its TTL. A state dir with
+        NO lease yet is not lapsed — the leader may simply not have
+        started; a standby never promotes over a leader it has never
+        seen (bring-up ordering, not failure)."""
+        lease = read_lease(self.state_dir)
+        if lease is None:
+            return False
+        self._last_seen_stamp = float(lease.get("stamp", 0.0))
+        return self._clock() - self._last_seen_stamp >= self.cfg.lease_ttl_s
+
+    def try_promote(self) -> Optional[PromotionRecord]:
+        """Attempt the promotion CAS (claim exactly max+1). Returns the
+        record on victory; None when another claimant won — the caller
+        returns to standby (the winner's lease will appear)."""
+        detected = self._clock()
+        epoch = max_claimed_epoch(self.state_dir) + 1
+        if not try_claim_epoch(self.state_dir, epoch, role="standby"):
+            logger.warning("promotion race lost for epoch %d; resuming "
+                           "standby", epoch)
+            return None
+        # Advertise IMMEDIATELY (with the promoting process's pid but
+        # the not-yet-bound port): other standbys see a fresh stamp and
+        # stand down while this one reconstructs the scheduler.
+        write_lease(self.state_dir, epoch, self.cfg.advertise_addr,
+                    self._promote_port,
+                    failover_budget_s=self.cfg.failover_budget_s)
+        lag = (self._clock() - self.follower.last_record_walltime
+               if self.follower.last_record_walltime is not None else 0.0)
+        record = PromotionRecord(
+            epoch=epoch, detected_at=detected,
+            last_lease_stamp=self._last_seen_stamp or 0.0,
+            applied_seq=self.follower.last_seq,
+            replication_lag_s=max(lag, 0.0))
+        self._obs.inc(obs_names.HA_FAILOVERS_TOTAL)
+        logger.warning(
+            "standby PROMOTING as epoch %d (lease lapsed %.2fs ago; "
+            "twin applied seq %d, replication lag %.3fs)", epoch,
+            detected - (self._last_seen_stamp or detected),
+            record.applied_seq, record.replication_lag_s)
+        return record
+
+    _promote_port = 0  # set by run_until_promoted
+
+    def run_until_promoted(self, port: int,
+                           stop: Optional[threading.Event] = None
+                           ) -> Optional[PromotionRecord]:
+        """Follow + watch until this process wins a promotion (or `stop`
+        is set). Writes ``promotion.json`` with the measured latency;
+        the caller then constructs the real PhysicalScheduler with
+        ``resume=True`` and ``ha.claimed_epoch`` from the record — the
+        conservative crash-recovery path, exactly as if an operator had
+        restarted it by hand, minus the operator."""
+        self._promote_port = int(port)
+        while stop is None or not stop.is_set():
+            self.poll_once()
+            if self.leader_lapsed():
+                record = self.try_promote()
+                if record is not None:
+                    promoted_wall = self._clock()
+                    write_text_atomic(
+                        os.path.join(self.state_dir, PROMOTION_NAME),
+                        json.dumps({
+                            "epoch": record.epoch,
+                            "detected_at": record.detected_at,
+                            "last_lease_stamp": record.last_lease_stamp,
+                            "promoted_at": promoted_wall,
+                            "from_lease_expiry_s": max(
+                                promoted_wall - (record.last_lease_stamp
+                                                 + self.cfg.lease_ttl_s),
+                                0.0),
+                            "applied_seq": record.applied_seq,
+                            "replication_lag_s": record.replication_lag_s,
+                        }, indent=1, sort_keys=True) + "\n")
+                    self._obs.observe(
+                        obs_names.HA_PROMOTION_SECONDS,
+                        max(promoted_wall - record.detected_at, 0.0))
+                    return record
+            time.sleep(self.cfg.standby_poll_interval_s)
+        return None
+
+    def health(self) -> dict:
+        """Standby /healthz block."""
+        lease = read_lease(self.state_dir)
+        now = self._clock()
+        lag = (now - self.follower.last_record_walltime
+               if self.follower and self.follower.last_record_walltime
+               is not None else None)
+        return {"ha": {
+            "role": "standby",
+            "leader_epoch": lease.get("epoch") if lease else None,
+            "leader_lease_age_s": (
+                round(now - float(lease.get("stamp", 0.0)), 3)
+                if lease else None),
+            "applied_seq": self.follower.last_seq if self.follower else 0,
+            "replication_lag_s": (round(lag, 3)
+                                  if lag is not None else None),
+            "stale_records_dropped": (self.follower.stale_dropped
+                                      if self.follower else 0),
+        }}

@@ -34,6 +34,19 @@ Record frame: ``<u32 payload_len> <u32 crc32(payload)> <payload>`` where
 payload is UTF-8 JSON ``{"seq": n, "type": str, "t": wall, "data": {...}}``.
 Files start with an 8-byte magic so an unrelated file is rejected loudly
 rather than replayed.
+
+**Leader epochs (control-plane HA):** when the scheduler runs under the
+HA controller (`sched/ha.py`), every record additionally carries the
+writer's fenced leader epoch (``"epoch": n``). Along the sequence chain
+epochs are non-decreasing in any correct history — a record whose epoch
+is LOWER than one already seen at an earlier-or-equal sequence was
+written by a deposed leader that had not yet noticed its fencing (the
+wedged-but-alive gray case). `filter_epoch_chain` deterministically
+discards those stale-writer orphans; `load_state` applies it so a
+promoted standby never replays a zombie's writes, and each HA
+incarnation opens a FRESH segment (`rotate_on_open`) so a zombie's
+leftover file descriptor can only ever append to a segment the new
+leader no longer writes.
 """
 from __future__ import annotations
 
@@ -258,6 +271,52 @@ def has_state(state_dir: str) -> bool:
     return False
 
 
+def filter_epoch_chain(events: List[dict]) -> Tuple[List[dict], List[dict]]:
+    """Drop stale-writer orphans from a seq-sorted event list.
+
+    Invariant of a correct single-writer-per-epoch history: walking the
+    records in sequence order, the leader epoch never decreases, and no
+    sequence number is written twice. A deposed leader that keeps
+    appending (frozen across its own fencing) violates both — its
+    records carry an epoch LOWER than the chain's high-water mark, or
+    duplicate a sequence the new leader already claimed. Rule, applied
+    deterministically:
+
+    - for duplicate seqs, the record with the HIGHEST epoch wins
+      (epoch-less duplicates lose to any epoch-tagged record);
+    - a record whose epoch is below the high-water epoch of the kept
+      chain so far is discarded;
+    - records with no epoch field (pre-HA journals, HA disabled) are
+      never discarded on epoch grounds.
+
+    Returns ``(kept, orphans)``; input must already be sorted by seq.
+    """
+    kept: List[dict] = []
+    orphans: List[dict] = []
+    max_epoch: Optional[int] = None
+    i, n = 0, len(events)
+    while i < n:
+        j = i
+        seq = int(events[i].get("seq", 0))
+        while j < n and int(events[j].get("seq", 0)) == seq:
+            j += 1
+        group = events[i:j]
+        winner = max(
+            group, key=lambda r: -1 if r.get("epoch") is None
+            else int(r["epoch"]))
+        orphans.extend(r for r in group if r is not winner)
+        epoch = winner.get("epoch")
+        if (epoch is not None and max_epoch is not None
+                and int(epoch) < max_epoch):
+            orphans.append(winner)
+        else:
+            kept.append(winner)
+            if epoch is not None:
+                max_epoch = max(max_epoch or 0, int(epoch))
+        i = j
+    return kept, orphans
+
+
 @dataclass
 class RecoveredState:
     """Everything recovery needs: newest snapshot (or None) plus every
@@ -266,6 +325,9 @@ class RecoveredState:
     events: List[dict] = field(default_factory=list)
     tail_status: str = TAIL_CLEAN
     segments: List[str] = field(default_factory=list)
+    #: Stale-writer records discarded by `filter_epoch_chain` (writes a
+    #: deposed leader landed after its fencing; see module docstring).
+    stale_orphans: List[dict] = field(default_factory=list)
 
     @property
     def last_seq(self) -> int:
@@ -296,6 +358,13 @@ def load_state(state_dir: str) -> RecoveredState:
             tail = status
         events.extend(r for r in records if int(r.get("seq", 0)) > min_seq)
     events.sort(key=lambda r: int(r.get("seq", 0)))
+    events, orphans = filter_epoch_chain(events)
+    if orphans:
+        logger.warning(
+            "discarded %d stale-writer journal record(s) superseded by a "
+            "higher leader epoch (a deposed leader wrote past its "
+            "fencing); seqs %s", len(orphans),
+            sorted({int(r.get("seq", 0)) for r in orphans})[:10])
     if snapshot is None and events and int(events[0].get("seq", 0)) > 1:
         raise JournalError(
             f"{state_dir}: no readable snapshot, and the journal starts "
@@ -304,7 +373,174 @@ def load_state(state_dir: str) -> RecoveredState:
             "now-unreadable snapshots) — state is unrecoverable; run "
             "scripts/utils/fsck_journal.py for details")
     return RecoveredState(snapshot=snapshot, events=events,
-                          tail_status=tail, segments=segments)
+                          tail_status=tail, segments=segments,
+                          stale_orphans=orphans)
+
+
+# ----------------------------------------------------------------------
+# Streaming follower (hot standby / fsck --follow)
+# ----------------------------------------------------------------------
+
+#: Follower poll outcomes beyond the shared tail statuses.
+FOLLOW_WAIT = "wait"        # torn/partial tail right now: poll again
+FOLLOW_BEHIND = "behind"    # compaction outran us: reload from snapshot
+
+
+class JournalFollower:
+    """Incremental reader that tails a LIVE journal while the leader is
+    still appending to it — the standby's replication feed and fsck's
+    ``--follow`` mode.
+
+    Unlike `read_journal`, a partial frame at end-of-file is WAIT (the
+    writer is mid-append, or its fsync has not landed), never
+    corruption: the follower keeps its offset at the last whole record
+    and re-reads the tail on the next poll. If a crash later truncates
+    that torn tail, re-reading from the valid offset parses the
+    replacement bytes cleanly. Epoch fencing is applied on the fly with
+    the same supersede rule recovery uses (`filter_epoch_chain`), so a
+    deposed leader's post-fencing appends never reach the twin.
+
+    The follower also detects falling behind compaction: when a new
+    snapshot's horizon passes the last delivered sequence while the
+    covering segments are already deleted, `poll` returns FOLLOW_BEHIND
+    and the caller must rebuild from `load_state` (then resume with a
+    fresh follower seeded at the new sequence).
+    """
+
+    def __init__(self, state_dir: str, start_after_seq: int = 0):
+        self.state_dir = state_dir
+        self.last_seq = int(start_after_seq)
+        self.last_record_walltime: Optional[float] = None
+        self.max_epoch: Optional[int] = None
+        self.stale_dropped = 0
+        self.records_delivered = 0
+        # path -> byte offset just past the last WHOLE record parsed
+        # (magic included).
+        self._offsets: dict = {}
+        # path -> highest epoch ever read from that segment: a torn
+        # tail on a SUPERSEDED writer's segment (a dead/deposed
+        # leader's never-reopened file) is ignorable debris, not a
+        # pending write — see poll().
+        self._seg_epoch: dict = {}
+        # (mtime_ns, size) -> horizon cache: the behind-compaction
+        # probe runs on every idle poll, and unpickling a fleet-sized
+        # snapshot each 100ms would dominate the standby's CPU.
+        self._snap_stat = None
+        self._snap_horizon = 0
+
+    def snapshot_horizon(self) -> int:
+        """last_seq of the current on-disk snapshot (0 when none) —
+        the staleness probe for the behind-compaction check. Cached by
+        the snapshot file's (mtime, size); only a rewritten snapshot is
+        re-read."""
+        try:
+            st = os.stat(os.path.join(self.state_dir, SNAPSHOT_NAME))
+            stat_key = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            stat_key = None
+        if stat_key != self._snap_stat or self._snap_stat is None:
+            snapshot = load_snapshot(self.state_dir)
+            self._snap_horizon = (int(snapshot.get("last_seq", 0))
+                                  if snapshot else 0)
+            self._snap_stat = stat_key
+        return self._snap_horizon
+
+    def _poll_segment(self, path: str) -> Tuple[List[dict], str]:
+        """New whole records of one segment since the last poll."""
+        start = self._offsets.get(path, len(JOURNAL_MAGIC))
+        try:
+            with open(path, "rb") as f:
+                if start == len(JOURNAL_MAGIC):
+                    magic = f.read(len(JOURNAL_MAGIC))
+                    if magic != JOURNAL_MAGIC:
+                        raise JournalError(f"{path}: bad journal magic")
+                else:
+                    f.seek(start)
+                blob = f.read()
+        except FileNotFoundError:
+            # Compacted away under us; anything unread is judged by the
+            # behind-compaction check in poll().
+            return [], TAIL_CLEAN
+        records, valid, status = _scan_records(blob)
+        self._offsets[path] = start + valid
+        return records, status
+
+    def poll(self) -> Tuple[List[dict], str]:
+        """Read every record appended since the last poll, fenced and
+        deduplicated, in sequence order.
+
+        Returns ``(events, status)`` where status is TAIL_CLEAN (caught
+        up at a record boundary), FOLLOW_WAIT (a torn tail is pending —
+        poll again) or FOLLOW_BEHIND (compaction deleted events this
+        follower never read; rebuild from `load_state`).
+        """
+        raw: List[dict] = []
+        torn_paths: List[str] = []
+        for path in list_segments(self.state_dir):
+            records, seg_status = self._poll_segment(path)
+            raw.extend(records)
+            epochs = [int(r["epoch"]) for r in records
+                      if r.get("epoch") is not None]
+            if epochs:
+                self._seg_epoch[path] = max(
+                    self._seg_epoch.get(path, 0), max(epochs))
+            if seg_status != TAIL_CLEAN:
+                torn_paths.append(path)
+        raw.sort(key=lambda r: int(r.get("seq", 0)))
+        # A zombie's append can DUPLICATE a sequence already delivered
+        # (its stale write landed after the winner's was shipped): the
+        # seq cursor filters it out of the feed, but it still counts as
+        # a fenced stale record for the lag/diagnostic surfaces.
+        if self.max_epoch is not None:
+            self.stale_dropped += sum(
+                1 for r in raw
+                if int(r.get("seq", 0)) <= self.last_seq
+                and r.get("epoch") is not None
+                and int(r["epoch"]) < self.max_epoch)
+        fresh, orphans = filter_epoch_chain(
+            [r for r in raw if int(r.get("seq", 0)) > self.last_seq])
+        # Fencing is STATEFUL across polls: a stale writer's records
+        # must lose to a higher epoch delivered on an earlier poll too.
+        if self.max_epoch is not None:
+            still = [r for r in fresh
+                     if r.get("epoch") is None
+                     or int(r["epoch"]) >= self.max_epoch]
+            orphans.extend(r for r in fresh if r not in still)
+            fresh = still
+        self.stale_dropped += len(orphans)
+        out: List[dict] = []
+        for rec in fresh:
+            seq = int(rec.get("seq", 0))
+            if seq != self.last_seq + 1:
+                # A gap inside the live stream: either compaction
+                # outran us (judged below) or events were lost; stop at
+                # the gap so the caller decides with a clean cursor.
+                break
+            out.append(rec)
+            self.last_seq = seq
+            if rec.get("epoch") is not None:
+                self.max_epoch = max(self.max_epoch or 0,
+                                     int(rec["epoch"]))
+            if rec.get("t") is not None:
+                self.last_record_walltime = float(rec["t"])
+        self.records_delivered += len(out)
+        # Tail status, decided AFTER this poll's epochs are folded in:
+        # a torn tail on a segment whose writer is superseded (its
+        # highest epoch is below the chain's) can never complete — the
+        # dead leader's file is never reopened — so it is ignorable
+        # debris, not a pending write to WAIT for.
+        status = TAIL_CLEAN
+        for path in torn_paths:
+            seg_epoch = self._seg_epoch.get(path)
+            superseded = (self.max_epoch is not None
+                          and seg_epoch is not None
+                          and seg_epoch < self.max_epoch)
+            if not superseded:
+                status = FOLLOW_WAIT
+        if (not out and status == TAIL_CLEAN
+                and self.snapshot_horizon() > self.last_seq):
+            return [], FOLLOW_BEHIND
+        return out, status
 
 
 class DurabilityLayer:
@@ -313,10 +549,17 @@ class DurabilityLayer:
     the round loop all emit)."""
 
     def __init__(self, state_dir: str,
-                 snapshot_interval_rounds: int = 10, obs=None):
+                 snapshot_interval_rounds: int = 10, obs=None,
+                 epoch: Optional[int] = None,
+                 rotate_on_open: bool = False):
         os.makedirs(state_dir, exist_ok=True)
         self.state_dir = state_dir
         self.snapshot_interval_rounds = snapshot_interval_rounds
+        # Fenced leader epoch (control-plane HA): stamped on every
+        # record so recovery and fsck can discard a deposed leader's
+        # post-fencing writes (filter_epoch_chain). None = HA disabled,
+        # records stay untagged.
+        self._epoch = None if epoch is None else int(epoch)
         # Observability: append/fsync latency histograms, byte counters
         # and journal-fsync spans. The owning scheduler injects its
         # bundle; standalone layers (tests, fsck) fall back to the
@@ -342,21 +585,59 @@ class DurabilityLayer:
         # needed by a snapshot generation that no longer exists).
         self._snap_seq = last_seq
         segments = list_segments(state_dir)
-        for path in reversed(segments):
-            records, _ = read_journal(path)
-            if records:
-                last_seq = max(last_seq, int(records[-1].get("seq", 0)))
-                break
+        if rotate_on_open:
+            # HA incarnation: resume numbering after the newest
+            # SURVIVING record. All segments are scanned (bounded to
+            # ~2 snapshot intervals) through the epoch supersede rule,
+            # so a deposed leader's stale tail records can never
+            # inflate the sequence this incarnation continues from.
+            all_records: List[dict] = []
+            for path in segments:
+                records, _ = read_journal(path)
+                all_records.extend(records)
+            all_records.sort(key=lambda r: int(r.get("seq", 0)))
+            kept, _ = filter_epoch_chain(all_records)
+            if kept:
+                last_seq = max(last_seq, int(kept[-1].get("seq", 0)))
+        else:
+            # Single-writer history: the newest non-empty segment's
+            # last record is authoritative (no stale-writer records
+            # can exist to supersede).
+            for path in reversed(segments):
+                records, _ = read_journal(path)
+                if records:
+                    last_seq = max(last_seq,
+                                   int(records[-1].get("seq", 0)))
+                    break
         self._seq = last_seq
-        # Continue the newest segment (its torn tail, if any, is truncated
-        # by JournalWriter) or start the first one.
-        path = segments[-1] if segments else _segment_path(state_dir,
-                                                           last_seq + 1)
+        if rotate_on_open or not segments:
+            # HA incarnations NEVER continue an inherited segment: a
+            # deposed-but-alive predecessor may still hold an open file
+            # descriptor into it, and two writers interleaving appends
+            # in one file is unframeable corruption. A fresh segment
+            # confines the zombie to files this incarnation only reads.
+            path = _segment_path(state_dir, last_seq + 1)
+            bump = last_seq + 1
+            while os.path.exists(path):
+                # Extremely rare: the predecessor rotated to this very
+                # start seq and crashed before appending. The filename
+                # seq only orders segments, and every record here will
+                # carry seq > last_seq, so bumping the name is safe.
+                bump += 1
+                path = _segment_path(state_dir, bump)
+        else:
+            # Continue the newest segment (its torn tail, if any, is
+            # truncated by JournalWriter).
+            path = segments[-1]
         self._writer: Optional[JournalWriter] = JournalWriter(path)
 
     @property
     def last_seq(self) -> int:
         return self._seq
+
+    @property
+    def epoch(self) -> Optional[int]:
+        return self._epoch
 
     def record(self, etype: str, data: dict, sync: bool = True) -> int:
         """Append one event; returns its sequence number. sync=False is
@@ -372,6 +653,8 @@ class DurabilityLayer:
             seq = self._seq + 1
             rec = {"seq": seq, "type": etype, "t": time.time(),
                    "data": data}
+            if self._epoch is not None:
+                rec["epoch"] = self._epoch
             t0 = self._obs.clock()
             if sync:
                 with self._obs.span(obs_names.SPAN_JOURNAL_FSYNC,
@@ -416,6 +699,8 @@ class DurabilityLayer:
             payload = dict(payload)
             payload["last_seq"] = self._seq
             payload.setdefault("time", time.time())
+            if self._epoch is not None:
+                payload["epoch"] = self._epoch
             with self._obs.span(obs_names.SPAN_SNAPSHOT, seq=self._seq), \
                     self._obs.timed(obs_names.SNAPSHOT_WRITE_SECONDS):
                 write_snapshot(self.state_dir, payload)

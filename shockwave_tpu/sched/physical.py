@@ -24,6 +24,7 @@ from __future__ import annotations
 import collections
 import logging
 import math
+import os
 import queue
 import threading
 import time
@@ -171,6 +172,24 @@ class PhysicalScheduler(Scheduler):
         # would launder a slow worker back to "expected").
         self._fleet_rate: Dict[Tuple[str, int, str], float] = {}
 
+        # Control-plane HA (config.ha; see sched/ha.py): claim a fenced
+        # leader epoch BEFORE recovery so every journal record this
+        # incarnation writes carries it, and so a deposed predecessor's
+        # post-fencing writes are already superseded when we replay.
+        self._ha = None
+        self._ha_fenced = False
+        if self._config.ha is not None:
+            if not self._config.state_dir:
+                raise ValueError("config error: ha requires state_dir "
+                                 "(the lease, epoch claims and shipped "
+                                 "journal all live there)")
+            from .ha import HAConfig, HAController
+            os.makedirs(self._config.state_dir, exist_ok=True)
+            self._ha = HAController(
+                self._config.state_dir,
+                HAConfig.from_dict(self._config.ha), port=port,
+                obs=self._obs, on_fenced=self._on_ha_fenced)
+
         # Durability: recover BEFORE the gRPC server starts (RPCs land
         # the moment the port is bound, and they must see the rebuilt
         # state), then attach the journal so every subsequent mutation
@@ -202,7 +221,12 @@ class PhysicalScheduler(Scheduler):
                 self._durability = DurabilityLayer(
                     self._config.state_dir,
                     self._config.snapshot_interval_rounds,
-                    obs=self._obs)
+                    obs=self._obs,
+                    epoch=(self._ha.epoch if self._ha is not None
+                           else None),
+                    # HA incarnations never append to a segment a
+                    # deposed zombie may still hold open.
+                    rotate_on_open=self._ha is not None)
                 self.attach_durability(self._durability)
                 if self._recovered:
                     self._requeue_inflight_after_recovery()
@@ -223,7 +247,12 @@ class PhysicalScheduler(Scheduler):
             "InitJob": self._init_job_callback,
             "UpdateLease": self._update_lease_callback,
             "UpdateResourceRequirement": self._update_resource_requirement_callback,
-        })
+        }, fenced_check=((lambda: self._ha_fenced)
+                         if self._ha is not None else None))
+        if self._ha is not None:
+            # First lease only once the port is bound: the lease IS the
+            # endpoint registry workers re-resolve through.
+            self._ha.start()
 
         if self._config.watchdog_interval:
             import faulthandler
@@ -295,6 +324,16 @@ class PhysicalScheduler(Scheduler):
             payload["journal"] = {
                 "last_seq": self._durability.last_seq,
                 "lag_events": self._durability.pending_events,
+            }
+        if self._ha is not None:
+            from .ha import read_lease
+            lease = read_lease(self._config.state_dir)
+            payload["ha"] = {
+                "role": "fenced" if self._ha_fenced else "leader",
+                "epoch": self._ha.epoch,
+                "lease_age_s": (
+                    round(time.time() - float(lease.get("stamp", 0.0)), 3)
+                    if lease else None),
             }
         return payload
 
@@ -439,12 +478,11 @@ class PhysicalScheduler(Scheduler):
         The daemon may be long dead — last_seen is stamped `now`, so the
         liveness monitor gives it one timeout window to answer a probe
         before its chips are retired (and a later heal revives them)."""
-        from ..runtime.clients import SchedulerToWorkerClient
         key = (addr, port)
         old = self._worker_hosts.get(key)
         if old is not None:
             self._close_host_client(old)
-        client = SchedulerToWorkerClient(addr, port)
+        client = self._new_worker_client(addr, port)
         now = self.get_current_timestamp()
         for worker_id in worker_ids:
             self._worker_connections[worker_id] = client
@@ -522,6 +560,69 @@ class PhysicalScheduler(Scheduler):
                                self.rounds.num_completed_rounds)
 
     # ------------------------------------------------------------------
+    # Control-plane HA (leader side)
+    # ------------------------------------------------------------------
+
+    @property
+    def ha_fenced(self) -> bool:
+        """Whether this incarnation was deposed by a promoted standby
+        (drivers exit with a distinct status so chaos harnesses can
+        tell a clean fence from a crash)."""
+        return self._ha_fenced
+
+    def _on_ha_fenced(self, successor_epoch: int) -> None:
+        """A higher epoch exists: this process is no longer the leader.
+        Runs on the HA renewal thread (or the dispatch path via
+        fence_now). Stop writing the journal (the successor owns it),
+        refuse further RPCs (serve_scheduler's fenced_check), and kick
+        every waiter so the round loop can observe the flag and exit.
+        Nothing is requeued here — the successor's recovery already
+        requeued everything conservatively on ITS side; this side's
+        only job is to stop acting."""
+        with self._cv:
+            self._ha_fenced = True
+            if self._durability is not None:
+                # Closing the writer makes any straggling append raise
+                # (swallowed + logged by _emit_event): the zombie's
+                # write window ends HERE, not at process exit.
+                self._durability.close()
+            self._cv.notify_all()
+        self.log.warning(
+            "scheduler FENCED: epoch %d superseded by %d; ceasing "
+            "dispatch and journal writes",
+            self._ha.epoch if self._ha else -1, successor_epoch)
+
+    def _worker_epoch_source(self):
+        """epoch_source for SchedulerToWorkerClient: the claimed epoch
+        under HA, None (no metadata at all) otherwise."""
+        if self._ha is None:
+            return None
+        return self._ha.epoch_value
+
+    def _new_worker_client(self, addr: str, port: int):
+        """Build a scheduler->worker client carrying this leader's
+        epoch metadata (single construction chokepoint: registration,
+        revival, and journal re-adoption must all fence identically)."""
+        from ..runtime.clients import SchedulerToWorkerClient
+        return SchedulerToWorkerClient(
+            addr, port, epoch_source=self._worker_epoch_source())
+
+    @staticmethod
+    def _is_stale_epoch_error(error) -> bool:
+        """A worker refused our leader epoch: we are fenced (a standby
+        promoted while we were wedged), regardless of what the renewal
+        thread has noticed yet."""
+        if not isinstance(error, grpc.RpcError):
+            return False
+        try:
+            code = error.code()
+            details = error.details() or ""
+        except Exception:  # noqa: BLE001 - non-standard RpcError stub
+            return False
+        return (code == grpc.StatusCode.FAILED_PRECONDITION
+                and "stale leader epoch" in details)
+
+    # ------------------------------------------------------------------
     # RPC callbacks
     # ------------------------------------------------------------------
 
@@ -531,7 +632,6 @@ class PhysicalScheduler(Scheduler):
         first response was lost) gets its ORIGINAL chip ids back, revived
         into capacity with a fresh channel, instead of ghost-duplicating
         the host's chips."""
-        from ..runtime.clients import SchedulerToWorkerClient
         with self._cv:
             key = (ip_addr, port)
             host = self._worker_hosts.get(key)
@@ -553,7 +653,7 @@ class PhysicalScheduler(Scheduler):
                 self._retire_worker_host(key)
                 self._close_host_client(host)
                 del self._worker_hosts[key]
-            client = SchedulerToWorkerClient(ip_addr, port)
+            client = self._new_worker_client(ip_addr, port)
             worker_ids, round_duration = self.register_worker(
                 worker_type, num_chips)
             now = self.get_current_timestamp()
@@ -588,9 +688,8 @@ class PhysicalScheduler(Scheduler):
             # daemon restarted (losing its dispatch state), so anything
             # in flight there is gone — fail it in-round first.
             self._retire_worker_host(key)
-        from ..runtime.clients import SchedulerToWorkerClient
         self._close_host_client(host)
-        client = SchedulerToWorkerClient(*key)
+        client = self._new_worker_client(*key)
         self._obs.inc(obs_names.WORKER_REVIVALS_TOTAL)
         # A rejoining daemon starts over on probation: suspect until it
         # posts recover_consecutive good observations.
@@ -1704,6 +1803,20 @@ class PhysicalScheduler(Scheduler):
                 self._worker_connections[worker_id].run_job(
                     descriptions, worker_id, round_id)
             except WORKER_RPC_ERRORS as e:
+                if self._is_stale_epoch_error(e):
+                    # The worker has seen a higher leader epoch: a
+                    # standby promoted over us. Do NOT retire the
+                    # (healthy) host or charge the job — stop being
+                    # the leader. The successor's conservative
+                    # recovery already owns every in-flight round.
+                    self._obs.inc(obs_names.DISPATCHES_TOTAL,
+                                  outcome="fenced")
+                    if self._ha is not None:
+                        self._ha.fence_now()
+                    else:  # fenced reply without an HA controller:
+                        # still stop dispatching (defensive)
+                        self._on_ha_fenced(-1)
+                    return
                 self._obs.inc(obs_names.DISPATCHES_TOTAL,
                               outcome=("unavailable"
                                        if isinstance(e, RpcUnavailableError)
@@ -1910,6 +2023,12 @@ class PhysicalScheduler(Scheduler):
         with self._obs.phase(obs_names.SPAN_WAIT, round=round_id):
             while not jobs_to_complete.issubset(
                     self.rounds.completed_in_round):
+                if self._ha_fenced:
+                    # Deposed mid-round: the outstanding completions
+                    # now belong to the successor (workers re-resolved
+                    # their report channel); waiting here would wedge
+                    # the fenced exit forever.
+                    return
                 # Bounded wait: completion normally arrives with a
                 # notify (done callback, watchdog, or dead-worker
                 # retirement), but round liveness must not hinge on
@@ -2104,11 +2223,16 @@ class PhysicalScheduler(Scheduler):
     # ------------------------------------------------------------------
 
     def run(self):
-        """Drive the round mechanism until max_rounds (or forever)."""
+        """Drive the round mechanism until max_rounds (or forever), or
+        until fenced by a promoted standby (ha_fenced tells the driver
+        which exit this was)."""
         with self._cv:
             while not (self.acct.jobs or self._serving_live()) or (
                     self._expected_num_workers is not None
                     and len(self.workers.worker_ids) < self._expected_num_workers):
+                if self._ha_fenced:
+                    self._done_event.set()
+                    return
                 self._cv.wait()
             if self._policy.name != "shockwave":
                 while self._need_to_update_allocation:
@@ -2131,12 +2255,16 @@ class PhysicalScheduler(Scheduler):
 
         while True:
             with self._cv:
+                if self._ha_fenced:
+                    break
                 final = self._is_final_round()
                 with self._obs.phase(obs_names.SPAN_BEGIN_ROUND,
                                      round=self.rounds.num_completed_rounds):
                     self._begin_round()
             time.sleep(self._time_per_iteration * SCHEDULE_RECOMPUTE_FRACTION)
             with self._cv:
+                if self._ha_fenced:
+                    break
                 self._mid_round()
                 if self._shockwave_planner is not None:
                     # Set of immutable JobIdPairs consumed for membership
@@ -2219,6 +2347,11 @@ class PhysicalScheduler(Scheduler):
 
     def shutdown(self):
         self._done_event.set()
+        if self._ha is not None:
+            # Stop renewing the lease FIRST: a clean shutdown should
+            # let a standby take over one TTL later, not keep looking
+            # alive from beyond the grave.
+            self._ha.stop()
         if self._config.obs_trace_path:
             try:
                 self._obs.tracer.export_chrome_trace(
@@ -2232,8 +2365,14 @@ class PhysicalScheduler(Scheduler):
         # may be rebuilding host channels concurrently), then shut the
         # clients down outside it — each shutdown is a bounded RPC, and
         # holding the lock across it would stall any in-flight handler.
+        # A FENCED ex-leader skips this entirely: the workers belong to
+        # the promoted successor now, and a zombie's parting Shutdown
+        # would take the live fleet down with it (the worker-side epoch
+        # fence also rejects it, but not every worker may have seen the
+        # new epoch yet).
         with self._lock:
-            clients = set(self._worker_connections.values())
+            clients = (set() if self._ha_fenced
+                       else set(self._worker_connections.values()))
         for client in clients:
             client.shutdown()
         self._server.stop(grace=1)

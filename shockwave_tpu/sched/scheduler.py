@@ -216,6 +216,17 @@ class SchedulerConfig:
     # replay path; a config with the default admission="always_admit"
     # keeps every admission decision identical too.
     whatif: Optional[dict] = None
+    # ---- control-plane HA (physical mode; see README "Control-plane
+    # HA" and configs/ha.json) ----
+    # sched/ha.HAConfig field overrides (lease_interval_s, lease_ttl_s,
+    # standby_poll_interval_s, failover_budget_s, advertise_addr).
+    # Enables the leader-side HA controller: a fenced epoch is claimed
+    # in state_dir, every journal record and scheduler->worker RPC
+    # carries it, a liveness lease is renewed for hot standbys to
+    # watch, and the process self-fences when a standby promotes over
+    # it. Requires state_dir. None (the default) constructs nothing —
+    # canonical replays and non-HA physical runs are bit-identical.
+    ha: Optional[dict] = None
 
 
 class Scheduler:
@@ -1178,9 +1189,14 @@ class Scheduler:
                            "%.4f steps/s from expected duration", key,
                            worker_type, nominal)
             self._throughputs[job_id][worker_type] = nominal
-        elif self._simulate and self._oracle_throughputs is not None:
+        elif (self._simulate and not self._replaying
+                and self._oracle_throughputs is not None):
             # Simulation has no measured path to recover from a missing
             # oracle entry; fail loudly rather than fabricate throughput.
+            # EXCEPT during journal replay: a sim-mode twin rebuilding a
+            # PHYSICAL run's history (hot standby, whatif load_twin)
+            # must tolerate whatever the physical side learned online —
+            # the default-and-learn path below mirrors it.
             raise KeyError(
                 f"no oracle throughput for {key} on {worker_type!r}")
         else:

@@ -80,7 +80,7 @@ def twin_config(config):
     bound cleared for the rollforward to set."""
     return replace(config, whatif=None, state_dir=None, resume=False,
                    obs_port=None, obs_trace_path=None, max_rounds=None,
-                   snapshot_interval_rounds=0)
+                   snapshot_interval_rounds=0, ha=None)
 
 
 def thaw(sched, blob: bytes, seed: Optional[int] = None):

@@ -29,6 +29,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from shockwave_tpu.runtime import faults  # noqa: E402
 from shockwave_tpu.runtime.clients import (IteratorToSchedulerClient,  # noqa: E402
                                            WorkerToSchedulerClient)
+from shockwave_tpu.runtime.resilience import EpochFence  # noqa: E402
 from shockwave_tpu.runtime.servers import serve_worker  # noqa: E402
 
 
@@ -71,10 +72,16 @@ def main():
                                [args.exec_time] * len(jobs))
         threading.Thread(target=execute, daemon=True).start()
 
+    # Same epoch fence as the real daemon (runtime/worker.py): a
+    # deposed leader's dispatches — and its parting Shutdown — are
+    # rejected, and an advanced epoch re-points the report channel at
+    # the promoted leader (HA failover drills lean on both).
+    fence = EpochFence()
     server = serve_worker(args.worker_port, {
         "RunJob": run_job, "KillJob": lambda j: None,
         "Reset": lambda: None, "Shutdown": shutdown.set,
-    })
+    }, fence=fence,
+        on_epoch_advance=lambda epoch: client.refresh_endpoint())
     worker_ids, round_duration = client.register_worker(
         "v5e", "127.0.0.1", args.worker_port, args.num_chips)
     box["round_duration"] = round_duration

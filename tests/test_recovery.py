@@ -441,6 +441,117 @@ class TestSchedulerCrashRestart:
 
 
 @pytest.mark.recovery
+@pytest.mark.timeout(120)
+class TestRepeatedIncarnationRecovery:
+    """Satellite (control-plane HA): crash -> promote -> crash the new
+    leader -> promote again, with the serving tier AND what-if plane
+    active. Serving services, tuned knobs, and quarantine records must
+    survive BOTH hops — recovery is idempotent across incarnations, not
+    a one-shot. (In-process: every journal append is fsync'd at emit
+    time, so the durable state at shutdown() is byte-identical to a
+    SIGKILL's; the subprocess SIGKILL variant is the chaos campaign's
+    HA mode and tests/test_ha.py's loopback failover.)"""
+
+    def _incarnation(self, state_dir, resume, epoch):
+        return PhysicalScheduler(
+            get_policy("max_min_fairness"), throughputs_file=THROUGHPUTS,
+            config=SchedulerConfig(
+                time_per_iteration=2.0, heartbeat_interval_s=0.0,
+                state_dir=str(state_dir), resume=resume,
+                snapshot_interval_rounds=2,
+                ha={"lease_interval_s": 0.2, "lease_ttl_s": 60.0,
+                    "claimed_epoch": epoch},
+                whatif={"admission": "always_admit"}),
+            port=free_port())
+
+    def _serving_job(self):
+        from shockwave_tpu.core.trace import serving_command
+        return Job(None, "Serving (batch size 1)",
+                   serving_command(base_rps=4.0, peak_rps=8.0,
+                                   period_s=600.0, tokens_per_request=64,
+                                   decode_tokens_per_s=1600.0,
+                                   max_replicas=4),
+                   "serving", "--num_steps", total_steps=0,
+                   duration=14400, mode="serving", SLO=0.5)
+
+    def test_state_survives_two_failover_hops(self, tmp_path):
+        from shockwave_tpu.sched.ha import try_claim_epoch
+
+        d = tmp_path / "state"
+        os.makedirs(d)
+        assert try_claim_epoch(str(d), 1, role="leader")
+        a = self._incarnation(d, resume=False, epoch=1)
+        try:
+            ids_a, _ = a._register_worker_rpc("v5e", 1, "127.0.0.1",
+                                              free_port())
+            ids_b, _ = a._register_worker_rpc("v5e", 1, "127.0.0.1",
+                                              free_port())
+            a.add_job(_job(300))
+            service_id = a.add_job(self._serving_job())
+            assert a._serving_tier is not None
+            assert not a._serving_tier.services[
+                service_id.integer_job_id()].retired
+            # A what-if-committed knob (journaled durable config).
+            a._emit_whatif_knob("quarantine_backoff_s", 45.0,
+                                round=0, sweep=[])
+            from shockwave_tpu.whatif.knobs import get_knob
+            get_knob("quarantine_backoff_s").set(a, 45.0)
+            # And a quarantined straggler.
+            key_b = next(k for k, h in a._worker_hosts.items()
+                         if set(h["worker_ids"]) == set(ids_b))
+            with a._cv:
+                a._quarantine_worker_host(key_b)
+            assert set(a.workers.quarantined) == set(ids_b)
+        finally:
+            a.shutdown()
+
+        # Hop 1: standby claims epoch 2 and recovers.
+        assert try_claim_epoch(str(d), 2, role="standby")
+        b = self._incarnation(d, resume=True, epoch=2)
+        try:
+            assert b._ha.epoch == 2 and b._durability.epoch == 2
+            svc = b._serving_tier.services[service_id.integer_job_id()]
+            assert not svc.retired
+            assert b._health_cfg.quarantine_backoff_s == 45.0
+            assert b._whatif_knob_values[
+                "quarantine_backoff_s"] == 45.0
+            assert set(b.workers.quarantined) == set(ids_b)
+            assert b.workers.cluster_spec == {"v5e": 1}
+            # Mutate state between the hops: release the quarantine so
+            # hop 2 must ALSO replay incremental epoch-2 events, not
+            # just re-read epoch-1 state.
+            with b._cv:
+                b._worker_hosts[key_b]["quarantined_at"] -= 10_000.0
+                b._maybe_release_quarantine(key_b)
+            assert not b.workers.quarantined
+        finally:
+            b.shutdown()
+
+        # Hop 2: a third incarnation claims epoch 3 and recovers the
+        # blended epoch-1 + epoch-2 history.
+        assert try_claim_epoch(str(d), 3, role="standby")
+        c = self._incarnation(d, resume=True, epoch=3)
+        try:
+            assert c._ha.epoch == 3
+            svc = c._serving_tier.services[service_id.integer_job_id()]
+            assert not svc.retired
+            assert c._health_cfg.quarantine_backoff_s == 45.0
+            assert not c.workers.quarantined       # release survived
+            assert c.workers.cluster_spec == {"v5e": 2}
+            assert JobIdPair(0) in c.acct.jobs     # training job alive
+            # Journal chain is exactly-one-writer-per-epoch clean.
+            rec = journal.load_state(str(d))
+            assert rec.stale_orphans == []
+            epochs = [e.get("epoch") for e in rec.events]
+            assert all(e in (1, 2, 3) for e in epochs)
+            non_decreasing = all(x <= y for x, y in
+                                 zip(epochs, epochs[1:]))
+            assert non_decreasing
+        finally:
+            c.shutdown()
+
+
+@pytest.mark.recovery
 class TestZeroCapacityAllocation:
     """A recovered scheduler can find its only worker endpoint dead and
     retire it, leaving zero capacity. The allocation solve must return
