@@ -145,6 +145,31 @@ def whatif_phase():
             "whatif_mean_capture_s": row["mean_capture_s"]}
 
 
+def tracing_phase():
+    """Fleet-tracing overhead: spans/s + estimated per-round cost of
+    context propagation and shard flushing (scripts/microbenchmarks/
+    bench_tracing.py) — keeps the distributed-tracing tax visible
+    beside the what-if and sweep rows."""
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts/microbenchmarks/bench_tracing.py"),
+             "--spans", "100000", "--propagations", "50000",
+             "--flushes", "10"],
+            capture_output=True, text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        return {"tracing_error": "bench_tracing timeout"}
+    if out.returncode != 0:
+        return {"tracing_error": out.stderr[-300:]}
+    try:
+        row = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001
+        return {"tracing_error": out.stdout[-300:]}
+    return {"tracing_spans_per_s": row["spans_per_s"],
+            "tracing_round_overhead_est_s": row["round_overhead_est_s"],
+            "tracing_shard_flush_mean_s": row["shard_flush_mean_s"]}
+
+
 def main():
     sim_start = time.monotonic()
     out = subprocess.run(
@@ -182,6 +207,7 @@ def main():
     }
     line.update(sweep_phase())
     line.update(whatif_phase())
+    line.update(tracing_phase())
     line.update(tpu_phase())
     print(json.dumps(line))
 

@@ -155,6 +155,24 @@ def main():
                         "Chrome-trace JSON at shutdown (view in "
                         "Perfetto, or summarize with python -m "
                         "shockwave_tpu.obs.report)")
+    p.add_argument("--trace_dir", default=None, metavar="DIR",
+                   help="fleet-trace directory: propagate span context "
+                        "on every dispatch, write the scheduler's span "
+                        "shard here at shutdown, and merge every shard "
+                        "present (point worker daemons at the same "
+                        "directory via --trace_dir / "
+                        "$SWTPU_SPAN_SHARD_DIR) into one Perfetto "
+                        "trace; explain a job with python -m "
+                        "shockwave_tpu.obs.explain")
+    p.add_argument("--history", default=None, metavar="JSON",
+                   help="JSON file (or inline JSON object) of "
+                        "obs/history.TelemetryHistory overrides "
+                        "(max_rounds, flush_interval_rounds, path). "
+                        "Default: enabled with defaults when "
+                        "--state_dir is set")
+    p.add_argument("--no_history", action="store_true",
+                   help="disable the telemetry-history ring (and its "
+                        "/history.json + swtpu_alert checks)")
     p.add_argument("--log_level", default=None, choices=LEVELS,
                    help="root log level (default: warning, or info "
                         "with --verbose)")
@@ -221,6 +239,17 @@ def main():
         p.error("--ha_standby requires --ha (the standby needs the "
                 "lease/epoch knobs to watch the leader)")
 
+    history_config = None
+    if not args.no_history:
+        if args.history:
+            if args.history.strip().startswith("{"):
+                history_config = json.loads(args.history)
+            else:
+                with open(args.history) as f:
+                    history_config = json.load(f)
+        elif args.state_dir:
+            history_config = {}
+
     policy = get_policy(args.policy, seed=args.seed)
     config = SchedulerConfig(
         time_per_iteration=args.round_duration, seed=args.seed,
@@ -238,6 +267,7 @@ def main():
         snapshot_interval_rounds=args.snapshot_interval,
         pipelined_planning=not args.no_pipelined_solve,
         obs_port=args.obs_port, obs_trace_path=args.obs_trace,
+        obs_trace_dir=args.trace_dir, history=history_config,
         serving=serving_config, whatif=whatif_config, ha=ha_config)
 
     if args.ha_standby:

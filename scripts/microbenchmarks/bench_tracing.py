@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""Fleet-tracing overhead microbenchmark: spans/s throughput and the
+per-round cost of context propagation + shard flushing.
+
+Measures the three costs the tracing work charges the hot paths:
+
+- **span** — one context-carrying `Tracer.span` enter/exit (id
+  allocation, parent-stack push/pop, ring append): what every
+  phase/dispatch span costs the round pipeline;
+- **propagate** — `propagation.rpc_metadata` + `from_rpc_metadata`
+  round trip (what each RunJob RPC pays on top of the span);
+- **shard flush** — one atomic rewrite of a realistically-sized shard
+  file (what a worker daemon pays per dispatch).
+
+Prints ONE JSON line; bench.py embeds it as the `tracing_phase` row.
+``--smoke`` exits nonzero when spans/s falls under --min_spans_per_s
+or the estimated per-round overhead exceeds --max_round_overhead_s —
+the CI floor gate.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.obs import names as obs_names  # noqa: E402
+from shockwave_tpu.obs import propagation  # noqa: E402
+from shockwave_tpu.obs.shard import ShardSpanWriter  # noqa: E402
+from shockwave_tpu.obs.tracing import Tracer  # noqa: E402
+
+#: Spans one 32-chip round emits with propagation on: ~6 phase/root
+#: spans + one runjob-rpc per chip, + worker-side runjob/launch/
+#: done-report and a trainer span per dispatch.
+SPANS_PER_ROUND_ESTIMATE = 6 + 32 * 4
+
+
+def bench_spans(n):
+    tracer = Tracer(clock=time.perf_counter)
+    t0 = time.perf_counter()
+    for i in range(n):
+        with tracer.span(obs_names.SPAN_TRACING_BENCH, i=i):
+            pass
+    wall = time.perf_counter() - t0
+    return wall / n, len(tracer.events())
+
+
+def bench_propagation(n):
+    ctx = propagation.new_root_context()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        metadata = propagation.rpc_metadata(ctx, send_ts=1234.5)
+        out, ts = propagation.from_rpc_metadata(metadata)
+    wall = time.perf_counter() - t0
+    assert out == ctx and ts == 1234.5
+    return wall / n
+
+
+def bench_flush(spans_in_shard, flushes):
+    with tempfile.TemporaryDirectory() as td:
+        shard = ShardSpanWriter(td, role="bench",
+                                clock=time.perf_counter)
+        for i in range(spans_in_shard):
+            with shard.span(obs_names.SPAN_TRACING_BENCH, i=i):
+                pass
+        t0 = time.perf_counter()
+        for _ in range(flushes):
+            shard.flush()
+        return (time.perf_counter() - t0) / flushes
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--spans", type=int, default=200_000)
+    p.add_argument("--propagations", type=int, default=100_000)
+    p.add_argument("--shard_spans", type=int, default=2_000,
+                   help="shard size for the flush benchmark (a worker "
+                        "daemon's steady-state ring)")
+    p.add_argument("--flushes", type=int, default=20)
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--min_spans_per_s", type=float, default=20_000.0,
+                   help="--smoke: fail below this span throughput")
+    p.add_argument("--max_round_overhead_s", type=float, default=0.05,
+                   help="--smoke: fail when the estimated scheduler-"
+                        "side per-round tracing cost exceeds this "
+                        "(spans + propagation for a 32-chip round)")
+    p.add_argument("--output", default=None, help="also write the JSON")
+    args = p.parse_args()
+
+    span_s, recorded = bench_spans(args.spans)
+    prop_s = bench_propagation(args.propagations)
+    flush_s = bench_flush(args.shard_spans, args.flushes)
+    # Scheduler-side per-round estimate: every span in the round plus
+    # one metadata round trip per dispatched chip (flushes happen on
+    # the worker, off the scheduler's critical path).
+    round_overhead_s = (SPANS_PER_ROUND_ESTIMATE * span_s
+                        + 32 * prop_s)
+    row = {
+        "spans_per_s": round(1.0 / span_s, 1),
+        "span_mean_us": round(span_s * 1e6, 3),
+        "propagate_mean_us": round(prop_s * 1e6, 3),
+        "shard_flush_mean_s": round(flush_s, 6),
+        "shard_flush_spans": args.shard_spans,
+        "round_overhead_est_s": round(round_overhead_s, 6),
+        "spans_recorded": recorded,
+    }
+    print(json.dumps(row))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(row, f)
+    if args.smoke:
+        if row["spans_per_s"] < args.min_spans_per_s:
+            print(f"SMOKE FAIL: {row['spans_per_s']} spans/s < "
+                  f"{args.min_spans_per_s}", file=sys.stderr)
+            return 1
+        if round_overhead_s > args.max_round_overhead_s:
+            print(f"SMOKE FAIL: estimated per-round overhead "
+                  f"{round_overhead_s:.4f}s > "
+                  f"{args.max_round_overhead_s}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,211 @@
+#!/usr/bin/env python3
+"""Fleet-trace smoke drive: one deterministic loopback run with tracing
+on, end to end — propagate span context scheduler -> worker, write span
+shards, merge them, validate the merged trace's cross-process parent
+links, and `explain` every job from the journal.
+
+    python scripts/tests/trace_smoke.py --workdir W --explain_out E.txt
+
+The worker is a deterministic stub (fixed simulated throughput and
+execution time, like tests/fault_stub_worker.py) so the drive's journal
+— and therefore the round-quantized `obs.explain` output — is a pure
+function of the configuration: the CI trace-smoke job runs this twice
+and byte-compares the explain outputs. Exit nonzero on any validation
+failure (missing shards, disconnected chain, explain coverage < 99%).
+"""
+import argparse
+import json
+import os
+import re
+import socket
+import sys
+import threading
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", ".."))
+sys.path.insert(0, REPO)
+
+from shockwave_tpu.core.job import Job  # noqa: E402
+from shockwave_tpu.obs import names as obs_names  # noqa: E402
+from shockwave_tpu.obs import explain as explain_mod  # noqa: E402
+from shockwave_tpu.obs.merge import parent_chain, spans_by_id  # noqa: E402
+from shockwave_tpu.obs.shard import ShardSpanWriter  # noqa: E402
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class ShardStubWorker:
+    """In-process stub daemon with fleet-trace support: consumes the
+    propagated RunJob span context, records runjob/done-report spans
+    into a worker shard, and reports deterministic progress (fixed
+    simulated throughput / execution time)."""
+
+    def __init__(self, sched_port, worker_port, trace_dir, num_chips=1,
+                 throughput=100.0, execution_time=0.4):
+        from shockwave_tpu.runtime.clients import (
+            IteratorToSchedulerClient, WorkerToSchedulerClient)
+        from shockwave_tpu.runtime.servers import serve_worker
+        self.throughput = throughput
+        self.execution_time = execution_time
+        self.sched_port = sched_port
+        self.shard = ShardSpanWriter(trace_dir, role="worker")
+        self._iter_client = IteratorToSchedulerClient
+        self._client = WorkerToSchedulerClient("localhost", sched_port)
+        self.server = serve_worker(worker_port, {
+            "RunJob": self._run_job, "KillJob": lambda j: None,
+            "Reset": lambda: None, "Shutdown": lambda: None,
+        })
+        self.worker_ids, self.round_duration = self._client.register_worker(
+            "v5e", "127.0.0.1", worker_port, num_chips)
+
+    def _run_job(self, jobs, worker_id, round_id, trace=None):
+        parent, send_ts = trace if trace is not None else (None, None)
+        with self.shard.span(
+                obs_names.SPAN_RUNJOB, parent=parent, round=round_id,
+                worker=worker_id, jobs=[j["job_id"] for j in jobs],
+                **({"send_ts": send_ts} if send_ts is not None
+                   else {})) as ctx:
+            thread = threading.Thread(
+                target=self._execute, args=(jobs, worker_id, ctx),
+                daemon=True)
+            thread.start()
+
+    def _execute(self, jobs, worker_id, parent):
+        max_steps = 10**9
+        for j in jobs:
+            it = self._iter_client(j["job_id"], worker_id, "localhost",
+                                   self.sched_port)
+            max_steps, _, _ = it.init()
+        time.sleep(self.execution_time)
+        steps = [min(int(self.throughput * self.round_duration),
+                     j["num_steps"], int(max_steps)) for j in jobs]
+        with self.shard.span(obs_names.SPAN_DONE_REPORT, parent=parent,
+                             jobs=[j["job_id"] for j in jobs]):
+            self._client.notify_done(
+                [j["job_id"] for j in jobs], worker_id, steps,
+                [self.execution_time] * len(jobs))
+        self.shard.flush()
+
+    def stop(self):
+        self.shard.flush()
+        self.server.stop(grace=0)
+
+
+def run_drive(workdir, num_jobs, round_duration, max_rounds):
+    from shockwave_tpu.sched.physical import PhysicalScheduler
+    from shockwave_tpu.sched.scheduler import SchedulerConfig
+    from shockwave_tpu.solver import get_policy
+    trace_dir = os.path.join(workdir, "trace")
+    state_dir = os.path.join(workdir, "state")
+    sched_port, worker_port = free_port(), free_port()
+    sched = PhysicalScheduler(
+        get_policy("max_min_fairness"),
+        throughputs_file=os.path.join(REPO,
+                                      "data/tacc_throughputs.json"),
+        config=SchedulerConfig(
+            time_per_iteration=round_duration, max_rounds=max_rounds,
+            state_dir=state_dir, snapshot_interval_rounds=10_000,
+            obs_trace_dir=trace_dir, history={}),
+        expected_num_workers=1, port=sched_port)
+    worker = ShardStubWorker(sched_port, worker_port, trace_dir)
+    job_ids = []
+    try:
+        for i in range(num_jobs):
+            job_ids.append(sched.add_job(Job(
+                None, "ResNet-18 (batch size 32)",
+                "python3 main.py --batch_size 32",
+                "image_classification/cifar10", "--num_steps",
+                total_steps=200 * (i + 2), duration=100000)))
+        runner = threading.Thread(target=sched.run, daemon=True)
+        runner.start()
+        deadline = time.time() + 30 * round_duration
+        while (time.time() < deadline
+               and len(sched._completed_jobs) < num_jobs):
+            time.sleep(0.2)
+        if len(sched._completed_jobs) < num_jobs:
+            raise SystemExit(
+                f"drive incomplete: {len(sched._completed_jobs)}/"
+                f"{num_jobs} jobs finished")
+    finally:
+        sched._done_event.set()
+        worker.stop()
+        sched.shutdown()
+        sched._server.stop(grace=0)
+    return trace_dir, state_dir, [j.integer_job_id() for j in job_ids]
+
+
+def validate_trace(trace_dir):
+    """The merged trace must exist, parse, and carry at least one
+    worker-side runjob span whose parent chain reaches the scheduler's
+    round root across the process boundary."""
+    merged_path = os.path.join(trace_dir, obs_names.MERGED_TRACE_NAME)
+    with open(merged_path) as f:
+        merged = json.load(f)
+    events = merged["traceEvents"]
+    index = spans_by_id(events)
+    runjobs = [e for e in events
+               if e.get("name") == obs_names.SPAN_RUNJOB
+               and (e.get("args") or {}).get("role") == "worker"]
+    if not runjobs:
+        raise SystemExit("merged trace has no worker runjob spans")
+    connected = 0
+    for e in runjobs:
+        chain = parent_chain(index, e)
+        roles = [(c.get("args") or {}).get("role") for c in chain]
+        names_ = [c.get("name") for c in chain]
+        if ("scheduler" in roles
+                and obs_names.SPAN_ROUND in names_):
+            connected += 1
+    if connected == 0:
+        raise SystemExit("no worker runjob span chains to a scheduler "
+                         "round root — propagation is broken")
+    return {"merged": merged_path, "spans": len(events),
+            "runjob_spans": len(runjobs), "connected": connected}
+
+
+def explain_jobs(state_dir, job_ids):
+    """Stable explain output for every job, concatenated; asserts the
+    >=99% coverage acceptance line per job."""
+    events = explain_mod.read_all_events(state_dir)
+    chunks = []
+    for int_id in job_ids:
+        tl = explain_mod.build_timeline(events, int_id)
+        text = explain_mod.render(tl)
+        m = re.search(r"total\s+\d+\s+([0-9.]+)%", text)
+        if m is None or float(m.group(1)) < 99.0:
+            raise SystemExit(
+                f"explain coverage below 99% for job {int_id}:\n{text}")
+        chunks.append(text)
+    return "\n\n".join(chunks) + "\n"
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--explain_out", required=True,
+                   help="file the byte-stable explain output is "
+                        "written to (CI cmp's two runs)")
+    p.add_argument("--num_jobs", type=int, default=2)
+    p.add_argument("--round_duration", type=float, default=2.0)
+    p.add_argument("--max_rounds", type=int, default=12)
+    args = p.parse_args()
+
+    os.makedirs(args.workdir, exist_ok=True)
+    trace_dir, state_dir, job_ids = run_drive(
+        args.workdir, args.num_jobs, args.round_duration,
+        args.max_rounds)
+    summary = validate_trace(trace_dir)
+    explain_text = explain_jobs(state_dir, job_ids)
+    with open(args.explain_out, "w") as f:
+        f.write(explain_text)
+    print(json.dumps({**summary, "jobs": job_ids,
+                      "explain_out": args.explain_out}))
+
+
+if __name__ == "__main__":
+    main()

@@ -480,33 +480,88 @@ OBS_NAMES_GLOBS = ("shockwave_tpu/obs/names.py",)
 #: The observability package itself, which must take its clock by
 #: injection...
 OBS_MODULE_GLOBS = ("shockwave_tpu/obs/*.py",)
+#: ...plus every span-emitting runtime module: span timestamps must be
+#: stamped through the injected obs clock (obs/shard.py), so a raw wall
+#: clock here would fork the fleet-trace timebase.
+OBS_CLOCK_EXTRA_GLOBS = ("shockwave_tpu/runtime/spans.py",)
 #: ...except the one designated clock adapter.
 OBS_CLOCK_ALLOW_GLOBS = ("shockwave_tpu/obs/clock.py",)
 #: Instrument entry points whose first argument is a metric/span name.
 OBS_INSTRUMENT_METHODS = frozenset({
     "inc", "observe", "set_gauge", "timed", "span", "phase",
 })
+#: names.py module-level constants whose VALUES are reserved literals:
+#: span-context propagation keys (gRPC metadata, env vars) and shard
+#: filename parts. Their string values may appear ONLY in names.py —
+#: a literal copy anywhere else is a cross-process contract fork.
+OBS_RESERVED_CONST_RE = r"^(TRACEPARENT|TRACE_SENDTS|SHARD_DIR|SHARD_FILE|MERGED_TRACE|HISTORY_FILE)"
+
+
+def _reserved_literals(index: RepoIndex,
+                       names_globs: Iterable[str]) -> Dict[str, str]:
+    """value -> declaring constant name, harvested from names.py
+    module-level assignments matching OBS_RESERVED_CONST_RE."""
+    import re as _re
+    pattern = _re.compile(OBS_RESERVED_CONST_RE)
+    reserved: Dict[str, str] = {}
+    for src in index.files:
+        if not src.matches(names_globs):
+            continue
+        for node in src.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and pattern.match(node.targets[0].id)):
+                value = const_str(node.value)
+                # Too-generic fragments (e.g. a bare ".json" suffix)
+                # would flag every unrelated artifact path; only values
+                # long enough to be unmistakably the contract are
+                # reserved.
+                if value is not None and len(value) >= 6:
+                    reserved[value] = node.targets[0].id
+    return reserved
 
 
 def check_obs_discipline(index: RepoIndex,
                          names_globs: Iterable[str] = OBS_NAMES_GLOBS,
                          obs_globs: Iterable[str] = OBS_MODULE_GLOBS,
                          clock_allow_globs: Iterable[str]
-                         = OBS_CLOCK_ALLOW_GLOBS) -> List[Finding]:
-    """Two halves of the instrumentation discipline: (1) every
+                         = OBS_CLOCK_ALLOW_GLOBS,
+                         clock_extra_globs: Iterable[str]
+                         = OBS_CLOCK_EXTRA_GLOBS) -> List[Finding]:
+    """Three parts of the instrumentation discipline: (1) every
     metric/span name at an instrument call site (``.inc(...)``,
     ``.observe(...)``, ``.span(...)``, ...) must be an attribute
     reference into ``obs/names.py``, never an inline string literal —
     ad-hoc names fork the catalog and rot silently out of the docs and
-    dashboards; (2) ``obs/`` itself reads no wall clock outside the
-    designated adapter ``obs/clock.py`` — the injected clock is what
-    lets the same instrumentation run under the simulator's virtual
-    clock without breaking bit-identical replay."""
+    dashboards; (2) span-context keys and shard filename parts (the
+    cross-process propagation contract) are declared ONLY in names.py —
+    any other file repeating one of those string values verbatim forks
+    the contract between the scheduler, worker daemon, dispatcher and
+    trainer; (3) neither ``obs/`` nor any span-emitting runtime module
+    (``runtime/spans.py``) reads a wall clock outside the designated
+    adapter ``obs/clock.py`` — the injected clock is what lets the same
+    instrumentation run under the simulator's virtual clock without
+    breaking bit-identical replay, and what keeps shard timestamps on
+    one timebase for the merge."""
     pass_id = "obs-discipline"
     findings: List[Finding] = []
+    reserved = _reserved_literals(index, names_globs)
+    clock_scope = tuple(obs_globs) + tuple(clock_extra_globs)
     for src in index.files:
         if not src.matches(names_globs):
             for node in ast.walk(src.tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value in reserved):
+                    f = finding(
+                        src, node, pass_id,
+                        f"reserved span-context/shard literal "
+                        f"{node.value!r} outside obs/names.py: "
+                        f"reference names.{reserved[node.value]} "
+                        "instead (the propagation contract is declared "
+                        "in one place)")
+                    if f is not None:
+                        findings.append(f)
                 if not isinstance(node, ast.Call) or not node.args:
                     continue
                 name = call_name(node)
@@ -525,7 +580,7 @@ def check_obs_discipline(index: RepoIndex,
                             "attribute")
                 if f is not None:
                     findings.append(f)
-        if src.matches(obs_globs) and not src.matches(clock_allow_globs):
+        if src.matches(clock_scope) and not src.matches(clock_allow_globs):
             aliases = _alias_map(src.tree)
             for node in ast.walk(src.tree):
                 if not isinstance(node, ast.Call):
@@ -533,11 +588,12 @@ def check_obs_discipline(index: RepoIndex,
                 cname = _canonical(call_name(node), aliases)
                 if cname in _CLOCK_CALLS:
                     f = finding(src, node, pass_id,
-                                f"wall-clock call {cname}() inside obs/ "
-                                "outside the clock adapter: obs "
-                                "components take their clock by "
-                                "injection (obs/clock.py is the only "
-                                "sanctioned reader)")
+                                f"wall-clock call {cname}() in a "
+                                "clock-disciplined obs/span module "
+                                "outside the clock adapter: obs and "
+                                "span-emitting runtime components take "
+                                "their clock by injection (obs/clock.py "
+                                "is the only sanctioned reader)")
                     if f is not None:
                         findings.append(f)
     return findings

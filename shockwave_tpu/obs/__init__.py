@@ -87,17 +87,19 @@ class Observability:
         _ALL_OBS.add(self)
 
     @contextmanager
-    def phase(self, name: str, **args):
+    def phase(self, name: str, parent=None, **args):
         """A round-pipeline phase: one trace span plus one observation
         into the shared phase histogram, so the trace timeline and the
-        /metrics scrape tell the same story."""
+        /metrics scrape tell the same story. `parent` splices the span
+        under a remote/manual SpanContext (the physical scheduler's
+        per-round root), wiring the phase into the fleet trace."""
         if not self.enabled:
-            yield
+            yield None
             return
         t0 = self.clock()
-        with self.tracer.span(name, **args):
+        with self.tracer.span(name, parent=parent, **args) as ctx:
             try:
-                yield
+                yield ctx
             finally:
                 self.registry.observe(names.ROUND_PHASE_SECONDS,
                                       max(self.clock() - t0, 0.0),

@@ -1,0 +1,335 @@
+"""Job-timeline explainer: where did this job's time go?
+
+    python -m shockwave_tpu.obs.explain <job_id> --state_dir <dir> \
+        [--trace merged_trace.json] [--wall]
+
+Fuses the scheduler's journal (the authoritative, crash-durable record
+of admission, round schedules, micro-task completions, failures,
+quarantines) with the merged fleet trace (optional: sub-round span
+detail) into one per-job lifecycle timeline, attributing every round of
+the job's JCT to a named phase:
+
+- ``run``              scheduled and progressing (extended leases too)
+- ``restart``          scheduled but the micro-task failed (worker
+                       death, kill, rejected dispatch) — the round was
+                       consumed by restart overhead
+- ``quarantine_migration``  a failed round whose workers were
+                       quarantined mid-round (gray-failure migration)
+- ``preempted_wait``   queued immediately after losing its chips
+- ``queue_wait``       queued (admission wait and ordinary rounds off
+                       the schedule)
+
+The DEFAULT output is **round-quantized and byte-stable**: two
+identical drives produce identical bytes (CI diffs them), because every
+number derives from journal event ORDER and recorded round indices,
+never from wall clocks. ``--wall`` adds wall-second attribution (from
+journal record stamps) and, with ``--trace``, per-process span detail —
+informative, not reproducible.
+
+Phase rounds always sum to the journal-derived JCT (coverage 100%); the
+acceptance gate asserts >= 99%.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+
+# -- journal loading ----------------------------------------------------
+
+def read_all_events(state_dir: str) -> List[dict]:
+    """Every surviving journal record in `state_dir`, seq-ordered and
+    epoch-fenced (compacted-away history is simply absent)."""
+    from ..sched.journal import (filter_epoch_chain, list_segments,
+                                 read_journal)
+    events: List[dict] = []
+    for path in list_segments(state_dir):
+        records, _ = read_journal(path)
+        events.extend(records)
+    events.sort(key=lambda r: int(r.get("seq", 0)))
+    kept, _ = filter_epoch_chain(events)
+    return kept
+
+
+def _members(key) -> List[int]:
+    """Decode a journaled job key ([lo, hi] pair or bare int)."""
+    if isinstance(key, (list, tuple)):
+        return [int(k) for k in key]
+    return [int(key)]
+
+
+# -- timeline model -----------------------------------------------------
+
+PHASE_RUN = "run"
+PHASE_RESTART = "restart"
+PHASE_QUARANTINE = "quarantine_migration"
+PHASE_PREEMPTED = "preempted_wait"
+PHASE_QUEUE = "queue_wait"
+PHASE_ORDER = (PHASE_RUN, PHASE_RESTART, PHASE_QUARANTINE,
+               PHASE_PREEMPTED, PHASE_QUEUE)
+
+
+class JobTimeline:
+    """Everything explain derives for one job from the journal."""
+
+    def __init__(self, int_id: int):
+        self.int_id = int_id
+        self.admitted: Optional[dict] = None      # job_added data
+        self.admitted_seq_t: Optional[float] = None
+        self.admission_round: Optional[int] = None
+        self.removed_round: Optional[int] = None
+        self.removed_t: Optional[float] = None
+        self.deferred = False
+        self.scheduled: "OrderedDict[int, list]" = OrderedDict()
+        # round -> {"failed": bool, "steps": int, "quarantined": bool}
+        self.microtasks: Dict[int, dict] = {}
+        self.failure_comps = 0
+        self.round_wall: Dict[int, float] = {}     # round -> end stamp
+
+    # -- derivation -----------------------------------------------------
+
+    @property
+    def completion_round(self) -> Optional[int]:
+        if self.removed_round is None:
+            return None
+        last_sched = max(self.scheduled, default=self.removed_round)
+        return max(self.removed_round, last_sched)
+
+    def phases(self) -> "OrderedDict[int, str]":
+        """round index -> phase name over [admission, completion]."""
+        out: "OrderedDict[int, str]" = OrderedDict()
+        if self.admission_round is None or self.completion_round is None:
+            return out
+        prev_scheduled = False
+        for rnd in range(self.admission_round,
+                         self.completion_round + 1):
+            if rnd in self.scheduled:
+                micro = self.microtasks.get(rnd)
+                if micro is None or not micro["failed"]:
+                    phase = PHASE_RUN
+                elif micro.get("quarantined"):
+                    phase = PHASE_QUARANTINE
+                else:
+                    phase = PHASE_RESTART
+                prev_scheduled = True
+            else:
+                phase = PHASE_PREEMPTED if prev_scheduled else PHASE_QUEUE
+                prev_scheduled = False
+            out[rnd] = phase
+        return out
+
+    def phase_totals(self) -> "OrderedDict[str, int]":
+        totals: "OrderedDict[str, int]" = OrderedDict(
+            (p, 0) for p in PHASE_ORDER)
+        for phase in self.phases().values():
+            totals[phase] += 1
+        return totals
+
+
+def build_timeline(events: List[dict], int_id: int) -> JobTimeline:
+    tl = JobTimeline(int_id)
+    rounds_ended = 0          # rounds completed so far (anchor)
+    next_record_idx = 0       # see round-index rule below
+    quarantined_this_round: set = set()
+    for rec in events:
+        etype = rec.get("type", "?")
+        data = rec.get("data", {}) or {}
+        if etype == "round_recorded":
+            # A recorded round's index: the stamped value when present
+            # (emitted since this module landed), kept monotonic — the
+            # physical mid-round records NEXT round under the current
+            # round's counter, and a crash re-records an abandoned
+            # round; max(stamp, next expected) resolves both.
+            stamp = int(data.get("round", next_record_idx))
+            idx = max(stamp, next_record_idx)
+            next_record_idx = idx + 1
+            for key, ids in data.get("assignments", []):
+                if int_id in _members(key):
+                    tl.scheduled[idx] = [int(i) for i in ids]
+            quarantined_this_round = set()
+        elif etype == "round_ended":
+            rounds_ended = int(data.get("round", rounds_ended + 1))
+            next_record_idx = max(next_record_idx, rounds_ended)
+            tl.round_wall[rounds_ended] = float(rec.get("t", 0.0))
+            quarantined_this_round = set()
+        elif etype == "job_added" and int(data.get("int_id", -1)) == int_id:
+            tl.admitted = data
+            tl.admitted_seq_t = float(rec.get("t", 0.0))
+            tl.admission_round = rounds_ended
+            tl.deferred = "trace_position" in (data.get("job") or {}) or \
+                "trace_position" in data
+        elif etype == "job_removed" and int(data.get("int_id", -1)) == int_id:
+            tl.removed_round = rounds_ended
+            tl.removed_t = float(data.get("ts", rec.get("t", 0.0)))
+        elif etype == "microtask_done":
+            members = _members(data.get("key", []))
+            if int_id not in members:
+                continue
+            j = members.index(int_id)
+            failed = False
+            steps = 0
+            for update in data.get("updates", []):
+                _, num_steps, times = update
+                if j < len(num_steps):
+                    steps += int(num_steps[j])
+                    if num_steps[j] <= 0 and times[j] <= 0:
+                        failed = True
+            executing = rounds_ended
+            micro = tl.microtasks.setdefault(
+                executing, {"failed": False, "steps": 0,
+                            "quarantined": False})
+            micro["failed"] = micro["failed"] or failed
+            micro["steps"] += steps
+            if failed and (set(tl.scheduled.get(executing, []))
+                           & quarantined_this_round):
+                micro["quarantined"] = True
+        elif etype == "failure_comp" and int(
+                data.get("int_id", -1)) == int_id:
+            tl.failure_comps += 1
+        elif etype == "worker_quarantined":
+            quarantined_this_round.update(
+                int(i) for i in data.get("worker_ids", []))
+    return tl
+
+
+# -- rendering ----------------------------------------------------------
+
+def render(tl: JobTimeline, wall: bool = False,
+           trace_path: Optional[str] = None) -> str:
+    if tl.admitted is None:
+        return (f"job {tl.int_id}: no job_added event in the journal "
+                "(wrong id, or its history was compacted away)")
+    lines: List[str] = []
+    phases = tl.phases()
+    totals = tl.phase_totals()
+    jct_rounds = len(phases)
+    completion = ("incomplete (no job_removed event)"
+                  if tl.removed_round is None
+                  else f"completed round {tl.completion_round}")
+    job_meta = tl.admitted.get("job") or {}
+    lines.append(
+        f"job {tl.int_id} · {job_meta.get('job_type', '?')} "
+        f"sf={job_meta.get('scale_factor', '?')} · admitted round "
+        f"{tl.admission_round}"
+        + (" (admission deferred/reordered)" if tl.deferred else "")
+        + f" · {completion} · jct {jct_rounds} rounds")
+    attributed = sum(totals.values())
+    lines.append("")
+    lines.append(f"{'phase':<22}{'rounds':>8}{'share':>9}")
+    for phase in PHASE_ORDER:
+        count = totals[phase]
+        share = 100.0 * count / jct_rounds if jct_rounds else 0.0
+        lines.append(f"{phase:<22}{count:>8}{share:>8.1f}%")
+    coverage = 100.0 * attributed / jct_rounds if jct_rounds else 0.0
+    lines.append(f"{'total':<22}{attributed:>8}{coverage:>8.1f}%"
+                 f"  (coverage of journal-derived JCT)")
+    lines.append("")
+    lines.append("timeline:")
+    for rnd, phase in phases.items():
+        detail = ""
+        if rnd in tl.scheduled:
+            detail = f"  workers={tl.scheduled[rnd]}"
+            micro = tl.microtasks.get(rnd)
+            if micro is not None:
+                detail += f" steps={micro['steps']}"
+                if micro["failed"]:
+                    detail += " FAILED"
+        lines.append(f"  round {rnd:<5} {phase:<20}{detail}")
+    lines.append("")
+    restarts = sum(1 for m in tl.microtasks.values() if m["failed"])
+    lines.append(
+        f"events: requeues={restarts} "
+        f"failure_compensations={tl.failure_comps} "
+        f"quarantine_migrations={totals[PHASE_QUARANTINE]}")
+    if wall:
+        lines.extend(_render_wall(tl))
+    if wall and trace_path:
+        lines.extend(_render_trace_detail(tl, trace_path))
+    return "\n".join(lines)
+
+
+def _render_wall(tl: JobTimeline) -> List[str]:
+    """Wall-second attribution from journal record stamps (NOT
+    byte-stable across drives — excluded from the default output)."""
+    if tl.removed_t is None or tl.admitted_seq_t is None:
+        return ["", "wall: job incomplete; no wall attribution"]
+    jct_s = max(tl.removed_t - tl.admitted_seq_t, 0.0)
+    phases = tl.phases()
+    seconds: Dict[str, float] = {p: 0.0 for p in PHASE_ORDER}
+    for rnd, phase in phases.items():
+        start = tl.round_wall.get(rnd)
+        end = tl.round_wall.get(rnd + 1)
+        if start is None:
+            start = tl.admitted_seq_t
+        if end is None:
+            end = tl.removed_t
+        lo = max(start, tl.admitted_seq_t)
+        hi = min(end, tl.removed_t)
+        seconds[phase] += max(hi - lo, 0.0)
+    attributed = sum(seconds.values())
+    out = ["", f"wall: jct {jct_s:.1f}s, attributed "
+               f"{attributed:.1f}s "
+               f"({100.0 * attributed / jct_s if jct_s else 0.0:.1f}%)"]
+    for phase in PHASE_ORDER:
+        if seconds[phase] > 0:
+            out.append(f"  {phase:<22}{seconds[phase]:>10.1f}s")
+    return out
+
+
+def _render_trace_detail(tl: JobTimeline, trace_path: str) -> List[str]:
+    """Sub-round span detail for this job from a merged fleet trace."""
+    try:
+        with open(trace_path, encoding="utf-8") as f:
+            trace = json.load(f)
+    except (OSError, ValueError) as e:
+        return ["", f"trace: unreadable ({e})"]
+    events = (trace.get("traceEvents", trace)
+              if isinstance(trace, dict) else trace)
+    by_name: Dict[str, List[float]] = {}
+    for e in events:
+        if e.get("ph", "X") != "X":
+            continue
+        args = e.get("args") or {}
+        if args.get("job") != tl.int_id:
+            continue
+        by_name.setdefault(e.get("name", "?"), []).append(
+            float(e.get("dur", 0.0)) / 1e6)
+    if not by_name:
+        return ["", "trace: no spans tagged with this job id"]
+    out = ["", "trace spans (merged fleet trace):"]
+    for name in sorted(by_name):
+        durs = by_name[name]
+        out.append(f"  {name:<22}n={len(durs):<5} "
+                   f"total={sum(durs):.3f}s mean={sum(durs)/len(durs):.4f}s")
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m shockwave_tpu.obs.explain",
+        description=__doc__.splitlines()[0])
+    p.add_argument("job_id", type=int, help="integer job id")
+    p.add_argument("--state_dir", required=True,
+                   help="scheduler state dir (write-ahead journal)")
+    p.add_argument("--trace", default=None,
+                   help="merged fleet trace (obs.merge output) for "
+                        "span detail (implies nothing without --wall)")
+    p.add_argument("--wall", action="store_true",
+                   help="add wall-second attribution and span detail "
+                        "(not byte-stable across drives)")
+    args = p.parse_args(argv)
+    events = read_all_events(args.state_dir)
+    if not events:
+        print(f"{args.state_dir}: no journal events", file=sys.stderr)
+        return 1
+    tl = build_timeline(events, args.job_id)
+    out = render(tl, wall=args.wall, trace_path=args.trace)
+    print(out)
+    return 0 if tl.admitted is not None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

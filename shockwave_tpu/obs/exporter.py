@@ -1,8 +1,13 @@
 """Scheduler health endpoint: a lightweight HTTP server exposing
 
-- ``GET /metrics``  — Prometheus text exposition of a MetricsRegistry,
-- ``GET /healthz``  — JSON from an injected health callback (current
-  round, live workers, breaker states, journal lag, ...).
+- ``GET /metrics``      — Prometheus text exposition of a MetricsRegistry,
+- ``GET /healthz``      — JSON from an injected health callback (current
+  round, live workers, breaker states, journal lag, ...),
+- ``GET /history.json`` — JSON from an injected telemetry-history
+  callback (obs/history.py: per-round metric snapshots + observed
+  throughput points + alert verdicts); 404 when the process keeps no
+  history (e.g. an HA hot standby before promotion — the history is
+  served by whichever process holds the journal).
 
 Built on the stdlib ThreadingHTTPServer: no new dependencies, one
 daemon thread, bounded per-request work (render + send). Opt-in via
@@ -31,9 +36,11 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 class ObsHttpServer:
     def __init__(self, registry: MetricsRegistry,
                  health_fn: Optional[Callable[[], dict]] = None,
+                 history_fn: Optional[Callable[[], dict]] = None,
                  addr: str = "0.0.0.0", port: int = 0):
         self._registry = registry
         self._health_fn = health_fn
+        self._history_fn = history_fn
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -58,9 +65,14 @@ class ObsHttpServer:
                     code, payload = outer._health()
                     self._send(code, "application/json",
                                json.dumps(payload).encode())
+                elif path == "/history.json":
+                    code, payload = outer._history()
+                    self._send(code, "application/json",
+                               json.dumps(payload).encode())
                 else:
                     self._send(404, "text/plain",
-                               b"try /metrics or /healthz\n")
+                               b"try /metrics, /healthz or "
+                               b"/history.json\n")
 
         self._httpd = ThreadingHTTPServer((addr, port), _Handler)
         self._httpd.daemon_threads = True
@@ -80,6 +92,20 @@ class ObsHttpServer:
             return 500, {"status": "error", "error": f"{type(e).__name__}: {e}"}
         payload.setdefault("status", "ok")
         return 200, payload
+
+    def _history(self):
+        if self._history_fn is None:
+            return 404, {"status": "no_history",
+                         "detail": "this process keeps no telemetry "
+                                   "history (see /metrics for live "
+                                   "gauges)"}
+        try:
+            return 200, dict(self._history_fn())
+        except Exception as e:  # noqa: BLE001 - history is telemetry;
+            # a broken ring must report, not take the exporter down.
+            logger.exception("history callback failed")
+            return 500, {"status": "error",
+                         "error": f"{type(e).__name__}: {e}"}
 
     @property
     def port(self) -> int:

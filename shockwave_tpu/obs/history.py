@@ -1,0 +1,271 @@
+"""Telemetry history: a crash-safe ring of per-round metric snapshots
+plus per-microtask observed-throughput points.
+
+Instantaneous gauges answer "what is happening"; the learned throughput
+oracle (ROADMAP item 2) and any regression analysis need "what has been
+happening" — and nothing retained that beyond the journal's accounting
+events. This module keeps two bounded rings:
+
+- **rounds**: one flattened snapshot of every registered metric per
+  scheduling round (counters, gauges, histogram count/sum), stamped
+  with the injected clock;
+- **observations**: one ``(job_type, batch_size, scale_factor,
+  worker_type) -> observed steps/s`` point per completed micro-task —
+  exactly the training set a learned performance model consumes
+  (PAPERS.md 2008.01040).
+
+Both rings are flushed to ONE file (``history.json`` in the state dir)
+through `core/durable_io.write_text_atomic` every few rounds, so a
+crash or an HA failover loses at most one flush interval and the
+promoted leader reloads the ring and keeps appending — the history is
+served by whichever process holds the journal. The exporter serves the
+whole payload as ``/history.json``.
+
+Simple burn-rate / regression checks run at every round sample and
+surface as the ``swtpu_alert`` gauge (one series per check), which the
+PR 8 health scorer and the PR 9 what-if forecasts can read off the
+shared registry.
+
+Off by default in simulation: the scheduler only constructs a history
+when configured (physical drivers enable it), so canonical replays
+never execute this code.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from . import names
+from .clock import Clock
+from .registry import MetricsRegistry
+
+#: Ring bounds: ~512 rounds of snapshots (days at 360 s rounds) and a
+#: few thousand throughput points.
+DEFAULT_MAX_ROUNDS = 512
+DEFAULT_MAX_OBSERVATIONS = 8192
+DEFAULT_FLUSH_INTERVAL_ROUNDS = 8
+
+HISTORY_SCHEMA = 1
+
+#: Check names of the swtpu_alert gauge.
+CHECK_ROUND_OVERRUN = "round_overrun"
+CHECK_DISPATCH_BURN = "dispatch_failure_burn"
+CHECK_THROUGHPUT_REGRESSION = "throughput_regression"
+
+#: Thresholds (module constants so tests can reason about them).
+ROUND_OVERRUN_FACTOR = 1.5
+DISPATCH_BURN_WINDOW_ROUNDS = 8
+DISPATCH_BURN_RATIO = 0.2
+REGRESSION_MIN_SAMPLES = 6
+REGRESSION_RATIO = 0.7
+
+
+def _median(values: List[float]) -> float:
+    ordered = sorted(values)
+    n = len(ordered)
+    mid = n // 2
+    if n % 2:
+        return ordered[mid]
+    return 0.5 * (ordered[mid - 1] + ordered[mid])
+
+
+class TelemetryHistory:
+    """Bounded, durable telemetry rings over one MetricsRegistry."""
+
+    def __init__(self, registry: MetricsRegistry, clock: Clock,
+                 path: str,
+                 time_per_iteration: Optional[float] = None,
+                 max_rounds: int = DEFAULT_MAX_ROUNDS,
+                 max_observations: int = DEFAULT_MAX_OBSERVATIONS,
+                 flush_interval_rounds: int = DEFAULT_FLUSH_INTERVAL_ROUNDS):
+        self._registry = registry
+        self._clock = clock
+        self.path = path
+        self._time_per_iteration = time_per_iteration
+        self._flush_interval = max(int(flush_interval_rounds), 1)
+        self._rounds: "deque[dict]" = deque(maxlen=max_rounds)
+        self._observations: "deque[list]" = deque(maxlen=max_observations)
+        self._alerts: Dict[str, int] = {}
+        self._samples_since_flush = 0
+        # Leaf lock: the round loop appends under the scheduler lock
+        # while the exporter's request thread reads /history.json; like
+        # the registry lock it is never held across another subsystem.
+        from ..analysis.sanitizer import maybe_wrap
+        self._lock = maybe_wrap(threading.Lock(),
+                                "TelemetryHistory._lock")
+        self._load()
+
+    @classmethod
+    def from_config(cls, cfg: Optional[dict], registry, clock, path,
+                    time_per_iteration=None) -> "TelemetryHistory":
+        cfg = dict(cfg or {})
+        return cls(registry, clock,
+                   path=cfg.get("path", path),
+                   time_per_iteration=time_per_iteration,
+                   max_rounds=int(cfg.get("max_rounds",
+                                          DEFAULT_MAX_ROUNDS)),
+                   max_observations=int(cfg.get(
+                       "max_observations", DEFAULT_MAX_OBSERVATIONS)),
+                   flush_interval_rounds=int(cfg.get(
+                       "flush_interval_rounds",
+                       DEFAULT_FLUSH_INTERVAL_ROUNDS)))
+
+    # -- durability -----------------------------------------------------
+
+    def _load(self) -> None:
+        """Seed the rings from a previous incarnation's flush (crash
+        recovery / HA takeover); a missing, foreign, future-schema or
+        partially-malformed file contributes nothing rather than
+        planting entries the alert checks (which run inside the round
+        loop) would KeyError on."""
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return
+        if not isinstance(payload, dict):
+            return
+        schema = payload.get("schema")
+        if schema != HISTORY_SCHEMA:
+            import logging
+            logging.getLogger("shockwave_tpu.obs").warning(
+                "telemetry history %s has schema %r (this build writes "
+                "%d); starting a fresh ring", self.path, schema,
+                HISTORY_SCHEMA)
+            return
+        for entry in payload.get("rounds", []):
+            if (isinstance(entry, dict) and "round" in entry
+                    and isinstance(entry.get("t"), (int, float))
+                    and isinstance(entry.get("metrics"), dict)):
+                self._rounds.append(entry)
+        for entry in payload.get("observations", []):
+            if isinstance(entry, list) and len(entry) == 6:
+                self._observations.append(entry)
+
+    def flush(self) -> str:
+        from ..core.durable_io import write_text_atomic
+        text = json.dumps(self.payload())
+        write_text_atomic(self.path, text)
+        with self._lock:
+            self._samples_since_flush = 0
+        self._registry.inc(names.HISTORY_FLUSHES_TOTAL)
+        return self.path
+
+    # -- sampling -------------------------------------------------------
+
+    @staticmethod
+    def _flatten_snapshot(snapshot: dict) -> Dict[str, float]:
+        """Registry snapshot -> flat {series_key: value}; histogram
+        series flatten to _count and _sum."""
+        flat: Dict[str, float] = {}
+        for name, data in snapshot.items():
+            for key, value in data.get("series", {}).items():
+                label = ",".join(str(k) for k in key)
+                suffix = f"{{{label}}}" if label else ""
+                if data.get("kind") == "histogram":
+                    flat[f"{name}_count{suffix}"] = float(value["count"])
+                    flat[f"{name}_sum{suffix}"] = float(value["sum"])
+                else:
+                    flat[f"{name}{suffix}"] = float(value)
+        return flat
+
+    def sample_round(self, round_id: int) -> None:
+        """Append one full metric snapshot for a completed round, run
+        the alert checks, and flush if the interval is due."""
+        entry = {"round": int(round_id), "t": float(self._clock()),
+                 "metrics": self._flatten_snapshot(
+                     self._registry.snapshot())}
+        with self._lock:
+            self._rounds.append(entry)
+            verdicts = self._compute_checks_locked()
+            self._alerts = verdicts
+            self._samples_since_flush += 1
+            need_flush = self._samples_since_flush >= self._flush_interval
+        self._registry.inc(names.HISTORY_SAMPLES_TOTAL, kind="round")
+        for check, firing in verdicts.items():
+            self._registry.set_gauge(names.ALERT, float(firing),
+                                     check=check)
+        if need_flush:
+            self.flush()
+
+    def record_observation(self, job_type: str, batch_size,
+                           scale_factor: int, worker_type: str,
+                           steps_per_s: float, round_id: int) -> None:
+        """One per-microtask observed rate point — the learned-oracle
+        training row."""
+        with self._lock:
+            self._observations.append(
+                [int(round_id), str(job_type), batch_size,
+                 int(scale_factor), str(worker_type),
+                 float(steps_per_s)])
+        self._registry.inc(names.HISTORY_SAMPLES_TOTAL,
+                           kind="observation")
+
+    # -- checks ---------------------------------------------------------
+
+    def _metric_delta(self, series_key: str, window: int) -> float:
+        """Counter increase of `series_key` over the last `window`
+        round samples (0.0 with insufficient history)."""
+        if len(self._rounds) < 2:
+            return 0.0
+        recent = list(self._rounds)[-(window + 1):]
+        first = recent[0]["metrics"].get(series_key, 0.0)
+        last = recent[-1]["metrics"].get(series_key, 0.0)
+        return max(last - first, 0.0)
+
+    def _compute_checks_locked(self) -> Dict[str, int]:
+        """All check verdicts; caller holds self._lock (the checks read
+        the rings) and publishes the gauges outside it."""
+        return {
+            CHECK_ROUND_OVERRUN: self._check_round_overrun(),
+            CHECK_DISPATCH_BURN: self._check_dispatch_burn(),
+            CHECK_THROUGHPUT_REGRESSION: self._check_regression(),
+        }
+
+    def _check_round_overrun(self) -> int:
+        if self._time_per_iteration is None or len(self._rounds) < 2:
+            return 0
+        wall = self._rounds[-1]["t"] - self._rounds[-2]["t"]
+        return int(wall > ROUND_OVERRUN_FACTOR * self._time_per_iteration)
+
+    def _check_dispatch_burn(self) -> int:
+        window = DISPATCH_BURN_WINDOW_ROUNDS
+        bad = (self._metric_delta(
+                   "swtpu_dispatches_total{unavailable}", window)
+               + self._metric_delta(
+                   "swtpu_dispatches_total{rejected}", window))
+        ok = self._metric_delta("swtpu_dispatches_total{ok}", window)
+        total = ok + bad
+        return int(total > 0 and bad / total > DISPATCH_BURN_RATIO)
+
+    def _check_regression(self) -> int:
+        by_key: Dict[tuple, List[float]] = {}
+        for rnd, job_type, bs, sf, wt, rate in self._observations:
+            by_key.setdefault((job_type, bs, sf, wt), []).append(rate)
+        for rates in by_key.values():
+            if len(rates) < REGRESSION_MIN_SAMPLES:
+                continue
+            head, tail = rates[:-3], rates[-3:]
+            if not head:
+                continue
+            if _median(tail) < REGRESSION_RATIO * _median(head):
+                return 1
+        return 0
+
+    # -- reading (exporter /history.json, tests) ------------------------
+
+    @property
+    def alerts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._alerts)
+
+    def payload(self) -> dict:
+        with self._lock:
+            return {
+                "schema": HISTORY_SCHEMA,
+                "rounds": list(self._rounds),
+                "observations": [list(o) for o in self._observations],
+                "alerts": dict(self._alerts),
+            }

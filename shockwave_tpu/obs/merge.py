@@ -1,0 +1,171 @@
+"""Fuse per-process span shards into one Perfetto/Chrome fleet trace.
+
+    python -m shockwave_tpu.obs.merge <trace_dir> [-o merged.json]
+
+Reads every ``spans-<role>-<pid>.json`` shard in the directory
+(scheduler, worker daemons, trainers — see obs/shard.py), aligns
+per-host clock offsets, and writes a single Chrome-trace JSON whose
+span args carry the propagated (trace_id, span_id, parent_id)
+identities — so one round's solve -> dispatch -> launch -> trainer ->
+done chain renders as one connected timeline and tests can walk parent
+links across process boundaries.
+
+Clock alignment: every scheduler->worker RPC carries the sender's send
+timestamp (names.TRACE_SENDTS_METADATA_KEY); the receiver's `runjob`
+span records it beside its own receive stamp. For each non-scheduler
+host the offset estimate is the MINIMUM of (recv - send) over all
+pairs — the pair least inflated by network latency; one-directional,
+so the residual error is bounded by the fastest observed RPC, which on
+an intra-cluster fabric is well under a round. The scheduler's host is
+the reference (offset 0), and trainer shards inherit their host's
+offset (trainers run on the worker host).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+from . import names
+from .shard import discover_shards, load_shard
+
+
+def _host_offsets(shards: List[dict]) -> Dict[str, float]:
+    """host -> seconds to SUBTRACT from that host's timestamps."""
+    sched_hosts = {s.get("host") for s in shards
+                   if s.get("role") == "scheduler"}
+    estimates: Dict[str, List[float]] = {}
+    for shard in shards:
+        host = shard.get("host", "?")
+        if host in sched_hosts:
+            continue
+        for span in shard.get("spans", []):
+            send_ts = (span.get("args") or {}).get("send_ts")
+            if send_ts is None:
+                continue
+            try:
+                estimates.setdefault(host, []).append(
+                    float(span["ts"]) - float(send_ts))
+            except (TypeError, ValueError):
+                continue
+    offsets = {host: 0.0 for host in sched_hosts if host is not None}
+    for host, deltas in estimates.items():
+        offsets[host] = min(deltas)
+    return offsets
+
+
+def merge_directory(directory: str, out_path: Optional[str] = None,
+                    obs=None) -> dict:
+    """Merge every shard in `directory` into one Chrome trace at
+    `out_path` (default ``<directory>/merged_trace.json``). Returns a
+    summary dict: shard/span counts, per-host offsets, output path."""
+    if obs is None:
+        from . import get_observability
+        obs = get_observability()
+    paths = discover_shards(directory)
+    shards = []
+    skipped = []
+    for path in paths:
+        shard = load_shard(path)
+        if shard is None:
+            skipped.append(os.path.basename(path))
+            continue
+        shards.append(shard)
+    offsets = _host_offsets(shards)
+    events = []
+    process_meta = []
+    total_spans = 0
+    for idx, shard in enumerate(shards):
+        role = shard.get("role", "?")
+        host = shard.get("host", "?")
+        offset = offsets.get(host, 0.0)
+        pid = idx + 1
+        process_meta.append({
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": f"{role} {host}:{shard.get('pid')}"}})
+        obs.inc(names.TRACE_MERGE_SHARDS_TOTAL, role=role)
+        from .tracing import Tracer
+        for span in shard.get("spans", []):
+            # Shard spans share the tracer-event shape, so the one
+            # identity-folding implementation serves both exports.
+            args = Tracer.event_args(span)
+            args["role"] = role
+            events.append({
+                "name": span.get("name", "?"), "ph": "X",
+                "cat": "swtpu",
+                "ts": (float(span.get("ts", 0.0)) - offset) * 1e6,
+                "dur": float(span.get("dur", 0.0)) * 1e6,
+                "pid": pid, "tid": span.get("tid", 0) or 0,
+                "args": args})
+            total_spans += 1
+    obs.inc(names.TRACE_MERGE_SPANS_TOTAL, amount=total_spans)
+    for host, offset in offsets.items():
+        obs.set_gauge(names.TRACE_MERGE_CLOCK_OFFSET_SECONDS, offset,
+                      host=host)
+    if out_path is None:
+        out_path = os.path.join(directory, names.MERGED_TRACE_NAME)
+    trace = {"displayTimeUnit": "ms",
+             "traceEvents": process_meta + events}
+    from ..core.durable_io import write_text_atomic
+    write_text_atomic(out_path, json.dumps(trace))
+    return {"out": out_path, "shards": len(shards),
+            "skipped": skipped, "spans": total_spans,
+            "offsets": {h: round(o, 6) for h, o in offsets.items()}}
+
+
+# -- parent-link helpers (merge consumers: explain, tests) --------------
+
+def spans_by_id(trace_events: List[dict]) -> Dict[str, dict]:
+    """span_id -> event for every identity-carrying span event."""
+    out = {}
+    for e in trace_events:
+        if e.get("ph", "X") != "X":
+            continue
+        span_id = (e.get("args") or {}).get("span_id")
+        if span_id:
+            out[span_id] = e
+    return out
+
+
+def parent_chain(index: Dict[str, dict], event: dict,
+                 limit: int = 64) -> List[dict]:
+    """The chain [event, parent, grandparent, ...] following parent_id
+    links through `index` (stops at a missing parent or `limit`)."""
+    chain = [event]
+    seen = set()
+    current = event
+    while len(chain) < limit:
+        parent_id = (current.get("args") or {}).get("parent_id")
+        if not parent_id or parent_id in seen:
+            break
+        seen.add(parent_id)
+        parent = index.get(parent_id)
+        if parent is None:
+            break
+        chain.append(parent)
+        current = parent
+    return chain
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m shockwave_tpu.obs.merge",
+        description=__doc__.splitlines()[0])
+    p.add_argument("trace_dir", help="directory of spans-*.json shards "
+                                     "(the drive's --trace_dir)")
+    p.add_argument("-o", "--out", default=None,
+                   help="merged Chrome-trace path (default "
+                        "<trace_dir>/merged_trace.json)")
+    args = p.parse_args(argv)
+    summary = merge_directory(args.trace_dir, args.out)
+    if summary["shards"] == 0:
+        print(f"{args.trace_dir}: no span shards found", file=sys.stderr)
+        return 1
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

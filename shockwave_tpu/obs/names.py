@@ -361,6 +361,48 @@ WHATIF_SHADOW_CHAOS_TOTAL = _counter(
     "failure charges or crashed the twin rollout)", ("outcome",))
 
 # ----------------------------------------------------------------------
+# Fleet-wide tracing (obs/propagation.py, obs/shard.py, obs/merge.py)
+# and telemetry history (obs/history.py)
+# ----------------------------------------------------------------------
+
+TRACE_SHARD_SPANS = _gauge(
+    "swtpu_trace_shard_spans",
+    "Spans currently buffered in this process's bounded span-shard "
+    "ring (worker daemons and trainers write shards into the trace "
+    "dir; python -m shockwave_tpu.obs.merge fuses them)")
+TRACE_SHARD_FLUSHES_TOTAL = _counter(
+    "swtpu_trace_shard_flushes_total",
+    "Atomic span-shard file rewrites by this process")
+TRACE_MERGE_SHARDS_TOTAL = _counter(
+    "swtpu_trace_merge_shards_total",
+    "Per-process span shards folded into the merged fleet trace, by "
+    "shard role (scheduler / worker / trainer)", ("role",))
+TRACE_MERGE_SPANS_TOTAL = _counter(
+    "swtpu_trace_merge_spans_total",
+    "Spans emitted into the merged fleet trace")
+TRACE_MERGE_CLOCK_OFFSET_SECONDS = _gauge(
+    "swtpu_trace_merge_clock_offset_seconds",
+    "Per-host clock offset the merge subtracted, estimated from RPC "
+    "send/recv timestamp pairs (scheduler host is the reference)",
+    ("host",))
+HISTORY_SAMPLES_TOTAL = _counter(
+    "swtpu_history_samples_total",
+    "Telemetry-history ring appends, by kind (round: one full metric "
+    "snapshot per round; observation: one per-microtask observed "
+    "steps/s point keyed by (job_type, bs, sf, worker_type))",
+    ("kind",))
+HISTORY_FLUSHES_TOTAL = _counter(
+    "swtpu_history_flushes_total",
+    "Crash-safe telemetry-history ring flushes to disk "
+    "(core/durable_io atomic rewrite)")
+ALERT = _gauge(
+    "swtpu_alert",
+    "Burn-rate / regression check verdicts over the telemetry history "
+    "(1 = firing), by check (round_overrun / dispatch_failure_burn / "
+    "throughput_regression); readable by the health scorer and the "
+    "what-if forecasts", ("check",))
+
+# ----------------------------------------------------------------------
 # Offline harnesses (scripts/microbenchmarks, scripts/profiling)
 # ----------------------------------------------------------------------
 
@@ -395,10 +437,57 @@ SPAN_WHATIF_ROLLOUT = "whatif-rollout"
 SPAN_PLANNER_SOLVE = "planner-solve"
 SPAN_POLICY_SOLVE = "policy-solve"
 SPAN_PROFILE_MEASURE = "profile-measure"
+SPAN_TRACING_BENCH = "tracing-bench"  # bench_tracing.py synthetic span
+#: Fleet-trace spans (obs/propagation.py). One round's
+#: solve -> dispatch -> launch -> trainer -> done chain shares one
+#: trace id across the scheduler, worker-daemon and trainer processes.
+SPAN_ROUND = "round"                  # scheduler: whole-round root span
+SPAN_RUNJOB_RPC = "runjob-rpc"        # scheduler: one RunJob dispatch RPC
+SPAN_RUNJOB = "runjob"                # worker daemon: RunJob handling
+SPAN_LAUNCH = "launch"                # worker daemon: trainer process life
+SPAN_DONE_REPORT = "done-report"      # worker daemon: Done RPC back
+SPAN_TRAINER = "trainer"              # trainer: lease window (init->exit)
+SPAN_CKPT_LOAD = "ckpt-load"          # trainer: checkpoint restore
+SPAN_CKPT_SAVE = "ckpt-save"          # trainer: checkpoint save
 
 #: Default phase columns of the report table, in pipeline order.
 REPORT_PHASES = (SPAN_SOLVE, SPAN_DISPATCH, SPAN_WAIT, SPAN_END_ROUND,
                  SPAN_JOURNAL_FSYNC)
+
+# ----------------------------------------------------------------------
+# Span-context propagation keys and shard filenames. Declared ONLY here
+# (enforced by the obs-discipline pass: these literals may not appear
+# anywhere else in the tree) so the cross-process contract between the
+# scheduler, the worker daemon, the dispatcher and the trainer-side
+# LeaseIterator cannot fork silently.
+# ----------------------------------------------------------------------
+
+#: gRPC metadata key carrying the traceparent of the sender's active
+#: span on scheduler->worker RPCs (must be lowercase per gRPC).
+TRACEPARENT_METADATA_KEY = "swtpu-traceparent"
+#: gRPC metadata key carrying the sender's wall-clock send timestamp;
+#: paired with the receiver's recv stamp by obs/merge.py to align
+#: per-host clock offsets.
+TRACE_SENDTS_METADATA_KEY = "swtpu-trace-sendts"
+#: Environment variable the dispatcher exports into trainer processes
+#: (the SWTPU_DEGRADE_FACTOR / GAVEL_* pattern): the launch span's
+#: traceparent, consumed by the job-side LeaseIterator.
+TRACEPARENT_ENV = "SWTPU_TRACEPARENT"
+#: Environment variable naming the directory every process writes its
+#: bounded span shard into (run_dir of the drive).
+SHARD_DIR_ENV = "SWTPU_SPAN_SHARD_DIR"
+#: Span-shard filename pattern: spans-<role>-<pid>.json.
+SHARD_FILE_PREFIX = "spans-"
+SHARD_FILE_SUFFIX = ".json"
+#: Default filename of the merged fleet trace next to the shards.
+MERGED_TRACE_NAME = "merged_trace.json"
+#: Default filename of the crash-safe telemetry-history ring.
+HISTORY_FILE_NAME = "history.json"
+
+
+def shard_filename(role: str, pid: int) -> str:
+    """Canonical shard filename for one process's span shard."""
+    return f"{SHARD_FILE_PREFIX}{role}-{int(pid)}{SHARD_FILE_SUFFIX}"
 
 
 def all_metric_specs():

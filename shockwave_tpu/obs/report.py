@@ -1,6 +1,8 @@
 """Per-round phase summary of an exported Chrome trace.
 
     python -m shockwave_tpu.obs.report <trace.json> [--phases a,b,...]
+    python -m shockwave_tpu.obs.report --compare A.json B.json \
+        [--threshold 0.25]
 
 Reads a trace written by ``Tracer.export_chrome_trace`` and prints one
 row per round with the total seconds spent in each pipeline phase
@@ -9,6 +11,10 @@ per-phase totals, counts and means. Spans that carry no ``round`` arg
 (journal fsyncs fire from RPC threads that don't know the round) are
 attributed to the round whose [start, next-start) window contains their
 start timestamp; spans outside every window land in the "-" row.
+
+``--compare A B`` diffs two traces' per-phase mean durations (B
+against baseline A) and exits nonzero when any phase regressed past
+``--threshold`` (default +25%) — the CI smoke jobs' overhead gate.
 """
 from __future__ import annotations
 
@@ -112,24 +118,88 @@ def render(spans: List[dict],
     return "\n".join(lines)
 
 
+def compare(path_a: str, path_b: str,
+            phases: Tuple[str, ...] = names.REPORT_PHASES,
+            threshold: float = 0.25):
+    """Diff per-phase means of trace B against baseline A.
+
+    Returns (report text, regressed phase list). A phase regresses when
+    its mean duration grew by more than `threshold` (fractional) over a
+    baseline mean that is large enough to measure (>= 1 ms — diffing
+    noise against noise flags nothing)."""
+    stats = {}
+    for path in (path_a, path_b):
+        spans = load_spans(path)
+        _, _, totals = phase_table(spans, phases)
+        stats[path] = totals
+    header = ["phase", "mean_A_s", "mean_B_s", "delta"]
+    widths = [max(len(h), 14) for h in header]
+
+    def fmt(cells):
+        return "  ".join(str(c).rjust(w) for c, w in zip(cells, widths))
+
+    lines = [f"A = {path_a}", f"B = {path_b}", fmt(header),
+             fmt(["-" * w for w in widths])]
+    regressed = []
+    for phase in phases:
+        count_a, total_a = stats[path_a].get(phase, (0, 0.0))
+        count_b, total_b = stats[path_b].get(phase, (0, 0.0))
+        mean_a = total_a / count_a if count_a else 0.0
+        mean_b = total_b / count_b if count_b else 0.0
+        if mean_a >= 1e-3:
+            delta = (mean_b - mean_a) / mean_a
+            delta_str = f"{delta * 100:+.1f}%"
+            if delta > threshold:
+                regressed.append(phase)
+                delta_str += " REGRESSED"
+        else:
+            delta_str = "-"
+        lines.append(fmt([phase, f"{mean_a:.4f}", f"{mean_b:.4f}",
+                          delta_str]))
+    return "\n".join(lines), regressed
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m shockwave_tpu.obs.report",
         description=__doc__.splitlines()[0])
-    p.add_argument("trace", help="Chrome-trace JSON exported by the "
-                                 "tracer (--obs_trace / "
-                                 "export_chrome_trace)")
+    p.add_argument("trace", nargs="+",
+                   help="Chrome-trace JSON exported by the tracer "
+                        "(--obs_trace / export_chrome_trace); with "
+                        "--compare, exactly two: baseline then "
+                        "candidate")
     p.add_argument("--phases", default=None,
                    help="comma-separated span names to tabulate "
                         f"(default: {','.join(names.REPORT_PHASES)})")
+    p.add_argument("--compare", action="store_true",
+                   help="diff two traces' per-phase means; exit 2 when "
+                        "any phase regressed past --threshold")
+    p.add_argument("--threshold", type=float, default=0.25,
+                   help="fractional mean-duration regression tolerance "
+                        "for --compare (default 0.25 = +25%%)")
     args = p.parse_args(argv)
     phases = (tuple(s.strip() for s in args.phases.split(",") if s.strip())
               if args.phases else names.REPORT_PHASES)
-    spans = load_spans(args.trace)
+    if args.compare:
+        if len(args.trace) != 2:
+            p.error("--compare takes exactly two traces: baseline "
+                    "then candidate")
+        text, regressed = compare(args.trace[0], args.trace[1],
+                                  phases, args.threshold)
+        print(text)
+        if regressed:
+            print(f"REGRESSION: phases {regressed} exceeded "
+                  f"+{args.threshold * 100:.0f}% over baseline",
+                  file=sys.stderr)
+            return 2
+        return 0
+    if len(args.trace) != 1:
+        p.error("exactly one trace (or use --compare A B)")
+    spans = load_spans(args.trace[0])
     if not spans:
-        print(f"{args.trace}: no spans", file=sys.stderr)
+        print(f"{args.trace[0]}: no spans", file=sys.stderr)
         return 1
-    print(f"{args.trace}: {len(spans)} spans")
+    print(f"{args.trace[0]}: {len(spans)} spans")
     print(render(spans, phases))
     return 0
 

@@ -116,19 +116,27 @@ class SchedulerToWorkerClient:
                 return ((EPOCH_METADATA_KEY, str(int(epoch))),)
         return None
 
-    def _call(self, method: str, request, policy: Optional[RetryPolicy] = None):
+    def _call(self, method: str, request, policy: Optional[RetryPolicy] = None,
+              metadata_extra: Optional[tuple] = None):
+        metadata = self._epoch_metadata()
+        if metadata_extra:
+            metadata = tuple(metadata or ()) + tuple(metadata_extra)
         return call_with_retry(
             getattr(self._stub, method), request,
             method=f"worker {self.addr}:{self.port}/{method}",
             policy=policy or self._policy, breaker=self.breaker,
-            metadata=self._epoch_metadata())
+            metadata=metadata)
 
     def run_job(self, job_descriptions: Sequence[dict], worker_id: int,
-                round_id: int) -> None:
+                round_id: int,
+                metadata_extra: Optional[tuple] = None) -> None:
+        """`metadata_extra` carries the fleet-trace span context
+        (obs/propagation.rpc_metadata) beside the HA epoch — the same
+        gRPC-metadata channel, empty when tracing is off."""
         request = pb.RunJobRequest(
             jobs=[pb.JobDescription(**d) for d in job_descriptions],
             worker_id=worker_id, round_id=round_id)
-        self._call("RunJob", request)
+        self._call("RunJob", request, metadata_extra=metadata_extra)
 
     def kill_job(self, job_id: int, deadline_s: Optional[float] = None) -> None:
         """With `deadline_s`, a single bounded attempt — for best-effort

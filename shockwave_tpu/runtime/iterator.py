@@ -43,6 +43,8 @@ import os
 import time
 from typing import Any, Callable, Iterable, Optional
 
+from ..obs import names as obs_names
+from . import spans as spans_mod
 from .clients import IteratorToSchedulerClient
 from .lease import Lease
 
@@ -126,6 +128,26 @@ class LeaseIterator:
         self._log_file = os.path.join(round_dir,
                                       f"worker={self._worker_id}.log")
         self._init_logger()
+
+        # Fleet tracing (opt-in): continue the dispatch's trace inside
+        # this training process. The dispatcher exports the launch
+        # span's context + the shard directory into the environment
+        # (runtime/spans.py); the `trainer` span covers this dispatch's
+        # whole lease window and is closed (with the step count) at
+        # lease expiry / completion / process exit, whichever first.
+        self._span_shard = spans_mod.shard_from_env(role="trainer")
+        self._trainer_span = None
+        self._trainer_ctx = None
+        if self._span_shard is not None:
+            self._trainer_span = self._span_shard.open_span(
+                obs_names.SPAN_TRAINER, parent=spans_mod.from_environ(),
+                job=self._job_id, worker=self._worker_id,
+                round=self._round_id)
+            # Kept past the span's close: the post-lease checkpoint
+            # save (the one every dispatch performs) still parents its
+            # ckpt-save span here.
+            self._trainer_ctx = self._trainer_span.context
+            atexit.register(self._close_trainer_span)
 
         self._rpc = IteratorToSchedulerClient(
             self._job_id, self._worker_id, sched_addr, sched_port)
@@ -285,6 +307,7 @@ class LeaseIterator:
                 self._lease.max_duration,
                 extra={"event": "LEASE", "status": "EXPIRED"})
             _device_sync(self._sync_ref)
+            self._close_trainer_span()
             if self._distributed_barrier is not None:
                 self._distributed_barrier()
             raise StopIteration
@@ -318,6 +341,7 @@ class LeaseIterator:
         self._done = True
         if not self._write_on_close:
             self._write_info()
+        self._close_trainer_span()
         self._logger.info("", extra={"event": "LEASE", "status": "COMPLETE"})
 
     def report_checkpoint_ahead(self) -> None:
@@ -344,15 +368,38 @@ class LeaseIterator:
         self._done = True
         self._rpc.update_resource_requirement(big_bs, small_bs)
 
+    def _ckpt_span(self, name):
+        """Checkpoint spans nest under the trainer span's context —
+        which outlives the span's close, because the standard flow is
+        lease expiry (span closed) THEN save_checkpoint. No-op context
+        without a shard."""
+        from contextlib import nullcontext
+        if self._span_shard is None or self._trainer_ctx is None:
+            return nullcontext()
+        return self._span_shard.span(name, parent=self._trainer_ctx,
+                                     job=self._job_id)
+
+    def _close_trainer_span(self) -> None:
+        """Close (once) the dispatch-lifetime trainer span with the
+        final step count; runs at lease exit and again harmlessly from
+        atexit for crashed/aborted loops."""
+        if self._span_shard is None or self._trainer_span is None:
+            return
+        span, self._trainer_span = self._trainer_span, None
+        self._span_shard.close_span(span, steps=self._steps,
+                                    done=self._done)
+
     def load_checkpoint(self, *args, **kwargs):
         self._logger.info("", extra={"event": "LOAD CHECKPOINT", "status": "BEGIN"})
-        out = self._load_checkpoint_func(*args, **kwargs)
+        with self._ckpt_span(obs_names.SPAN_CKPT_LOAD):
+            out = self._load_checkpoint_func(*args, **kwargs)
         self._logger.info("", extra={"event": "LOAD CHECKPOINT", "status": "END"})
         return out
 
     def save_checkpoint(self, *args, **kwargs):
         self._logger.info("", extra={"event": "SAVE CHECKPOINT", "status": "BEGIN"})
-        out = self._save_checkpoint_func(*args, **kwargs)
+        with self._ckpt_span(obs_names.SPAN_CKPT_SAVE):
+            out = self._save_checkpoint_func(*args, **kwargs)
         self._logger.info("", extra={"event": "SAVE CHECKPOINT", "status": "END"})
         return out
 

@@ -178,6 +178,18 @@ def serve_worker(port: int, callbacks: Dict[str, Callable],
     leader probing the fleet is harmless; a standby probing before its
     first dispatch is essential)."""
 
+    # Fleet tracing: hand the propagated span context (traceparent +
+    # sender send-timestamp metadata) to callbacks that accept it; the
+    # legacy 3-arg signature (test stubs, chaos stubs) stays untouched.
+    import inspect
+    try:
+        params = inspect.signature(callbacks["RunJob"]).parameters
+        run_job_takes_trace = ("trace" in params or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in params.values()))
+    except (TypeError, ValueError):
+        run_job_takes_trace = False
+
     def run_job(request, context):
         jobs = [
             dict(job_id=j.job_id, command=j.command,
@@ -187,7 +199,13 @@ def serve_worker(port: int, callbacks: Dict[str, Callable],
                  mode=j.mode)
             for j in request.jobs
         ]
-        callbacks["RunJob"](jobs, request.worker_id, request.round_id)
+        if run_job_takes_trace:
+            from ..obs.propagation import from_rpc_metadata
+            trace = from_rpc_metadata(context.invocation_metadata())
+            callbacks["RunJob"](jobs, request.worker_id,
+                                request.round_id, trace=trace)
+        else:
+            callbacks["RunJob"](jobs, request.worker_id, request.round_id)
         return pb.Empty()
 
     def kill_job(request, context):

@@ -43,7 +43,7 @@ class WorkerDaemon:
     def __init__(self, worker_type: str, sched_addr: str, sched_port: int,
                  worker_port: int, num_chips: int, run_dirs: dict,
                  data_dir: str, checkpoint_dir: str,
-                 obs_port: int = None):
+                 obs_port: int = None, trace_dir: str = None):
         self._shutdown_event = threading.Event()
         self._obs = get_observability()
         self._obs_server = None
@@ -54,6 +54,14 @@ class WorkerDaemon:
                 port=obs_port).start()
         self._worker_type = worker_type
         self._last_dispatch_time = 0.0
+        # Fleet tracing (opt-in): this daemon's bounded span shard in
+        # the drive's trace directory; scheduler-propagated span
+        # contexts (RunJob metadata) parent this daemon's runjob/launch
+        # spans, and the dispatcher forwards them into trainers.
+        from . import spans
+        self._trace_dir = trace_dir or spans.trace_dir_from_env()
+        self._span_shard = spans.init_process_shard(self._trace_dir,
+                                                    role="worker")
         self._rpc_client = WorkerToSchedulerClient(sched_addr, sched_port)
 
         # Control-plane HA: reject dispatches from a deposed leader
@@ -109,7 +117,8 @@ class WorkerDaemon:
             round_duration, chip_ids=list(range(num_chips)),
             worker_rpc_client=self._rpc_client, sched_addr=sched_addr,
             sched_port=sched_port, run_dirs=run_dirs, data_dir=data_dir,
-            checkpoint_dir=checkpoint_dir)
+            checkpoint_dir=checkpoint_dir,
+            span_shard=self._span_shard, trace_dir=self._trace_dir)
 
     def _on_epoch_advance(self, epoch: int) -> None:
         """A new leader's first dispatch reached this daemon: point the
@@ -131,7 +140,7 @@ class WorkerDaemon:
             if self._last_dispatch_time else None,
         }
 
-    def _run_job(self, jobs, worker_id, round_id):
+    def _run_job(self, jobs, worker_id, round_id, trace=None):
         # Worker-side dispatch heartbeat: a daemon that stops receiving
         # RunJobs (partitioned, or starved by the scheduler) shows up as
         # a growing age on this stamp.
@@ -139,7 +148,22 @@ class WorkerDaemon:
         self._obs.inc(obs_names.WORKER_JOBS_DISPATCHED_TOTAL)
         self._obs.set_gauge(obs_names.WORKER_LAST_DISPATCH_TIMESTAMP,
                             self._last_dispatch_time)
-        self._dispatcher.dispatch_jobs(jobs, worker_id, round_id)
+        parent, send_ts = trace if trace is not None else (None, None)
+        if self._span_shard is not None:
+            # The runjob span records this host's RECEIVE stamp beside
+            # the scheduler's send stamp — the RPC timestamp pair the
+            # merge aligns per-host clocks from. The launch span (the
+            # trainer process's lifetime) is the dispatcher's.
+            with self._span_shard.span(
+                    obs_names.SPAN_RUNJOB, parent=parent,
+                    round=round_id, worker=worker_id,
+                    jobs=[j["job_id"] for j in jobs],
+                    **({"send_ts": send_ts} if send_ts is not None
+                       else {})) as ctx:
+                self._dispatcher.dispatch_jobs(jobs, worker_id, round_id,
+                                               trace_parent=ctx)
+        else:
+            self._dispatcher.dispatch_jobs(jobs, worker_id, round_id)
 
     def _kill_job(self, job_id):
         self._dispatcher.kill_job(job_id)
@@ -154,6 +178,9 @@ class WorkerDaemon:
     def join(self):
         self._shutdown_event.wait()
         self._server.stop(grace=1)
+        if self._span_shard is not None:
+            from . import spans
+            spans.flush()
         if self._obs_server is not None:
             self._obs_server.stop()
 
@@ -174,6 +201,12 @@ def main(argv=None):
     p.add_argument("--obs_port", type=int, default=None,
                    help="serve /metrics + /healthz for this daemon "
                         "(0 = ephemeral port; default disabled)")
+    p.add_argument("--trace_dir", default=None,
+                   help="directory this daemon (and its trainer "
+                        "subprocesses) write span shards into; merge "
+                        "with python -m shockwave_tpu.obs.merge "
+                        "(default: $SWTPU_SPAN_SHARD_DIR, else "
+                        "disabled)")
     p.add_argument("--log_level", default="info", choices=LEVELS)
     args = p.parse_args(argv)
 
@@ -194,7 +227,7 @@ def main(argv=None):
                   # in the same tree as the static training scripts.
                   "serving": args.static_run_dir},
         data_dir=args.data_dir, checkpoint_dir=args.checkpoint_dir,
-        obs_port=args.obs_port)
+        obs_port=args.obs_port, trace_dir=args.trace_dir)
     signal.signal(signal.SIGINT, lambda s, f: daemon._shutdown())
     daemon.join()
 

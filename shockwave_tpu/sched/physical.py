@@ -89,6 +89,9 @@ class PhysicalScheduler(Scheduler):
         "_port_offset",
         # pipelined-planning handoff (round loop <-> solve thread)
         "_planner_request", "_planner_result", "_planner_busy",
+        # fleet-trace per-round root span context (round loop; read by
+        # the dispatch path under the same lock)
+        "_round_ctx", "_round_ctx_round", "_round_ctx_started",
         # gray-failure health scoring + quarantine (fed by done/dispatch
         # callbacks and the liveness monitor; read by the round pipeline
         # and the serving tier's suspect-skip)
@@ -231,13 +234,50 @@ class PhysicalScheduler(Scheduler):
                 if self._recovered:
                     self._requeue_inflight_after_recovery()
 
-        # Health endpoint (opt-in): /metrics + /healthz. Started before
-        # the gRPC server so a hung bring-up is already observable.
+        # Fleet-trace propagation (opt-in via obs_trace_dir): each round
+        # gets a root span context; phase spans and per-dispatch RunJob
+        # RPCs nest under it and the context rides the RPC metadata into
+        # worker daemons and trainer subprocesses. None means no
+        # contexts are ever constructed — historical tracer content is
+        # untouched.
+        self._trace_propagation = self._config.obs_trace_dir is not None
+        self._round_ctx = None
+        self._round_ctx_round = -1
+        self._round_ctx_started = 0.0
+
+        # Telemetry history (opt-in; see obs/history.py): per-round
+        # metric snapshots + per-microtask observed steps/s, crash-safe
+        # in the state dir, served as /history.json, surfacing
+        # swtpu_alert burn-rate checks.
+        self._history = None
+        if self._config.history is not None:
+            from ..obs import names as _names
+            from ..obs.history import TelemetryHistory
+            hist_cfg = dict(self._config.history)
+            path = hist_cfg.get("path") or (
+                os.path.join(self._config.state_dir,
+                             _names.HISTORY_FILE_NAME)
+                if self._config.state_dir else None)
+            if path is None:
+                raise ValueError(
+                    "config error: history requires state_dir (the "
+                    "ring file lives beside the journal) or an "
+                    "explicit history.path")
+            self._history = TelemetryHistory.from_config(
+                hist_cfg, self._obs.registry,
+                self.get_current_timestamp, path,
+                time_per_iteration=self._time_per_iteration)
+
+        # Health endpoint (opt-in): /metrics + /healthz (+ the history
+        # ring as /history.json). Started before the gRPC server so a
+        # hung bring-up is already observable.
         self._obs_server = None
         if self._config.obs_port is not None:
             from ..obs.exporter import ObsHttpServer
             self._obs_server = ObsHttpServer(
                 self._obs.registry, health_fn=self.obs_health,
+                history_fn=(self._history.payload
+                            if self._history is not None else None),
                 port=self._config.obs_port).start()
 
         from ..runtime.servers import serve_scheduler
@@ -1616,7 +1656,35 @@ class PhysicalScheduler(Scheduler):
                 self._health_note_rate(worker_id, job_id,
                                        int(all_num_steps[0]),
                                        float(all_execution_times[0]))
+                self._history_note_rate(worker_id, job_id,
+                                        int(all_num_steps[0]),
+                                        float(all_execution_times[0]))
             self._cv.notify_all()
+
+    @requires_lock
+    def _history_note_rate(self, worker_id: int, job_id: JobIdPair,
+                           steps: int, exec_time: float) -> None:
+        """Telemetry-history observation feed: one observed steps/s
+        point per completed micro-task, keyed by (job_type, batch_size,
+        scale_factor, worker_type) — the learned-oracle training row
+        (ROADMAP item 2). Recorded regardless of the health classifier
+        (history is measurement, not mitigation). Must hold the lock."""
+        if self._history is None or steps <= 0 or exec_time <= 0:
+            return
+        if worker_id not in self.workers.id_to_type:
+            return
+        a = self.acct
+        job = a.jobs.get(job_id)  # may already be completed/removed
+        self._history.record_observation(
+            job_type=(job.job_type if job is not None
+                      else a.original_job_type.get(job_id, "?")),
+            batch_size=(job.batch_size if job is not None
+                        else a.original_bs.get(job_id)),
+            scale_factor=(job.scale_factor if job is not None else len(
+                self.rounds.current_assignments.get(job_id, (0,)))),
+            worker_type=self.workers.id_to_type[worker_id],
+            steps_per_s=steps / exec_time,
+            round_id=self.rounds.num_completed_rounds)
 
     @requires_lock
     def _inflight_elapsed_times(self, current_time: float):
@@ -1800,8 +1868,27 @@ class PhysicalScheduler(Scheduler):
                     num_steps=job.total_steps, mode=job.mode))
             dispatch_start = self._obs.clock()
             try:
-                self._worker_connections[worker_id].run_job(
-                    descriptions, worker_id, round_id)
+                if self._trace_propagation and self._obs.enabled:
+                    # One span per dispatch RPC, nested under the
+                    # round's dispatch phase (or the round root at
+                    # startup); its context + send timestamp ride the
+                    # RPC metadata so the worker daemon's runjob span
+                    # parents here and the merge can align clocks.
+                    from ..obs import propagation
+                    parent = (self._obs.tracer.current_context()
+                              or self._round_ctx)
+                    with self._obs.tracer.span(
+                            obs_names.SPAN_RUNJOB_RPC, parent=parent,
+                            round=round_id, worker=worker_id,
+                            jobs=[m.integer_job_id()
+                                  for m in job_id.singletons()]) as rpc_ctx:
+                        self._worker_connections[worker_id].run_job(
+                            descriptions, worker_id, round_id,
+                            metadata_extra=propagation.rpc_metadata(
+                                rpc_ctx, send_ts=dispatch_start))
+                else:
+                    self._worker_connections[worker_id].run_job(
+                        descriptions, worker_id, round_id)
             except WORKER_RPC_ERRORS as e:
                 if self._is_stale_epoch_error(e):
                     # The worker has seen a higher leader epoch: a
@@ -1917,8 +2004,38 @@ class PhysicalScheduler(Scheduler):
                 self._available_workers.put(item)
 
     @requires_lock
+    def _maybe_new_round_ctx(self) -> None:
+        """Open this round's fleet-trace root context (idempotent per
+        round; no-op unless obs_trace_dir propagation is on). The root
+        span itself is recorded at round end with the round's real
+        bounds (record_span), so children can link to it while it is
+        still open."""
+        if not self._trace_propagation or not self._obs.enabled:
+            return
+        current = self.rounds.num_completed_rounds
+        if self._round_ctx is not None and self._round_ctx_round == current:
+            return
+        from ..obs import propagation
+        self._round_ctx = propagation.new_root_context()
+        self._round_ctx_round = current
+        self._round_ctx_started = self.get_current_timestamp()
+
+    @requires_lock
+    def _close_round_ctx(self) -> None:
+        """Record the round root span (round start -> now) and retire
+        the context."""
+        if self._round_ctx is None:
+            return
+        self._obs.tracer.record_span(
+            obs_names.SPAN_ROUND, ts=self._round_ctx_started,
+            dur=self.get_current_timestamp() - self._round_ctx_started,
+            context=self._round_ctx, round=self._round_ctx_round)
+        self._round_ctx = None
+
+    @requires_lock
     def _begin_round(self):
         self._current_round_start_time = self.get_current_timestamp()
+        self._maybe_new_round_ctx()
         self._maybe_kick_planner_solve()
         for job_id in self.rounds.current_assignments:
             for m in job_id.singletons():
@@ -1947,7 +2064,8 @@ class PhysicalScheduler(Scheduler):
         round_end = self._current_round_start_time + self._time_per_iteration
         round_id = self.rounds.num_completed_rounds
 
-        with self._obs.phase(obs_names.SPAN_SOLVE, round=round_id):
+        with self._obs.phase(obs_names.SPAN_SOLVE, parent=self._round_ctx,
+                             round=round_id):
             # Pipelined planning: the MILP ran on the background thread
             # since round start; commit it here if it finished (the
             # planner serves its deadline fallback otherwise), so this
@@ -1975,7 +2093,8 @@ class PhysicalScheduler(Scheduler):
 
         # list(): a dispatch failure retires the worker's host, which
         # prunes that host's entries from next_assignments.
-        with self._obs.phase(obs_names.SPAN_DISPATCH, round=round_id):
+        with self._obs.phase(obs_names.SPAN_DISPATCH,
+                             parent=self._round_ctx, round=round_id):
             for job_id, worker_ids in list(
                     self.rounds.next_assignments.items()):
                 if job_id not in self.rounds.next_assignments:
@@ -2020,7 +2139,8 @@ class PhysicalScheduler(Scheduler):
         jobs_to_complete = {
             job_id for job_id in self.rounds.current_assignments
             if any(m in self.acct.jobs for m in job_id.singletons())}
-        with self._obs.phase(obs_names.SPAN_WAIT, round=round_id):
+        with self._obs.phase(obs_names.SPAN_WAIT, parent=self._round_ctx,
+                             round=round_id):
             while not jobs_to_complete.issubset(
                     self.rounds.completed_in_round):
                 if self._ha_fenced:
@@ -2034,7 +2154,8 @@ class PhysicalScheduler(Scheduler):
                 # retirement), but round liveness must not hinge on
                 # never missing one.
                 self._cv.wait(timeout=5.0)
-        with self._obs.phase(obs_names.SPAN_END_ROUND, round=round_id):
+        with self._obs.phase(obs_names.SPAN_END_ROUND,
+                             parent=self._round_ctx, round=round_id):
             self._finish_round()
 
     @requires_lock
@@ -2066,12 +2187,19 @@ class PhysicalScheduler(Scheduler):
                 finally:
                     self._cv.acquire()
 
+        self._close_round_ctx()
         self.rounds.num_completed_rounds += 1
         self.rounds.completed_in_round = set()
         self.rounds.current_assignments = self.rounds.next_assignments or (
             collections.OrderedDict())
         self.rounds.next_assignments = None
         self._emit("round_ended", round=self.rounds.num_completed_rounds)
+        if self._history is not None:
+            # Sample every registered metric into the telemetry-history
+            # ring (and run the burn-rate checks) once per round; the
+            # periodic flush is one atomic rewrite, same order of cost
+            # as the compacting snapshot below.
+            self._history.sample_round(self.rounds.num_completed_rounds)
         self._maybe_snapshot()
         if self._whatif is not None:
             # Pay only the state-copy cost under the lock (the
@@ -2250,6 +2378,7 @@ class PhysicalScheduler(Scheduler):
             self.rounds.current_assignments = self._schedule_jobs_on_workers()
             if self._shockwave_planner is not None:
                 self._shockwave_planner.increment_round()
+            self._maybe_new_round_ctx()
             for job_id, worker_ids in self.rounds.current_assignments.items():
                 self._try_dispatch_job(job_id, worker_ids)
 
@@ -2258,7 +2387,9 @@ class PhysicalScheduler(Scheduler):
                 if self._ha_fenced:
                     break
                 final = self._is_final_round()
+                self._maybe_new_round_ctx()
                 with self._obs.phase(obs_names.SPAN_BEGIN_ROUND,
+                                     parent=self._round_ctx,
                                      round=self.rounds.num_completed_rounds):
                     self._begin_round()
             time.sleep(self._time_per_iteration * SCHEDULE_RECOMPUTE_FRACTION)
@@ -2359,6 +2490,30 @@ class PhysicalScheduler(Scheduler):
             except OSError:
                 self.log.exception("obs trace export to %s failed",
                                    self._config.obs_trace_path)
+        if self._history is not None:
+            try:
+                self._history.flush()
+            except OSError:
+                self.log.exception("telemetry-history flush failed")
+        if self._config.obs_trace_dir:
+            # Fleet-trace collection: write this scheduler's span shard
+            # beside the worker/trainer shards and fuse everything into
+            # one merged Perfetto trace. Telemetry only — a failed
+            # merge must never fail the shutdown.
+            try:
+                from ..obs.merge import merge_directory
+                from ..obs.shard import export_tracer_shard
+                export_tracer_shard(self._config.obs_trace_dir,
+                                    "scheduler", self._obs.tracer,
+                                    obs=self._obs)
+                summary = merge_directory(self._config.obs_trace_dir,
+                                          obs=self._obs)
+                self.log.info(
+                    "fleet trace merged: %d shards, %d spans -> %s",
+                    summary["shards"], summary["spans"], summary["out"])
+            except Exception:  # noqa: BLE001 - telemetry collection
+                # must never take the shutdown path down
+                self.log.exception("fleet-trace collection failed")
         if self._obs_server is not None:
             self._obs_server.stop()
         # Snapshot the client set under the lock (a re-registration RPC

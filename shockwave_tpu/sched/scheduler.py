@@ -172,6 +172,22 @@ class SchedulerConfig:
     # (view in Perfetto, or summarize with
     # `python -m shockwave_tpu.obs.report`). None skips the export.
     obs_trace_path: Optional[str] = None
+    # Fleet-trace directory: the scheduler writes its own span shard
+    # here at shutdown and merges every shard present (worker daemons
+    # and trainers write theirs when pointed at the same directory via
+    # --trace_dir / $SWTPU_SPAN_SHARD_DIR) into ONE Perfetto trace —
+    # a round's solve->dispatch->launch->trainer chain connected by
+    # propagated span context. None disables propagation entirely
+    # (physical-mode only; simulation never constructs contexts).
+    obs_trace_dir: Optional[str] = None
+    # Telemetry history (obs/history.py): a crash-safe ring sampling
+    # every registered metric each round plus per-microtask observed
+    # steps/s by (job_type, bs, sf, worker_type) — served as
+    # /history.json and feeding the swtpu_alert burn-rate checks.
+    # A dict of TelemetryHistory.from_config overrides ({} for
+    # defaults); None (the default) keeps history off — simulation
+    # stays bit-identical and history-free.
+    history: Optional[dict] = None
     # ---- simulation performance (see README "Fleet-scale simulation")
     # Vectorized sim-core passes (sched/simcore.py): priority recompute,
     # round-queue sort, schedule-membership bookkeeping, batched
@@ -1689,9 +1705,14 @@ class Scheduler:
                 self.rounds.num_scheduled_rounds[int_id] += 1
             else:
                 self.rounds.num_queued_rounds[int_id] += 1
-        self._emit("round_recorded", assignments=[
-            [list(k) if isinstance(k, tuple) else k, list(ids)]
-            for k, ids in int_assignments.items()])
+        # The round stamp anchors obs.explain's per-round attribution
+        # (the physical mid-round records NEXT round under the current
+        # counter; the explainer's monotonic rule resolves it).
+        self._emit("round_recorded",
+                   round=self.rounds.num_completed_rounds,
+                   assignments=[
+                       [list(k) if isinstance(k, tuple) else k, list(ids)]
+                       for k, ids in int_assignments.items()])
 
     def _execute_forced_assignments(
             self, recorded: Dict[int, Sequence[int]]

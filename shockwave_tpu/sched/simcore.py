@@ -295,9 +295,10 @@ def record_round(sched, int_assignments: Dict) -> None:
             num_scheduled[int_id] += 1
         else:
             num_queued[int_id] += 1
-    sched._emit("round_recorded", assignments=[
-        [list(k) if isinstance(k, tuple) else k, list(ids)]
-        for k, ids in int_assignments.items()])
+    sched._emit("round_recorded", round=sched.rounds.num_completed_rounds,
+                assignments=[
+                    [list(k) if isinstance(k, tuple) else k, list(ids)]
+                    for k, ids in int_assignments.items()])
 
 
 def complete_microtask_batch(sched, job_id, worker_ids: Sequence[int],

@@ -1,0 +1,28 @@
+"""Seeded obs-discipline propagation violations.
+
+Mirrors the fleet-tracing half of the pass: a reserved span-context
+key / shard filename literal copied verbatim outside the name catalog
+(the cross-process contract may only be spelled in obs/names.py — the
+test's ``good_names.py`` stand-in), and a wall-clock read inside what
+the test treats as a span-emitting runtime module
+(``clock_extra_globs=("bad_propagation.py",)``) — span timestamps must
+come from the injected obs clock.
+"""
+import time
+
+
+def forked_metadata_key():
+    return ("fixture-traceparent", "00-abc-def-01")  # SEEDED
+
+
+def forked_shard_prefix():
+    return "fixture-spans-" + "worker-1.json"  # SEEDED
+
+
+def stamp_span_start():
+    return time.time()  # SEEDED
+
+
+def reference_is_fine(names):
+    # Attribute references into the catalog are the sanctioned form.
+    return names.TRACEPARENT_METADATA_KEY

@@ -1,0 +1,8 @@
+"""Stand-in name catalog for the obs-discipline propagation-contract
+test: declares reserved span-context/shard constants the way
+obs/names.py does (module-level NAME = "literal" assignments matching
+OBS_RESERVED_CONST_RE). Never a violation itself."""
+
+TRACEPARENT_METADATA_KEY = "fixture-traceparent"
+TRACE_SENDTS_METADATA_KEY = "fixture-trace-sendts"
+SHARD_FILE_PREFIX = "fixture-spans-"

@@ -117,8 +117,34 @@ class TestNegativeFixtures:
         findings = passes.check_obs_discipline(
             fixture_index("bad_obs.py"),
             names_globs=(), obs_globs=("bad_obs.py",),
-            clock_allow_globs=())
+            clock_allow_globs=(), clock_extra_globs=())
         assert_exactly_seeded(findings, "bad_obs.py", "obs-discipline")
+
+    def test_obs_propagation_contract(self):
+        """The fleet-tracing half: a reserved span-context/shard
+        literal copied outside the name catalog, and a wall-clock read
+        in a span-emitting runtime module, are both findings."""
+        findings = passes.check_obs_discipline(
+            fixture_index("good_names.py", "bad_propagation.py"),
+            names_globs=("good_names.py",), obs_globs=(),
+            clock_allow_globs=(),
+            clock_extra_globs=("bad_propagation.py",))
+        assert_exactly_seeded(findings, "bad_propagation.py",
+                              "obs-discipline")
+
+    def test_reserved_literals_harvested_from_real_catalog(self):
+        """The real names.py declares the propagation contract in the
+        shape the harvester expects — an empty harvest would silently
+        disable the contract rule tree-wide."""
+        index = RepoIndex.from_root(
+            REPO, include_dirs=("shockwave_tpu",))
+        reserved = passes._reserved_literals(
+            index, passes.OBS_NAMES_GLOBS)
+        from shockwave_tpu.obs import names as obs_names
+        assert obs_names.TRACEPARENT_METADATA_KEY in reserved
+        assert obs_names.TRACEPARENT_ENV in reserved
+        assert obs_names.SHARD_DIR_ENV in reserved
+        assert obs_names.SHARD_FILE_PREFIX in reserved
 
     def test_cli_exits_one_on_violations(self, tmp_path):
         """End-to-end exit-1 proof: a copy of a broken fixture placed

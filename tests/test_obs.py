@@ -5,6 +5,7 @@ real loopback scheduler), and obs-on/off simulator determinism."""
 import json
 import os
 import re
+import signal
 import socket
 import subprocess
 import sys
@@ -192,10 +193,24 @@ class TestTracer:
              "ts": 10_000_000.0, "dur": 3_000_000.0,
              "args": {"round": 0}},
         ]
-        got = [{k: e[k] for k in ("name", "ph", "cat", "ts", "dur",
-                                  "args")}
+        # Span identities (trace_id/span_id/parent_id) ride in args
+        # since the fleet-tracing work; strip them for the golden
+        # compare and assert them separately below.
+        id_keys = ("trace_id", "span_id", "parent_id")
+        got = [{k: (({a: v for a, v in e[k].items()
+                      if a not in id_keys}) if k == "args" else e[k])
+                for k in ("name", "ph", "cat", "ts", "dur", "args")}
                for e in trace["traceEvents"]]
         assert got == golden
+        events = trace["traceEvents"]
+        assert all("trace_id" in e["args"] and "span_id" in e["args"]
+                   for e in events)
+        # Nesting yields parent links within one trace: the dispatch
+        # span's parent is the solve span, and both share a trace id.
+        dispatch, solve = events[0], events[1]
+        assert dispatch["args"]["parent_id"] == solve["args"]["span_id"]
+        assert dispatch["args"]["trace_id"] == solve["args"]["trace_id"]
+        assert "parent_id" not in solve["args"]  # root
         assert trace["displayTimeUnit"] == "ms"
         # pid/tid present on every event (Perfetto requires them).
         assert all("pid" in e and "tid" in e for e in trace["traceEvents"])
@@ -211,9 +226,179 @@ class TestTracer:
 
     def test_disabled_tracer_records_nothing(self):
         tracer = Tracer(enabled=False)
-        with tracer.span(names.SPAN_WAIT):
-            pass
+        with tracer.span(names.SPAN_WAIT) as ctx:
+            assert ctx is None
         assert tracer.events() == []
+
+    def test_remote_parent_splices_cross_process_context(self):
+        """A span opened with an explicit remote parent joins that
+        trace and links to the remote span id — the worker-daemon /
+        trainer adoption path."""
+        from shockwave_tpu.obs.propagation import SpanContext
+        remote = SpanContext(trace_id="ab" * 16, span_id="cd" * 8)
+        tracer = Tracer(clock=SteppingClock())
+        with tracer.span(names.SPAN_WAIT, parent=remote) as ctx:
+            assert ctx.trace_id == remote.trace_id
+            assert ctx.span_id != remote.span_id
+        event = tracer.events()[0]
+        assert event["parent_id"] == remote.span_id
+        assert event["trace_id"] == remote.trace_id
+
+    def test_record_span_pins_identity_for_late_roots(self):
+        """record_span writes a span under a pre-allocated context so
+        children created earlier link to it (the scheduler's per-round
+        root span, recorded at round end)."""
+        from shockwave_tpu.obs.propagation import new_root_context
+        tracer = Tracer(clock=SteppingClock())
+        root = new_root_context()
+        with tracer.span(names.SPAN_SOLVE, parent=root):
+            pass
+        tracer.record_span(names.SPAN_ROUND, ts=0.0, dur=5.0,
+                           context=root, round=3)
+        solve, round_span = tracer.events()
+        assert solve["parent_id"] == root.span_id
+        assert round_span["span_id"] == root.span_id
+        assert round_span["trace_id"] == solve["trace_id"]
+
+
+class TestPropagation:
+    def test_traceparent_roundtrip(self):
+        from shockwave_tpu.obs import propagation as prop
+        ctx = prop.new_root_context()
+        assert prop.parse_traceparent(prop.format_traceparent(ctx)) == ctx
+
+    def test_malformed_traceparent_is_none(self):
+        from shockwave_tpu.obs import propagation as prop
+        for bad in (None, "", "junk", "00-zz-yy-01",
+                    "01-" + "a" * 32 + "-" + "b" * 16 + "-01-extra"):
+            assert prop.parse_traceparent(bad) is None
+
+    def test_rpc_metadata_roundtrip(self):
+        from shockwave_tpu.obs import propagation as prop
+        ctx = prop.new_root_context()
+        metadata = prop.rpc_metadata(ctx, send_ts=42.5)
+        got, send_ts = prop.from_rpc_metadata(metadata)
+        assert got == ctx and send_ts == 42.5
+        assert prop.rpc_metadata(None) == ()
+        assert prop.from_rpc_metadata(None) == (None, None)
+
+    def test_environ_roundtrip(self):
+        from shockwave_tpu.obs import propagation as prop
+        ctx = prop.new_root_context()
+        env = prop.to_environ(ctx, {})
+        assert prop.from_environ(env) == ctx
+        assert prop.from_environ({}) is None
+
+    def test_ids_are_unique_and_well_formed(self):
+        from shockwave_tpu.obs import propagation as prop
+        trace_ids = {prop.new_trace_id() for _ in range(100)}
+        span_ids = {prop.new_span_id() for _ in range(100)}
+        assert len(trace_ids) == 100 and len(span_ids) == 100
+        assert all(len(t) == 32 for t in trace_ids)
+        assert all(len(s) == 16 for s in span_ids)
+
+
+class TestShardMerge:
+    def _shard(self, tmp_path, role, host, spans):
+        from shockwave_tpu.core.durable_io import write_text_atomic
+        path = os.path.join(str(tmp_path),
+                            names.shard_filename(role, sum(host.encode())))
+        write_text_atomic(path, json.dumps(
+            {"schema": 1, "role": role, "pid": 1, "host": host,
+             "spans": spans}))
+        return path
+
+    def test_shard_writer_flush_and_load(self, tmp_path):
+        from shockwave_tpu.obs.shard import (ShardSpanWriter,
+                                             discover_shards, load_shard)
+        shard = ShardSpanWriter(str(tmp_path), role="worker",
+                                clock=SteppingClock())
+        span = shard.open_span(names.SPAN_LAUNCH, job=7)
+        shard.close_span(span, steps=123)
+        with shard.span(names.SPAN_RUNJOB, parent=span.context,
+                        round=2):
+            pass
+        path = shard.flush()
+        assert path in discover_shards(str(tmp_path))
+        payload = load_shard(path)
+        assert payload["role"] == "worker"
+        by_name = {s["name"]: s for s in payload["spans"]}
+        assert by_name["launch"]["args"]["steps"] == 123
+        assert (by_name["runjob"]["parent_id"]
+                == by_name["launch"]["span_id"])
+
+    def test_load_shard_tolerates_garbage(self, tmp_path):
+        from shockwave_tpu.obs.shard import load_shard
+        bad = tmp_path / "spans-x-1.json"
+        bad.write_text("{not json")
+        assert load_shard(str(bad)) is None
+        assert load_shard(str(tmp_path / "missing.json")) is None
+
+    def test_merge_aligns_remote_host_clock(self, tmp_path):
+        """A worker shard whose clock runs 100s ahead is shifted back
+        by the min (recv - send) over its RPC pairs; the scheduler
+        host is the reference."""
+        from shockwave_tpu.obs.merge import merge_directory
+        self._shard(tmp_path, "scheduler", "host-a", [
+            {"name": "runjob-rpc", "ts": 10.0, "dur": 0.01,
+             "trace_id": "t1", "span_id": "s1", "parent_id": None,
+             "args": {}}])
+        self._shard(tmp_path, "worker", "host-b", [
+            {"name": "runjob", "ts": 110.2, "dur": 0.5,
+             "trace_id": "t1", "span_id": "s2", "parent_id": "s1",
+             "args": {"send_ts": 10.0}},
+            {"name": "runjob", "ts": 140.1, "dur": 0.5,
+             "trace_id": "t1", "span_id": "s3", "parent_id": "s1",
+             "args": {"send_ts": 40.0}}])
+        summary = merge_directory(str(tmp_path))
+        assert summary["shards"] == 2 and summary["spans"] == 3
+        # min(110.2-10, 140.1-40) = 100.1 subtracted from host-b.
+        assert summary["offsets"]["host-b"] == pytest.approx(100.1)
+        assert summary["offsets"]["host-a"] == 0.0
+        with open(summary["out"]) as f:
+            merged = json.load(f)
+        worker_spans = [e for e in merged["traceEvents"]
+                        if (e.get("args") or {}).get("role") == "worker"]
+        # 110.2 - 100.1 = 10.1s -> microseconds.
+        assert min(e["ts"] for e in worker_spans) == pytest.approx(
+            10.1e6, rel=1e-6)
+
+    def test_parent_chain_walks_across_shards(self, tmp_path):
+        from shockwave_tpu.obs.merge import (merge_directory,
+                                             parent_chain, spans_by_id)
+        self._shard(tmp_path, "scheduler", "h", [
+            {"name": "round", "ts": 0.0, "dur": 2.0, "trace_id": "t",
+             "span_id": "root", "parent_id": None, "args": {}},
+            {"name": "runjob-rpc", "ts": 0.5, "dur": 0.01,
+             "trace_id": "t", "span_id": "rpc", "parent_id": "root",
+             "args": {}}])
+        self._shard(tmp_path, "trainer", "h", [
+            {"name": "trainer", "ts": 0.6, "dur": 1.0, "trace_id": "t",
+             "span_id": "tr", "parent_id": "rpc", "args": {"job": 0}}])
+        summary = merge_directory(str(tmp_path))
+        with open(summary["out"]) as f:
+            events = json.load(f)["traceEvents"]
+        index = spans_by_id(events)
+        trainer = next(e for e in events if e.get("name") == "trainer")
+        chain = [c["name"] for c in parent_chain(index, trainer)]
+        assert chain == ["trainer", "runjob-rpc", "round"]
+
+    def test_merge_cli(self, tmp_path):
+        self._shard(tmp_path, "scheduler", "h", [
+            {"name": "solve", "ts": 0.0, "dur": 1.0, "trace_id": "t",
+             "span_id": "a", "parent_id": None, "args": {}}])
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.obs.merge",
+             str(tmp_path)], capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        summary = json.loads(out.stdout)
+        assert summary["shards"] == 1
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.obs.merge",
+             str(empty)], capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 1
 
 
 class TestReport:
@@ -332,6 +517,290 @@ class TestExporter:
                 assert "wedged" in body["error"]
         finally:
             server.stop()
+
+    def test_history_endpoint_404_without_history(self):
+        """A process keeping no telemetry history (e.g. an HA standby)
+        answers /history.json with 404, not an error page."""
+        server = ObsHttpServer(MetricsRegistry(), addr="127.0.0.1",
+                               port=0).start()
+        try:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/history.json",
+                    timeout=5)
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+                assert json.loads(e.read())["status"] == "no_history"
+        finally:
+            server.stop()
+
+    def test_history_endpoint_serves_payload(self, tmp_path):
+        from shockwave_tpu.obs.history import TelemetryHistory
+        reg = MetricsRegistry()
+        hist = TelemetryHistory(reg, SteppingClock(),
+                                str(tmp_path / "history.json"))
+        hist.record_observation("ResNet-18", 32, 1, "v5e", 50.0, 0)
+        hist.sample_round(1)
+        server = ObsHttpServer(reg, history_fn=hist.payload,
+                               addr="127.0.0.1", port=0).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/history.json",
+                    timeout=5) as r:
+                payload = json.loads(r.read())
+            assert len(payload["rounds"]) == 1
+            assert payload["observations"] == [
+                [0, "ResNet-18", 32, 1, "v5e", 50.0]]
+            assert set(payload["alerts"]) == {
+                "round_overrun", "dispatch_failure_burn",
+                "throughput_regression"}
+        finally:
+            server.stop()
+
+
+class TestTelemetryHistory:
+    def _history(self, tmp_path, clock=None, **kwargs):
+        from shockwave_tpu.obs.history import TelemetryHistory
+        reg = MetricsRegistry()
+        return reg, TelemetryHistory(
+            reg, clock or SteppingClock(),
+            str(tmp_path / "history.json"), **kwargs)
+
+    def test_round_samples_snapshot_every_metric(self, tmp_path):
+        reg, hist = self._history(tmp_path)
+        reg.inc(COUNTER, kind="a")
+        reg.set_gauge(GAUGE, 7)
+        reg.observe(HIST, 0.5, op="x")
+        hist.sample_round(1)
+        entry = hist.payload()["rounds"][0]
+        assert entry["round"] == 1
+        assert entry["metrics"]["test_events_total{a}"] == 1.0
+        assert entry["metrics"]["test_depth"] == 7.0
+        assert entry["metrics"]["test_latency_seconds_count{x}"] == 1.0
+
+    def test_ring_is_bounded(self, tmp_path):
+        _, hist = self._history(tmp_path, max_rounds=4,
+                                max_observations=8,
+                                flush_interval_rounds=1000)
+        for r in range(10):
+            hist.sample_round(r)
+            for _ in range(3):
+                hist.record_observation("t", 32, 1, "v5e", 10.0, r)
+        payload = hist.payload()
+        assert [e["round"] for e in payload["rounds"]] == [6, 7, 8, 9]
+        assert len(payload["observations"]) == 8
+
+    def test_flush_and_reload_survive_restart(self, tmp_path):
+        reg, hist = self._history(tmp_path)
+        hist.record_observation("t", 32, 1, "v5e", 10.0, 0)
+        hist.sample_round(1)
+        hist.flush()
+        # A new incarnation (promoted standby / restarted scheduler)
+        # reloads the ring and keeps appending.
+        reg2, hist2 = self._history(tmp_path)
+        hist2.sample_round(2)
+        payload = hist2.payload()
+        assert [e["round"] for e in payload["rounds"]] == [1, 2]
+        assert payload["observations"] == [[0, "t", 32, 1, "v5e", 10.0]]
+
+    def test_round_overrun_alert(self, tmp_path):
+        from shockwave_tpu.obs import history as hist_mod
+
+        class JumpClock:
+            def __init__(self):
+                self.now = 0.0
+
+            def __call__(self):
+                return self.now
+
+        clock = JumpClock()
+        reg, hist = self._history(tmp_path, clock=clock)
+        hist._time_per_iteration = 10.0
+        hist.sample_round(1)
+        clock.now = 11.0  # within 1.5x
+        hist.sample_round(2)
+        assert hist.alerts[hist_mod.CHECK_ROUND_OVERRUN] == 0
+        clock.now = 40.0  # 29s round >> 15s
+        hist.sample_round(3)
+        assert hist.alerts[hist_mod.CHECK_ROUND_OVERRUN] == 1
+        assert reg.value(names.ALERT,
+                         check=hist_mod.CHECK_ROUND_OVERRUN) == 1.0
+
+    def test_dispatch_burn_alert(self, tmp_path):
+        from shockwave_tpu.obs import history as hist_mod
+        reg, hist = self._history(tmp_path)
+        hist.sample_round(0)
+        reg.inc(names.DISPATCHES_TOTAL, amount=10, outcome="ok")
+        hist.sample_round(1)
+        assert hist.alerts[hist_mod.CHECK_DISPATCH_BURN] == 0
+        reg.inc(names.DISPATCHES_TOTAL, amount=9, outcome="unavailable")
+        hist.sample_round(2)
+        assert hist.alerts[hist_mod.CHECK_DISPATCH_BURN] == 1
+
+    def test_throughput_regression_alert(self, tmp_path):
+        from shockwave_tpu.obs import history as hist_mod
+        reg, hist = self._history(tmp_path)
+        for r in range(6):
+            hist.record_observation("t", 32, 1, "v5e", 100.0, r)
+        hist.sample_round(6)
+        assert hist.alerts[hist_mod.CHECK_THROUGHPUT_REGRESSION] == 0
+        for r in range(3):
+            hist.record_observation("t", 32, 1, "v5e", 40.0, 7 + r)
+        hist.sample_round(10)
+        assert hist.alerts[hist_mod.CHECK_THROUGHPUT_REGRESSION] == 1
+        assert reg.value(
+            names.ALERT,
+            check=hist_mod.CHECK_THROUGHPUT_REGRESSION) == 1.0
+
+
+class TestExplain:
+    """Unit tests of the journal -> per-job timeline derivation on a
+    synthetic event stream (the loopback acceptance runs in
+    scripts/tests/trace_smoke.py and the CI trace-smoke job)."""
+
+    def _events(self):
+        def ev(seq, etype, t=0.0, **data):
+            return {"seq": seq, "type": etype, "t": t, "data": data}
+        # Round 0: job 0 runs, job 1 queued. Round 1: job 1 runs,
+        # job 0 preempted-waits. Round 2: job 0's microtask FAILS
+        # (worker death; compensated). Round 3: job 0 reruns and both
+        # complete.
+        return [
+            ev(1, "job_added", t=0.0, int_id=0,
+               job={"job_type": "ResNet-18", "scale_factor": 1}),
+            ev(2, "job_added", t=0.1, int_id=1,
+               job={"job_type": "Transformer", "scale_factor": 1,
+                    "trace_position": 3}),
+            ev(3, "round_recorded", round=0, assignments=[[0, [5]]]),
+            ev(4, "microtask_done", t=1.0, key=0,
+               updates=[[5, [200], [1.5]]]),
+            ev(5, "round_ended", t=2.0, round=1),
+            ev(6, "round_recorded", round=1, assignments=[[1, [5]]]),
+            ev(7, "microtask_done", t=3.0, key=1,
+               updates=[[5, [150], [1.4]]]),
+            ev(8, "round_ended", t=4.0, round=2),
+            ev(9, "round_recorded", round=2, assignments=[[0, [5]]]),
+            ev(10, "failure_comp", int_id=0),
+            ev(11, "microtask_done", t=5.0, key=0,
+               updates=[[5, [0], [0.0]]]),
+            ev(12, "round_ended", t=6.0, round=3),
+            ev(13, "round_recorded", round=3,
+               assignments=[[0, [5]], [1, [6]]]),
+            ev(14, "microtask_done", t=7.0, key=0,
+               updates=[[5, [200], [1.5]]]),
+            ev(15, "microtask_done", t=7.1, key=1,
+               updates=[[6, [150], [1.4]]]),
+            ev(16, "job_removed", t=7.5, int_id=0, ts=7.5),
+            ev(17, "job_removed", t=7.6, int_id=1, ts=7.6),
+            ev(18, "round_ended", t=8.0, round=4),
+        ]
+
+    def test_phases_and_full_coverage(self):
+        from shockwave_tpu.obs import explain as ex
+        tl = ex.build_timeline(self._events(), 0)
+        phases = tl.phases()
+        assert phases == {0: ex.PHASE_RUN, 1: ex.PHASE_PREEMPTED,
+                          2: ex.PHASE_RESTART, 3: ex.PHASE_RUN}
+        totals = tl.phase_totals()
+        assert sum(totals.values()) == len(phases)  # 100% coverage
+        assert tl.failure_comps == 1
+
+    def test_queue_wait_and_deferral_marker(self):
+        from shockwave_tpu.obs import explain as ex
+        tl = ex.build_timeline(self._events(), 1)
+        phases = tl.phases()
+        assert phases[0] == ex.PHASE_QUEUE
+        assert phases[1] == ex.PHASE_RUN
+        assert tl.deferred  # trace_position rode job_added
+        text = ex.render(tl)
+        assert "deferred" in text
+        assert "100.0%" in text
+
+    def test_quarantine_migration_classification(self):
+        from shockwave_tpu.obs import explain as ex
+
+        def ev(seq, etype, **data):
+            return {"seq": seq, "type": etype, "t": 0.0, "data": data}
+        events = [
+            ev(1, "job_added", int_id=0, job={"job_type": "t",
+                                              "scale_factor": 1}),
+            ev(2, "round_recorded", round=0, assignments=[[0, [5]]]),
+            ev(3, "worker_quarantined", addr="h", port=1,
+               worker_ids=[5]),
+            ev(4, "microtask_done", key=0, updates=[[5, [0], [0.0]]]),
+            ev(5, "round_ended", round=1),
+            ev(6, "round_recorded", round=1, assignments=[[0, [6]]]),
+            ev(7, "microtask_done", key=0, updates=[[6, [100], [1.0]]]),
+            ev(8, "job_removed", int_id=0, ts=1.0),
+            ev(9, "round_ended", round=2),
+        ]
+        tl = ex.build_timeline(events, 0)
+        assert tl.phases() == {0: ex.PHASE_QUARANTINE, 1: ex.PHASE_RUN}
+
+    def test_unknown_job_reports_cleanly(self):
+        from shockwave_tpu.obs import explain as ex
+        tl = ex.build_timeline(self._events(), 99)
+        assert "no job_added" in ex.render(tl)
+
+    def test_wall_attribution_covers_jct(self):
+        from shockwave_tpu.obs import explain as ex
+        tl = ex.build_timeline(self._events(), 0)
+        text = ex.render(tl, wall=True)
+        m = re.search(r"wall: jct ([0-9.]+)s, attributed ([0-9.]+)s "
+                      r"\(([0-9.]+)%\)", text)
+        assert m, text
+        assert float(m.group(3)) >= 99.0
+
+    def test_cli_reads_a_real_journal(self, tmp_path):
+        from shockwave_tpu.sched.journal import DurabilityLayer
+        layer = DurabilityLayer(str(tmp_path), obs=Observability(
+            clock=SteppingClock(), enabled=False))
+        for rec in self._events():
+            layer.record(rec["type"], rec["data"])
+        layer.close()
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.obs.explain", "0",
+             "--state_dir", str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "jct 4 rounds" in out.stdout
+        assert "restart" in out.stdout
+
+
+class TestReportCompare:
+    def _trace(self, tmp_path, name, solve_s):
+        clock = SteppingClock(start=0.0, step=solve_s)
+        tracer = Tracer(clock=clock)
+        for rnd in range(3):
+            with tracer.span(names.SPAN_SOLVE, round=rnd):
+                pass
+            with tracer.span(names.SPAN_DISPATCH, round=rnd):
+                pass
+        path = str(tmp_path / name)
+        tracer.export_chrome_trace(path)
+        return path
+
+    def test_compare_passes_within_threshold(self, tmp_path):
+        from shockwave_tpu.obs.report import compare
+        a = self._trace(tmp_path, "a.json", 1.0)
+        b = self._trace(tmp_path, "b.json", 1.1)
+        text, regressed = compare(a, b, threshold=0.25)
+        assert regressed == []
+        assert "solve" in text
+
+    def test_compare_flags_regression_and_cli_exits_2(self, tmp_path):
+        from shockwave_tpu.obs.report import compare
+        a = self._trace(tmp_path, "a.json", 1.0)
+        b = self._trace(tmp_path, "b.json", 2.0)
+        _, regressed = compare(a, b, threshold=0.25)
+        assert names.SPAN_SOLVE in regressed
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.obs.report",
+             "--compare", a, b], capture_output=True, text=True,
+            cwd=REPO)
+        assert out.returncode == 2, out.stdout + out.stderr
+        assert "REGRESSED" in out.stdout
 
 
 class _StubWorker:
@@ -466,6 +935,252 @@ class TestPhysicalObsLoopback:
              trace_path], capture_output=True, text=True, cwd=REPO)
         assert out.returncode == 0, out.stdout + out.stderr
         assert "journal-fsync" in out.stdout
+
+
+@pytest.mark.runtime
+@pytest.mark.timeout(180)
+class TestFleetTraceLoopback:
+    """ACCEPTANCE: a sanitizer-clean loopback drive (real scheduler,
+    real worker daemon, real trainer subprocesses under the genuine
+    LeaseIterator) yields ONE merged Perfetto trace in which a round's
+    solve -> dispatch -> launch -> trainer -> done chain is connected
+    by propagated span context across all three processes — asserted
+    by walking parent links across the process boundaries."""
+
+    def test_merged_trace_chains_across_processes(self, tmp_path):
+        from shockwave_tpu.runtime.worker import WorkerDaemon
+        from shockwave_tpu.sched.physical import PhysicalScheduler
+        from shockwave_tpu.sched.scheduler import SchedulerConfig
+        from shockwave_tpu.solver import get_policy
+        sched_port, worker_port = free_port(), free_port()
+        trace_dir = str(tmp_path / "trace")
+        sched = PhysicalScheduler(
+            get_policy("max_min_fairness"),
+            throughputs_file=os.path.join(DATA, "tacc_throughputs.json"),
+            config=SchedulerConfig(
+                time_per_iteration=3.0, max_rounds=8,
+                state_dir=str(tmp_path / "state"),
+                snapshot_interval_rounds=1000,
+                obs_trace_dir=trace_dir, history={}),
+            expected_num_workers=1, port=sched_port)
+        daemon = WorkerDaemon(
+            worker_type="v5e", sched_addr="127.0.0.1",
+            sched_port=sched_port, worker_port=worker_port, num_chips=1,
+            run_dirs={"static": REPO, "accordion": REPO, "gns": REPO,
+                      "serving": REPO},
+            data_dir=None, checkpoint_dir=str(tmp_path / "ckpt"),
+            trace_dir=trace_dir)
+        cmd = (f"{sys.executable} tests/toy_trainer.py "
+               "--step_time 0.001 --chunk 150")
+        job_id = sched.add_job(Job(
+            None, "ResNet-18 (batch size 32)", cmd, "", "--num_steps",
+            total_steps=300, duration=100000))
+        runner = threading.Thread(target=sched.run, daemon=True)
+        runner.start()
+        try:
+            deadline = time.time() + 90
+            while (time.time() < deadline
+                   and len(sched._completed_jobs) < 1):
+                time.sleep(0.3)
+            assert len(sched._completed_jobs) == 1
+        finally:
+            sched._done_event.set()
+            daemon._shutdown()
+            daemon.join()
+            sched.shutdown()
+            sched._server.stop(grace=0)
+
+        # ONE merged trace, written by the scheduler's shutdown
+        # collection, holding shards from all three process roles.
+        from shockwave_tpu.obs.merge import parent_chain, spans_by_id
+        merged_path = os.path.join(trace_dir, names.MERGED_TRACE_NAME)
+        with open(merged_path) as f:
+            merged = json.load(f)
+        events = merged["traceEvents"]
+        roles_present = {(e.get("args") or {}).get("role")
+                         for e in events if e.get("ph") == "X"}
+        assert {"scheduler", "worker", "trainer"} <= roles_present
+
+        index = spans_by_id(events)
+        trainers = [e for e in events if e.get("name") == "trainer"]
+        assert trainers, "no trainer spans reached the merged trace"
+        int_id = job_id.integer_job_id()
+        connected = 0
+        for trainer in trainers:
+            assert (trainer.get("args") or {}).get("job") == int_id
+            chain = parent_chain(index, trainer)
+            chain_names = [c["name"] for c in chain]
+            chain_roles = [(c.get("args") or {}).get("role")
+                           for c in chain]
+            # The chain must cross BOTH process boundaries and reach
+            # the scheduler's round root.
+            if (chain_names[0] == "trainer"
+                    and "launch" in chain_names
+                    and "runjob" in chain_names
+                    and "runjob-rpc" in chain_names
+                    and chain_names[-1] == "round"
+                    and {"trainer", "worker",
+                         "scheduler"} <= set(chain_roles)):
+                connected += 1
+                # The same round's solve span shares the trace id: the
+                # whole solve->dispatch->launch->step->done story is
+                # ONE trace.
+                trace_id = (trainer.get("args") or {}).get("trace_id")
+                solves = [e for e in events if e.get("name") == "solve"
+                          and (e.get("args") or {}).get("trace_id")
+                          == trace_id]
+                assert len(solves) >= 1 or chain_names == [
+                    "trainer", "launch", "runjob", "runjob-rpc",
+                    "round"]  # round 0's solve ran pre-loop (startup)
+        assert connected >= 1, [e.get("name") for e in events]
+
+        # The trainer really consumed its budget through the chain.
+        steps = sum((t.get("args") or {}).get("steps", 0)
+                    for t in trainers)
+        assert steps == 300
+
+
+@pytest.mark.recovery
+@pytest.mark.timeout(360)  # covers the summed internal wait budgets
+class TestExporterUnderHAFailover:
+    """Satellite: leader and standby both scraped mid-failover — no
+    port clash (both exporters live concurrently), role blocks flip,
+    and /history.json is served by whichever process holds the journal
+    (404 on the standby; after promotion the successor serves a ring
+    that includes pre-failover rounds reloaded from the state dir)."""
+
+    def _get(self, port, path, timeout=5):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def test_history_follows_the_journal_holder(self, tmp_path):
+        from test_ha import HA_JSON, _spawn, _wait_for_port
+        state_dir = tmp_path / "state"
+        trace = tmp_path / "obs_ha.trace"
+        line = ("ResNet-18 (batch size 32)\tpython3 main.py "
+                "--batch_size 32\timage_classification/cifar10\t"
+                "--num_steps\t0\t600\t1\tstatic\t1\t-1.000000\t10000\t0")
+        trace.write_text(line + "\n" + line + "\n")
+        p1, p2 = free_port(), free_port()
+        obs1, obs2 = free_port(), free_port()
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["SWTPU_HA_ENDPOINT_FILE"] = str(state_dir / "leader.lease")
+        env["SWTPU_RPC_JITTER_SEED"] = "0"
+        env["SWTPU_RPC_DEADLINE_S"] = "5"
+        env["SWTPU_RPC_BUDGET_S"] = "8"
+        run_physical = os.path.join(REPO, "scripts", "drivers",
+                                    "run_physical.py")
+
+        def sched_cmd(port, obs_port, out, standby=False):
+            cmd = [sys.executable, run_physical, "--trace", str(trace),
+                   "--policy", "max_min_fairness",
+                   "--throughputs",
+                   os.path.join(DATA, "tacc_throughputs.json"),
+                   "--expected_num_workers", "1",
+                   "--round_duration", "2", "--port", str(port),
+                   "--state_dir", str(state_dir),
+                   "--snapshot_interval", "4",
+                   "--obs_port", str(obs_port),
+                   "--history", '{"flush_interval_rounds": 1}',
+                   "--output", str(out), "--ha", HA_JSON,
+                   "--heartbeat_interval", "0.2",
+                   "--worker_timeout", "1.0",
+                   "--probe_failures", "2", "--kill_wait", "0.5",
+                   "--completion_buffer", "5",
+                   "--first_init_grace", "0", "--verbose"]
+            if standby:
+                cmd.append("--ha_standby")
+            return cmd
+
+        leader, llog = _spawn(
+            sched_cmd(p1, obs1, tmp_path / "m1.pkl"),
+            tmp_path / "leader.log", env)
+        assert _wait_for_port(p1), "leader never bound"
+        standby, slog = _spawn(
+            sched_cmd(p2, obs2, tmp_path / "m2.pkl", standby=True),
+            tmp_path / "standby.log", env)
+        worker, wlog = _spawn(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__),
+                          "fault_stub_worker.py"),
+             "--sched_port", str(p1), "--worker_port",
+             str(free_port()), "--num_chips", "1",
+             "--state_file", str(tmp_path / "w.json")],
+            tmp_path / "worker.log", env)
+        try:
+            assert _wait_for_port(obs1), "leader exporter never bound"
+            assert _wait_for_port(obs2), "standby exporter never bound"
+
+            # Mid-run: BOTH endpoints serve concurrently on their own
+            # ports; roles disagree exactly as they should.
+            deadline = time.time() + 60
+            pre_kill_round = None
+            while time.time() < deadline:
+                health = self._get(obs1, "/healthz")
+                if health.get("ha", {}).get("role") == "leader":
+                    hist = self._get(obs1, "/history.json")
+                    if hist["rounds"]:
+                        pre_kill_round = hist["rounds"][-1]["round"]
+                        break
+                time.sleep(0.3)
+            assert pre_kill_round is not None, \
+                (tmp_path / "leader.log").read_text()[-2000:]
+            standby_health = self._get(obs2, "/healthz")
+            assert standby_health["ha"]["role"] == "standby"
+            try:
+                self._get(obs2, "/history.json")
+                assert False, "standby served history it does not hold"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+
+            os.kill(leader.pid, signal.SIGKILL)
+            leader.wait(timeout=10)
+
+            # The standby promotes, rebinds ITS obs port as the new
+            # leader, reloads the history ring from the state dir, and
+            # keeps serving — the role block flips on the same port.
+            deadline = time.time() + 120
+            promoted = False
+            while time.time() < deadline and standby.poll() is None:
+                try:
+                    health = self._get(obs2, "/healthz", timeout=2)
+                except (urllib.error.URLError, OSError):
+                    time.sleep(0.3)  # window: standby server rebinding
+                    continue
+                if health.get("ha", {}).get("role") == "leader":
+                    promoted = True
+                    break
+                time.sleep(0.3)
+            assert promoted, (tmp_path / "standby.log").read_text()[-2000:]
+            hist = self._get(obs2, "/history.json")
+            assert hist["rounds"], "promoted leader serves no history"
+            # Continuity: the reloaded ring reaches back to rounds the
+            # DEAD leader sampled (the history followed the journal).
+            assert hist["rounds"][0]["round"] <= pre_kill_round
+
+            rc = standby.wait(timeout=120)
+            assert rc == 0, (tmp_path / "standby.log").read_text()[-3000:]
+            # The run itself stayed correct through the failover: both
+            # trace jobs completed and their removals are durable in
+            # the (epoch-fenced) journal the successor owns. Read the
+            # raw segments (explain's loader) — load_state would hide
+            # removals compacted into the snapshot.
+            from shockwave_tpu.obs.explain import read_all_events
+            removed = sum(e["type"] == "job_removed"
+                          for e in read_all_events(str(state_dir)))
+            assert removed == 2, [
+                e["type"] for e in read_all_events(str(state_dir))][-20:]
+        finally:
+            for proc in (leader, standby, worker):
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=10)
+            for log in (llog, slog, wlog):
+                log.close()
 
 
 class TestSimObsDeterminism:

@@ -95,6 +95,31 @@ class TestMilpAssemblyBench:
         assert json.loads(out.strip().splitlines()[-1])["assembler"] == "loop"
 
 
+class TestTracingBench:
+    def test_smoke_gate_and_row_shape(self):
+        """bench_tracing honors --smoke and emits the bench.py row
+        fields (spans/s floor + per-round overhead ceiling)."""
+        out = run_script(["scripts/microbenchmarks/bench_tracing.py",
+                          "--smoke", "--spans", "5000",
+                          "--propagations", "2000", "--flushes", "2",
+                          "--min_spans_per_s", "1000"])
+        row = json.loads(out.strip().splitlines()[-1])
+        for key in ("spans_per_s", "propagate_mean_us",
+                    "shard_flush_mean_s", "round_overhead_est_s"):
+            assert key in row
+        assert row["spans_per_s"] > 1000
+
+    def test_smoke_fails_below_floor(self):
+        out = subprocess.run(
+            [sys.executable,
+             "scripts/microbenchmarks/bench_tracing.py", "--smoke",
+             "--spans", "2000", "--propagations", "1000",
+             "--flushes", "1", "--min_spans_per_s", "1e12"],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 1
+        assert "SMOKE FAIL" in out.stderr
+
+
 class TestPlotting:
     def test_all_plot_kinds(self, tmp_path):
         from shockwave_tpu import plotting

@@ -1,0 +1,67 @@
+"""Minimal REAL trainer for loopback drives: a jax-free training loop
+under the genuine LeaseIterator, launched by the genuine Dispatcher as
+a subprocess — so tests can assert the whole fleet-trace chain
+(scheduler -> worker daemon -> trainer) across real process boundaries.
+
+Consumes the dispatcher-constructed command line
+(``--local_rank N --num_steps N --checkpoint_dir D
+--enable_lease_iterator``) plus ``--step_time`` (simulated per-step
+compute) and ``--chunk`` (steps per dispatch before a clean exit, for
+deterministic drives; 0 runs to lease expiry).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from shockwave_tpu.runtime.iterator import LeaseIterator  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--local_rank", type=int, default=0)
+    p.add_argument("--num_steps", type=int, required=True)
+    p.add_argument("--checkpoint_dir", required=True)
+    p.add_argument("--enable_lease_iterator", action="store_true")
+    p.add_argument("--step_time", type=float, default=0.001)
+    p.add_argument("--chunk", type=int, default=0,
+                   help="steps per dispatch before a clean exit "
+                        "(0 = run until the lease expires)")
+    p.add_argument("--batch_size", type=int, default=32)
+    args = p.parse_args()
+
+    state = {"restored": False}
+
+    def load_checkpoint(path):
+        state["restored"] = os.path.exists(os.path.join(path, "step"))
+        return state["restored"]
+
+    def save_checkpoint(path, step):
+        with open(os.path.join(path, "step"), "w") as f:
+            f.write(str(step))
+
+    it = LeaseIterator(
+        data_loader=list(range(64)), checkpoint_dir=args.checkpoint_dir,
+        load_checkpoint_func=load_checkpoint,
+        save_checkpoint_func=save_checkpoint, synthetic_data=True)
+    it.load_checkpoint(args.checkpoint_dir)
+
+    steps = 0
+    while not it.done and (args.chunk <= 0 or steps < args.chunk):
+        try:
+            for _ in it:
+                steps += 1
+                time.sleep(args.step_time)
+                if args.chunk > 0 and steps >= args.chunk:
+                    break
+        except StopIteration:
+            pass
+    if not it.done:
+        it.complete()
+    it.save_checkpoint(args.checkpoint_dir, steps)
+
+
+if __name__ == "__main__":
+    main()
