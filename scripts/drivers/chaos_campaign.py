@@ -458,7 +458,7 @@ def run_physical_schedule(seed, cfg, workdir):
     (plans + invariant booleans); wall telemetry to stderr."""
     import pickle
     import time as _time  # wall-clock is subprocess babysitting only,
-    # never in the record  # swtpu-check: ignore[determinism]
+    # never in the record (call sites carry their own ignores)
 
     rng = np.random.RandomState(cfg["seed_base"] + 10_000 + seed)
     plan = draw_physical_schedule(rng)
@@ -666,7 +666,7 @@ def run_ha_schedule(seed, cfg, workdir):
     + exact journal accounting); wall telemetry stays on stderr."""
     import pickle
     import time as _time  # wall-clock is subprocess babysitting only,
-    # never in the record  # swtpu-check: ignore[determinism]
+    # never in the record (call sites carry their own ignores)
 
     sys.path.insert(0, os.path.join(REPO, "scripts", "utils"))
     import fsck_journal as fsck_mod  # noqa: E402
@@ -1117,7 +1117,7 @@ def main():
     print(json.dumps(result))
     if args.timing_out:
         # Telemetry sidecar, not durable state.
-        with open(args.timing_out, "w") as f:  # swtpu-check: ignore[durability]
+        with open(args.timing_out, "w") as f:
             json.dump(result, f, indent=2)
     if summary["violations"]:
         print(f"CHAOS CAMPAIGN FAILED: {len(summary['violations'])} "

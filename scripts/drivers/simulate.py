@@ -144,7 +144,7 @@ def main():
         pstats.Stats(profiler, stream=buf).sort_stats(
             "cumulative").print_stats(40)
         # Telemetry dump, not durable state: a torn file just re-runs.
-        with open(args.profile_out + ".txt", "w") as f:  # swtpu-check: ignore[durability]
+        with open(args.profile_out + ".txt", "w") as f:
             f.write(buf.getvalue())
         print(f"profile: {args.profile_out} (summary: "
               f"{args.profile_out}.txt)", file=sys.stderr)
@@ -166,7 +166,7 @@ def main():
     print(json.dumps(summary))
     if args.json_out:
         # CI artifact, not durable state: a torn file just re-runs.
-        with open(args.json_out, "w") as f:  # swtpu-check: ignore[durability]
+        with open(args.json_out, "w") as f:
             json.dump(summary, f, indent=2)
 
     if args.output:

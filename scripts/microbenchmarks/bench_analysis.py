@@ -1,0 +1,102 @@
+#!/usr/bin/env python3
+"""Analyzer-performance microbenchmark: whole-tree swtpu-check wall.
+
+Measures three things the analyzer-performance satellite cares about:
+
+- **cold** — one full analyzer run from scratch (parse every module,
+  build the shared call graph, run every pass + the suppression
+  audit): what CI pays;
+- **warm** — a second run against the process-wide cached RepoIndex
+  (mtime-validated): what repeated in-process runs (the tier-1 gate's
+  three CLI invocations, editor integrations) pay;
+- **per-pass** — the wall table from ``run_timed``, so a regression is
+  attributable to one pass rather than "the analyzer got slow".
+
+Prints ONE JSON line. ``--smoke`` exits nonzero when the cold wall
+exceeds ``--max_cold_s`` or the warm wall exceeds ``--max_warm_s`` —
+the CI floor keeping whole-tree analysis cheap enough to run on every
+push (the race detector alone must stay well under a second on this
+~180-module tree).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.analysis import __main__ as cli  # noqa: E402
+from shockwave_tpu.analysis import core  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--root", default=None,
+                   help="repo root (default: autodetect)")
+    p.add_argument("--runs", type=int, default=3,
+                   help="warm runs to average")
+    p.add_argument("--smoke", action="store_true",
+                   help="exit 1 when a floor is violated")
+    p.add_argument("--max_cold_s", type=float, default=6.0,
+                   help="cold full-run ceiling (parse + graph + passes)")
+    p.add_argument("--max_warm_s", type=float, default=3.0,
+                   help="warm (cached-index) full-run ceiling")
+    p.add_argument("--output", default=None,
+                   help="also write the JSON record here")
+    args = p.parse_args()
+
+    root = args.root or cli.default_root()
+
+    # Cold: empty the cache so parsing + call-graph cost is included.
+    core._INDEX_CACHE.clear()
+    t0 = time.perf_counter()
+    findings, timing = cli.run_timed(root=root)
+    cold_s = time.perf_counter() - t0
+
+    warm_walls = []
+    for _ in range(max(args.runs, 1)):
+        t0 = time.perf_counter()
+        findings, timing = cli.run_timed(root=root)
+        warm_walls.append(time.perf_counter() - t0)
+    warm_s = min(warm_walls)
+
+    record = {
+        "bench": "analysis",
+        "files_indexed": len(core.cached_index(
+            root, include_dirs=cli.DEFAULT_INCLUDE_DIRS,
+            exclude_globs=cli.DEFAULT_EXCLUDE_GLOBS).files),
+        "findings": len(findings),
+        "cold_wall_s": round(cold_s, 4),
+        "warm_wall_s": round(warm_s, 4),
+        "per_pass_wall_s": {name: t["wall_s"]
+                            for name, t in sorted(timing.items())},
+    }
+    line = json.dumps(record, sort_keys=True)
+    print(line)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
+
+    if args.smoke:
+        failures = []
+        if cold_s > args.max_cold_s:
+            failures.append(f"cold wall {cold_s:.2f}s > "
+                            f"{args.max_cold_s}s")
+        if warm_s > args.max_warm_s:
+            failures.append(f"warm wall {warm_s:.2f}s > "
+                            f"{args.max_warm_s}s")
+        if findings:
+            failures.append(f"{len(findings)} unexpected finding(s)")
+        if failures:
+            print("bench_analysis SMOKE FAIL: " + "; ".join(failures),
+                  file=sys.stderr)
+            return 1
+        print(f"bench_analysis smoke ok: cold {cold_s:.2f}s, "
+              f"warm {warm_s:.2f}s over "
+              f"{record['files_indexed']} files", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

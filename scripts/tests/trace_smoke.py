@@ -57,11 +57,17 @@ class ShardStubWorker:
         self._iter_client = IteratorToSchedulerClient
         self._client = WorkerToSchedulerClient("localhost", sched_port)
         self.server = serve_worker(worker_port, {
-            "RunJob": self._run_job, "KillJob": lambda j: None,
-            "Reset": lambda: None, "Shutdown": lambda: None,
+            "RunJob": self._run_job, "KillJob": self._noop_kill,
+            "Reset": self._noop_reset, "Shutdown": self._noop_reset,
         })
         self.worker_ids, self.round_duration = self._client.register_worker(
             "v5e", "127.0.0.1", worker_port, num_chips)
+
+    def _noop_kill(self, job_id):
+        pass  # the stub never hosts a killable process
+
+    def _noop_reset(self):
+        pass
 
     def _run_job(self, jobs, worker_id, round_id, trace=None):
         parent, send_ts = trace if trace is not None else (None, None)

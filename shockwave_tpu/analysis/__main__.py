@@ -4,6 +4,19 @@ Runs every pass (or the ``--select``ed subset) over the repo tree and
 prints findings as ``path:line: [pass-id] message``. Exit status: 0 on
 a clean tree, 1 when any finding survives, 2 on usage errors.
 
+After the selected passes, the ``suppression-audit`` pass runs over
+the same index: an inline ``swtpu-check: ignore[<pass-id>]`` that the
+named pass never matched (nothing would fire on that line) is itself a
+finding, so stale exceptions cannot rot in place.
+
+``--json`` emits a machine-readable report: the findings list plus a
+per-pass ``{id, findings, wall_s}`` timing table (the findings content
+is deterministic — byte-identical across runs; wall times are
+telemetry). ``--list`` runs each pass once to report its wall beside
+its description. The parsed-AST index (and the concurrency passes'
+shared call graph) is cached process-wide with mtime validation, so
+repeated runs parse each file once.
+
 The tier-1 gate (tests/test_analysis.py) runs exactly this entry
 point, so CI and a local ``scripts/utils/check.py`` see the same
 verdict.
@@ -11,12 +24,14 @@ verdict.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
-from .core import Finding, RepoIndex
-from .passes import ALL_PASSES
+from .core import Finding, cached_index
+from .passes import SUPPRESSION_AUDIT_ID, ALL_PASSES, check_suppression_audit
 
 #: Repo-relative directories scanned by default.
 DEFAULT_INCLUDE_DIRS = ("shockwave_tpu", "scripts")
@@ -30,17 +45,42 @@ def default_root() -> str:
     return os.path.dirname(package_dir)
 
 
+def run_timed(root: Optional[str] = None,
+              select: Optional[List[str]] = None
+              ) -> Tuple[List[Finding], Dict[str, dict]]:
+    """Run the selected passes (plus the suppression audit) with
+    repo-default scopes. Returns (findings sorted by location,
+    per-pass {id: {findings, wall_s}} timing table)."""
+    index = cached_index(root or default_root(),
+                         include_dirs=DEFAULT_INCLUDE_DIRS,
+                         exclude_globs=DEFAULT_EXCLUDE_GLOBS)
+    index.reset_suppression_hits()
+    findings: List[Finding] = []
+    timing: Dict[str, dict] = {}
+    selected = [p for p in (select or sorted(ALL_PASSES))
+                if p != SUPPRESSION_AUDIT_ID]
+    for name in selected:
+        t0 = time.perf_counter()
+        got = ALL_PASSES[name](index)
+        timing[name] = {"findings": len(got),
+                        "wall_s": round(time.perf_counter() - t0, 4)}
+        findings.extend(got)
+    # The audit must see every selected pass's suppression hits, so it
+    # always runs last.
+    t0 = time.perf_counter()
+    got = check_suppression_audit(index, ran_pass_ids=selected)
+    timing[SUPPRESSION_AUDIT_ID] = {
+        "findings": len(got),
+        "wall_s": round(time.perf_counter() - t0, 4)}
+    findings.extend(got)
+    return (sorted(findings, key=lambda f: (f.path, f.line, f.pass_id)),
+            timing)
+
+
 def run(root: Optional[str] = None,
         select: Optional[List[str]] = None) -> List[Finding]:
-    """Run the selected passes with repo-default scopes; returns the
-    combined findings sorted by location."""
-    index = RepoIndex.from_root(root or default_root(),
-                                include_dirs=DEFAULT_INCLUDE_DIRS,
-                                exclude_globs=DEFAULT_EXCLUDE_GLOBS)
-    findings: List[Finding] = []
-    for name in (select or sorted(ALL_PASSES)):
-        findings.extend(ALL_PASSES[name](index))
-    return sorted(findings, key=lambda f: (f.path, f.line, f.pass_id))
+    """Back-compat entry point (tests, check.py): findings only."""
+    return run_timed(root=root, select=select)[0]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -52,31 +92,62 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "from the installed package location)")
     parser.add_argument("--select", default=None,
                         help="comma-separated pass ids "
-                             f"(default: all of {', '.join(sorted(ALL_PASSES))})")
+                             f"(default: all of {', '.join(sorted(ALL_PASSES))}"
+                             "; the suppression audit always rides along)")
     parser.add_argument("--list", action="store_true",
-                        help="list pass ids and exit")
+                        help="list pass ids with their wall time on this "
+                             "tree, and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="emit a JSON report (findings + per-pass "
+                             "wall) instead of text")
     args = parser.parse_args(argv)
 
     if args.list:
+        _, timing = run_timed(root=args.root)
         for name, fn in sorted(ALL_PASSES.items()):
             first_line = (fn.__doc__ or "").strip().splitlines()[0]
-            print(f"{name}: {first_line}")
+            t = timing.get(name, {})
+            print(f"{name}: {first_line} "
+                  f"[wall {t.get('wall_s', 0.0):.3f}s, "
+                  f"{t.get('findings', 0)} finding(s)]")
+        t = timing.get(SUPPRESSION_AUDIT_ID, {})
+        print(f"{SUPPRESSION_AUDIT_ID}: "
+              f"{check_suppression_audit.__doc__.strip().splitlines()[0]} "
+              f"[wall {t.get('wall_s', 0.0):.3f}s, "
+              f"{t.get('findings', 0)} finding(s)]")
+        total = sum(v.get("wall_s", 0.0) for v in timing.values())
+        print(f"total analyzer wall: {total:.3f}s")
         return 0
 
     select = None
     if args.select:
         select = [p.strip() for p in args.select.split(",") if p.strip()]
-        unknown = [p for p in select if p not in ALL_PASSES]
+        # The audit is not in ALL_PASSES (it must run after the others
+        # and always rides along), but selecting it is legal: alone, it
+        # still flags unknown-id suppressions.
+        unknown = [p for p in select
+                   if p not in ALL_PASSES and p != SUPPRESSION_AUDIT_ID]
         if unknown:
             print(f"unknown pass id(s): {', '.join(unknown)} "
                   f"(try --list)", file=sys.stderr)
             return 2
 
-    findings = run(root=args.root, select=select)
-    for f in findings:
-        print(f)
-    print(f"swtpu-check: {len(findings)} finding(s)"
-          + ("" if findings else " — tree is clean"))
+    findings, timing = run_timed(root=args.root, select=select)
+    if args.json:
+        report = {
+            "findings": [{"file": f.path, "line": f.line,
+                          "pass": f.pass_id, "message": f.message}
+                         for f in findings],
+            "count": len(findings),
+            "passes": [{"id": name, **timing[name]}
+                       for name in sorted(timing)],
+        }
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        for f in findings:
+            print(f)
+        print(f"swtpu-check: {len(findings)} finding(s)"
+              + ("" if findings else " — tree is clean"))
     return 1 if findings else 0
 
 

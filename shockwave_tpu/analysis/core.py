@@ -7,11 +7,12 @@ what the tier-1 gate (tests/test_analysis.py) asserts against.
 Inline suppression: a line (or the ``def`` line of a function, which
 covers the whole function) may carry
 
-    # swtpu-check: ignore[pass-id]           (one id)
-    # swtpu-check: ignore[pass-a,pass-b]     (several)
+    # swtpu-check: ignore[<pass-id>]            (one id)
+    # swtpu-check: ignore[<pass-a>,<pass-b>]    (several)
 
 Every suppression is an auditable exception to an invariant; the
-comment should say why (e.g. "telemetry, not durable state").
+comment should say why (e.g. "telemetry, not durable state"), and the
+suppression-audit pass flags any that stop matching a real finding.
 """
 from __future__ import annotations
 
@@ -45,6 +46,10 @@ class SourceFile:
         self.text = text
         self.tree = ast.parse(text, filename=rel_path)
         self.suppressions: Dict[int, Set[str]] = {}
+        #: (line, pass_id) pairs a pass actually consulted AND matched:
+        #: the suppression-audit pass flags declared suppressions that
+        #: never land here (nothing would have fired on that line).
+        self.suppression_hits: Set[tuple] = set()
         for lineno, line in enumerate(text.splitlines(), start=1):
             m = SUPPRESS_RE.search(line)
             if m:
@@ -53,18 +58,46 @@ class SourceFile:
 
     def suppressed(self, line: int, pass_id: str) -> bool:
         ids = self.suppressions.get(line)
-        return ids is not None and pass_id in ids
+        hit = ids is not None and pass_id in ids
+        if hit:
+            self.suppression_hits.add((line, pass_id))
+        return hit
 
     def matches(self, globs: Iterable[str]) -> bool:
         return any(fnmatch.fnmatch(self.rel, g) for g in globs)
 
 
 class RepoIndex:
-    """The set of files one analyzer run looks at."""
+    """The set of files one analyzer run looks at.
+
+    Every pass shares ONE index (files parsed once); the concurrency
+    passes additionally share one call graph (`call_graph` memoizes).
+    """
 
     def __init__(self, files: List[SourceFile], root: str):
         self.files = files
         self.root = root
+        self._call_graph = None
+        #: (serve-funcs, callback-kwargs) -> (roots, findings); see
+        #: threads.discover_thread_roots.
+        self._thread_roots_memo: Dict[tuple, tuple] = {}
+
+    def call_graph(self):
+        """The shared static call graph (analysis/threads.py), built on
+        first use and reused by every concurrency pass in this run."""
+        if self._call_graph is None:
+            from .threads import CallGraph
+            self._call_graph = CallGraph(self)
+        return self._call_graph
+
+    def reset_suppression_hits(self) -> None:
+        """Forget which suppressions fired (a cached index is reused
+        across analyzer runs; the audit must see only this run). Also
+        drops the thread-roots discovery memo — its findings consult
+        suppressions, so a new run must re-record the hits."""
+        for src in self.files:
+            src.suppression_hits.clear()
+        self._thread_roots_memo = {}
 
     @classmethod
     def from_root(cls, root: str,
@@ -76,23 +109,72 @@ class RepoIndex:
         silently skip code."""
         root = os.path.abspath(root)
         files: List[SourceFile] = []
-        roots = ([os.path.join(root, d) for d in include_dirs]
-                 if include_dirs else [root])
-        for base in roots:
-            for dirpath, dirnames, filenames in os.walk(base):
-                dirnames[:] = [d for d in dirnames
-                               if d not in ("__pycache__", ".git")]
-                for name in sorted(filenames):
-                    if not name.endswith(".py"):
-                        continue
-                    abs_path = os.path.join(dirpath, name)
-                    rel = os.path.relpath(abs_path, root).replace(os.sep, "/")
-                    if any(fnmatch.fnmatch(rel, g) for g in exclude_globs):
-                        continue
-                    with open(abs_path, encoding="utf-8") as f:
-                        text = f.read()
-                    files.append(SourceFile(abs_path, rel, text))
+        for rel, abs_path in iter_py_files(root, include_dirs,
+                                           exclude_globs):
+            with open(abs_path, encoding="utf-8") as f:
+                text = f.read()
+            files.append(SourceFile(abs_path, rel, text))
         return cls(files, root)
+
+
+def iter_py_files(root: str, include_dirs: Optional[Iterable[str]],
+                  exclude_globs: Iterable[str]):
+    """The ONE directory walk behind both the index build and the
+    cache-validation signature: (rel, abs) pairs of every .py file
+    under `root` (restricted to `include_dirs` when given), pruning
+    __pycache__/.git and applying `exclude_globs`. Keeping a single
+    walk guarantees the signature covers exactly the files the index
+    parses."""
+    bases = ([os.path.join(root, d) for d in include_dirs]
+             if include_dirs else [root])
+    for base in bases:
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                abs_path = os.path.join(dirpath, name)
+                rel = os.path.relpath(abs_path, root).replace(os.sep, "/")
+                if any(fnmatch.fnmatch(rel, g) for g in exclude_globs):
+                    continue
+                yield rel, abs_path
+
+
+#: Process-wide index cache: (root, include, exclude) -> (signature,
+#: RepoIndex). The signature is every file's (path, mtime_ns, size);
+#: any change rebuilds. Saves re-parsing ~180 modules when the CLI and
+#: the tier-1 gate run the analyzer repeatedly in one process.
+_INDEX_CACHE: Dict[tuple, tuple] = {}
+
+
+def _tree_signature(root: str, include_dirs, exclude_globs) -> tuple:
+    sig = []
+    for rel, abs_path in iter_py_files(root, include_dirs, exclude_globs):
+        st = os.stat(abs_path)
+        sig.append((rel, st.st_mtime_ns, st.st_size))
+    return tuple(sig)
+
+
+def cached_index(root: str,
+                 include_dirs: Optional[Iterable[str]] = None,
+                 exclude_globs: Iterable[str] = ()) -> RepoIndex:
+    """`RepoIndex.from_root` behind an mtime/size-validated cache: the
+    parsed AST table (and the call graph hanging off it) is shared
+    across analyzer runs in one process, rebuilt the moment any indexed
+    file changes on disk."""
+    root = os.path.abspath(root)
+    include = tuple(include_dirs) if include_dirs else None
+    exclude = tuple(exclude_globs)
+    key = (root, include, exclude)
+    sig = _tree_signature(root, include, exclude)
+    cached = _INDEX_CACHE.get(key)
+    if cached is not None and cached[0] == sig:
+        return cached[1]
+    index = RepoIndex.from_root(root, include_dirs=include,
+                                exclude_globs=exclude)
+    _INDEX_CACHE[key] = (sig, index)
+    return index
 
 
 def finding(src: SourceFile, node_or_line, pass_id: str,

@@ -1,0 +1,206 @@
+"""Seeded interleaving explorer: perturb-many, not observe-one.
+
+The sanitizer (analysis/sanitizer.py) validates whatever single
+interleaving a test happens to execute. This module upgrades it: under
+``SWTPU_SANITIZE_EXPLORE=<seed>`` every ``SanitizedLock`` injects a
+*seeded* scheduling perturbation at its acquire/release boundaries —
+nothing, a bare scheduler yield (``sleep(0)``), or a short seeded
+sleep — so N seeds drive N different interleavings of the same
+critical sections, with the lock-order-cycle, ownership and hold-time
+checks evaluated on every schedule.
+
+Determinism contract (asserted by tests/test_explorer.py): the
+decision at a thread's k-th lock event is a pure function of
+``(seed, thread name, k)`` — it does NOT depend on what other threads
+do. Two runs of the same seeded workload therefore produce identical
+per-thread decision traces even though the OS schedules them
+differently, and the trace IS the reproduction recipe: replaying the
+seed replays the perturbation schedule exactly.
+
+Yield points fire only when BOTH the sanitizer and the explorer are
+enabled; production locks are never wrapped, so this module is inert
+outside explicitly-marked tests and the CI explorer smoke.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+ENV_VAR = "SWTPU_SANITIZE_EXPLORE"
+
+_M64 = (1 << 64) - 1
+
+#: Decision space: cumulative thresholds over the 64-bit hash.
+#: ~45% no perturbation, ~35% bare yield, ~20% short seeded sleep.
+_YIELD_AT = int(0.45 * _M64)
+_SLEEP_AT = int(0.80 * _M64)
+#: Seeded sleep range (seconds): long enough to genuinely reorder
+#: threads, short enough that a 20-seed smoke stays in tier-1 budget.
+_SLEEP_MIN_S = 0.00005
+_SLEEP_MAX_S = 0.0008
+
+ACTION_NONE = "-"
+ACTION_YIELD = "yield"
+ACTION_SLEEP = "sleep"
+
+
+def _fnv64(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & _M64
+    return h
+
+
+def _mix(seed: int, thread_hash: int, counter: int) -> int:
+    """splitmix64-style avalanche over (seed, thread, event counter)."""
+    x = (seed * 0x9E3779B97F4A7C15 + thread_hash * 0xBF58476D1CE4E5B9
+         + counter * 0x94D049BB133111EB) & _M64
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & _M64
+    x ^= x >> 27
+    x = (x * 0x94D049BB133111EB) & _M64
+    x ^= x >> 31
+    return x
+
+
+class InterleavingExplorer:
+    """One seeded exploration run (normally installed via `install`)."""
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        self._tls = threading.local()
+        self._mu = threading.Lock()
+        #: thread name -> [(counter, point, lock_name, action)]
+        self._traces: Dict[str, List[Tuple[int, str, str, str]]] = {}
+        self._events = 0
+        self._perturbations = 0
+
+    # -- decision core -------------------------------------------------
+
+    def _thread_state(self):
+        state = getattr(self._tls, "state", None)
+        if state is None:
+            name = threading.current_thread().name
+            state = self._tls.state = {
+                "name": name,
+                "hash": _fnv64(name.encode()),
+                "counter": 0,
+                "trace": [],
+            }
+            with self._mu:
+                self._traces[name] = state["trace"]
+        return state
+
+    def decide(self, point: str, lock_name: str) -> Tuple[str, float]:
+        """The (action, sleep_s) for this thread's next lock event —
+        pure in (seed, thread name, per-thread counter)."""
+        state = self._thread_state()
+        counter = state["counter"]
+        state["counter"] = counter + 1
+        h = _mix(self.seed, state["hash"], counter)
+        if h < _YIELD_AT:
+            action, sleep_s = ACTION_NONE, 0.0
+        elif h < _SLEEP_AT:
+            action, sleep_s = ACTION_YIELD, 0.0
+        else:
+            frac = (h & 0xFFFF) / 0xFFFF
+            action = ACTION_SLEEP
+            sleep_s = _SLEEP_MIN_S + frac * (_SLEEP_MAX_S - _SLEEP_MIN_S)
+        state["trace"].append((counter, point, lock_name, action))
+        return action, sleep_s
+
+    def perturb(self, point: str, lock_name: str) -> None:
+        """Called by SanitizedLock at an acquire/release boundary."""
+        action, sleep_s = self.decide(point, lock_name)
+        with self._mu:
+            self._events += 1
+            if action != ACTION_NONE:
+                self._perturbations += 1
+        if action == ACTION_YIELD:
+            time.sleep(0)
+        elif action == ACTION_SLEEP:
+            time.sleep(sleep_s)
+
+    # -- reporting -----------------------------------------------------
+
+    def trace(self) -> Dict[str, List[Tuple[int, str, str, str]]]:
+        """Per-thread decision traces (copies)."""
+        with self._mu:
+            return {name: list(t) for name, t in self._traces.items()}
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"seed": self.seed, "events": self._events,
+                    "perturbations": self._perturbations,
+                    "threads": len(self._traces)}
+
+
+_active: Optional[InterleavingExplorer] = None
+_env_checked = False
+#: Serializes env installation so exactly ONE explorer instance ever
+#: results from a given environment (two bring-up threads racing
+#: install_from_env must not each build one — the loser's per-thread
+#: counters would reset mid-run and fork the schedule).
+_install_mu = threading.Lock()
+
+
+def install(seed: int) -> InterleavingExplorer:
+    """Activate exploration with `seed` (tests drive this directly;
+    the env var is the subprocess interface). Returns the explorer."""
+    global _active, _env_checked
+    _active = InterleavingExplorer(seed)
+    _env_checked = True
+    return _active
+
+
+def uninstall() -> None:
+    global _active, _env_checked
+    _active = None
+    _env_checked = True
+
+
+def active() -> Optional[InterleavingExplorer]:
+    return _active
+
+
+def install_from_env() -> Optional[InterleavingExplorer]:
+    """Install from ``SWTPU_SANITIZE_EXPLORE`` (once; later lock
+    creations reuse the installed explorer). A garbage value logs and
+    stays off rather than crashing every instrumented process.
+
+    Ordering matters: ``_env_checked`` flips True only AFTER
+    ``_active`` is assigned (install() does both in that order), so a
+    concurrently-starting thread either performs the (idempotent)
+    installation itself or observes the fully-installed explorer —
+    never a half-open window where its lock events are skipped without
+    consuming counter ticks, which would break seed replay."""
+    global _env_checked
+    if _env_checked:
+        return _active
+    with _install_mu:
+        if _env_checked:
+            return _active
+        raw = os.environ.get(ENV_VAR)
+        if raw is None or raw == "":
+            _env_checked = True
+            return None
+        try:
+            seed = int(raw)
+        except ValueError:
+            import logging
+            logging.getLogger("shockwave_tpu.analysis").warning(
+                "%s=%r is not an integer seed; interleaving exploration "
+                "stays off", ENV_VAR, raw)
+            _env_checked = True
+            return None
+        return install(seed)
+
+
+def on_lock_event(point: str, lock_name: str) -> None:
+    """SanitizedLock hook: perturb if an explorer is active (either
+    installed programmatically or via the environment)."""
+    explorer = _active if _env_checked else install_from_env()
+    if explorer is not None:
+        explorer.perturb(point, lock_name)

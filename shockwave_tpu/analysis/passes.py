@@ -1,4 +1,4 @@
-"""The six swtpu-check passes.
+"""The swtpu-check passes.
 
 Each pass is a function ``check_<name>(index, ...) -> List[Finding]``
 taking a ``core.RepoIndex``; scope/allowlist arguments default to the
@@ -21,6 +21,13 @@ a deliberately-broken module.
 | obs-discipline     | metric/span names are attribute references into       |
 |                    | ``obs/names.py`` (no inline literals); ``obs/`` takes |
 |                    | its clock by injection (``obs/clock.py`` only)        |
+| thread-roots       | every thread spawn (Thread/Timer/HTTP handler/gRPC    |
+|                    | callback dict) resolves to a function in the tree     |
+|                    | (analysis/threads.py)                                 |
+| race-detector      | every cross-thread field holds a consistent lockset   |
+|                    | or a documented registry verdict (analysis/races.py)  |
+| suppression-audit  | every inline ignore[] still matches a finding the     |
+|                    | named pass would otherwise report (runs last)         |
 """
 from __future__ import annotations
 
@@ -74,8 +81,10 @@ def check_lock_discipline(index: RepoIndex,
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             inner = (decorated_requires_lock(node)
                      or node.name in exempt_methods)
-            if src.suppressed(node.lineno, pass_id):
-                return
+            # No early return on a def-line suppression: the per-access
+            # path below consults it only when a finding would actually
+            # fire, so the suppression-audit can tell a load-bearing
+            # function-level ignore from a stale one.
             for child in node.body:
                 scan(src, protected, child, inner, node.lineno)
             return
@@ -600,8 +609,67 @@ def check_obs_discipline(index: RepoIndex,
 
 
 # ----------------------------------------------------------------------
+# 7. suppression-audit
+# ----------------------------------------------------------------------
+
+SUPPRESSION_AUDIT_ID = "suppression-audit"
+
+
+def check_suppression_audit(index: RepoIndex,
+                            ran_pass_ids: Optional[Iterable[str]] = None
+                            ) -> List[Finding]:
+    """Every inline ``swtpu-check: ignore[<pass-id>]`` must still be
+    load-bearing: if the named pass ran over the file and never matched
+    the suppression (no finding would fire on that line), the
+    suppression itself is a finding — stale exceptions are how
+    invariants rot invisibly. A suppression naming an unknown pass id
+    is flagged unconditionally (a typo'd id suppresses nothing and
+    documents a lie).
+
+    Must run AFTER the passes it audits (the CLI driver orders this);
+    only the passes in `ran_pass_ids` are audited, so a ``--select``
+    subset never misreports the others' suppressions as stale."""
+    ran = set(ran_pass_ids if ran_pass_ids is not None else ALL_PASSES)
+    findings: List[Finding] = []
+    for src in index.files:
+        for line in sorted(src.suppressions):
+            for pid in sorted(src.suppressions[line]):
+                if pid == SUPPRESSION_AUDIT_ID:
+                    continue  # the audit's own escape hatch
+                if pid not in ALL_PASSES:
+                    f = finding(src, line, SUPPRESSION_AUDIT_ID,
+                                f"suppression names unknown pass id "
+                                f"'{pid}' (typo? see --list)")
+                    if f is not None:
+                        findings.append(f)
+                elif (pid in ran
+                      and (line, pid) not in src.suppression_hits):
+                    f = finding(src, line, SUPPRESSION_AUDIT_ID,
+                                f"unused suppression: no [{pid}] "
+                                "finding would fire on this line — "
+                                "delete the stale ignore")
+                    if f is not None:
+                        findings.append(f)
+    return findings
+
+
+# ----------------------------------------------------------------------
 # Registry
 # ----------------------------------------------------------------------
+
+def _check_thread_roots(index: RepoIndex) -> List[Finding]:
+    """Every thread spawn (Thread/Timer/HTTP handler/gRPC callback)
+    resolves statically to a function in the tree."""
+    from .threads import check_thread_roots
+    return check_thread_roots(index)
+
+
+def _check_race_detector(index: RepoIndex) -> List[Finding]:
+    """Lockset race detection: cross-thread fields hold a consistent
+    lockset or carry a documented registry verdict."""
+    from .races import check_race_detector
+    return check_race_detector(index)
+
 
 ALL_PASSES = {
     "lock-discipline": check_lock_discipline,
@@ -610,4 +678,6 @@ ALL_PASSES = {
     "determinism": check_determinism,
     "exception-hygiene": check_exception_hygiene,
     "obs-discipline": check_obs_discipline,
+    "thread-roots": _check_thread_roots,
+    "race-detector": _check_race_detector,
 }

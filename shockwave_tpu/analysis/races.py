@@ -1,0 +1,310 @@
+"""Lockset race detector: whole-tree, no opt-in.
+
+For every class whose methods are reachable from the discovered thread
+roots (analysis/threads.py), every instance field is checked RacerD
+style: collect each ``self.<field>`` access with (a) the set of locks
+lexically held there (``with self._lock`` / ``with self._cv`` /
+``@requires_lock``), and (b) the set of thread roots that statically
+reach the enclosing method. A field accessed from two different roots
+— or from one *self-concurrent* root, a gRPC/HTTP handler pool — whose
+access-site locksets share no common lock, and written at least once
+outside ``__init__``, is a race finding.
+
+The verdict can be *documented* instead of lexically proven, through
+two class-body registries:
+
+- ``_LOCK_PROTECTED = frozenset({...})`` — the field is guarded by the
+  instance's own ``self._lock``/``self._cv``; the lock-discipline pass
+  enforces the lexical claim and the runtime sanitizer enforces
+  ``@requires_lock`` ownership dynamically.
+- ``_EXTERNALLY_SYNCHRONIZED = frozenset({...})`` — the field's
+  synchronization lives outside the class: the owning scheduler's lock
+  held at every call site, or single-thread confinement. The static
+  detector cannot see a caller's lock, so the declaration (with its
+  justifying comment) is the documented verdict; the runtime sanitizer
+  and the interleaving explorer are the checks that keep it honest.
+
+Registries are resolved hierarchy-wide (a field declared protected by
+``PhysicalScheduler`` covers accesses in ``Scheduler`` methods — the
+sim-mode instance is single-threaded, the physical subclass carries
+the locking story for both).
+
+Exemptions, each of which removes a whole class of false positives:
+
+- fields that ARE synchronization (locks, conditions, ``Event``,
+  ``queue.Queue``, ``threading.local`` — their own thread safety);
+- fields never written outside ``__init__`` (immutable configuration
+  and injected handles);
+- accesses inside ``__init__`` itself (the object has not escaped its
+  constructing thread);
+- methods no thread root reaches (construction helpers, dead code).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .core import (Finding, RepoIndex, SourceFile, decorated_requires_lock,
+                   finding, is_self_attr, literal_str_set)
+from .threads import (CALLBACK_ROOT_KWARGS, RPC_SERVE_FUNCS,
+                      SELF_CONCURRENT_KINDS, CallGraph, FuncKey,
+                      discover_thread_roots)
+
+PASS_ID = "race-detector"
+
+#: Class-body registry documenting externally synchronized fields.
+EXTERNAL_REGISTRY_NAME = "_EXTERNALLY_SYNCHRONIZED"
+LOCK_REGISTRY_NAME = "_LOCK_PROTECTED"
+
+#: Default lock attribute names honored even without a detected
+#: constructor assignment (mirrors the lock-discipline pass).
+DEFAULT_LOCK_ATTRS = frozenset({"_lock", "_cv"})
+
+#: Container-method calls that mutate the receiver in place: a call of
+#: one of these on a field counts as a WRITE to that field.
+MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "add", "pop", "popleft", "update", "clear",
+    "discard", "remove", "extend", "insert", "setdefault", "popitem",
+})
+
+#: Sync-field kinds that make a field exempt (thread-safe by type).
+#: Deliberately excludes deque: iterating one while another thread
+#: appends raises RuntimeError — a deque ring still needs a lock.
+SAFE_SYNC_KINDS = frozenset({"lock", "event", "queue", "tls"})
+
+
+@dataclass
+class Access:
+    field: str
+    write: bool
+    locks: FrozenSet[str]
+    src: SourceFile
+    line: int
+    func: FuncKey
+
+
+def _class_registry(graph: CallGraph, cls: str) -> Set[str]:
+    """Union of both registries over the class family (ancestors and
+    descendants): a declaration anywhere in the hierarchy documents the
+    field for every instance shape."""
+    family = set(graph.mro(cls))
+    for sub in graph.subclasses(cls):
+        family.add(sub)
+        family.update(graph.mro(sub))
+    out: Set[str] = set()
+    for name in family:
+        info = graph.classes.get(name)
+        if info is None:
+            continue
+        for stmt in info.node.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id in (LOCK_REGISTRY_NAME,
+                                               EXTERNAL_REGISTRY_NAME)):
+                declared = literal_str_set(stmt.value)
+                if declared:
+                    out |= declared
+    return out
+
+
+def _is_lock_attr(graph: CallGraph, cls: str, attr: str) -> bool:
+    if attr in DEFAULT_LOCK_ATTRS:
+        return True
+    for name in graph.mro(cls):
+        if graph.sync_fields.get((name, attr)) == "lock":
+            return True
+    return False
+
+
+def _collect_accesses(graph: CallGraph, fi) -> List[Access]:
+    """Field accesses of one method with lexical locksets; nested
+    function definitions are skipped (they are their own nodes and
+    their bodies run with their own — empty — lock context)."""
+    cls = fi.cls
+    out: List[Access] = []
+    base_locks: FrozenSet[str] = frozenset()
+    if decorated_requires_lock(fi.node):
+        base_locks = frozenset({graph.canonical_lock(cls, "_lock")})
+
+    def record(node: ast.Attribute, write: bool,
+               locks: FrozenSet[str]) -> None:
+        out.append(Access(node.attr, write, locks, fi.src, node.lineno,
+                          fi.key))
+
+    def scan(node: ast.AST, locks: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fi.node:
+            return  # separate node; analyzed on its own
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(locks)
+            for item in node.items:
+                expr = item.context_expr
+                if (is_self_attr(expr)
+                        and _is_lock_attr(graph, cls, expr.attr)):
+                    inner.add(graph.canonical_lock(cls, expr.attr))
+            for child in ast.iter_child_nodes(node):
+                scan(child, frozenset(inner))
+            return
+        if isinstance(node, ast.Lambda):
+            scan(node.body, frozenset())
+            return
+        # Mutator-method call on a field: self.f.append(x).
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute) and fn.attr in MUTATOR_METHODS
+                    and is_self_attr(fn.value)):
+                record(fn.value, True, locks)
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    scan(arg, locks)
+                return
+        # Subscript store/delete through a field: self.f[k] = v.
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target] if isinstance(node, ast.AugAssign)
+                       else node.targets if isinstance(node, ast.Delete)
+                       else [])
+            for target in targets:
+                for sub in ast.walk(target):
+                    if (isinstance(sub, ast.Subscript)
+                            and is_self_attr(sub.value)):
+                        record(sub.value, True, locks)
+        if isinstance(node, ast.Attribute) and is_self_attr(node):
+            record(node, isinstance(node.ctx, (ast.Store, ast.Del)), locks)
+            return
+        for child in ast.iter_child_nodes(node):
+            scan(child, locks)
+
+    for child in fi.node.body:
+        scan(child, base_locks)
+    return out
+
+
+def check_race_detector(index: RepoIndex,
+                        rpc_serve_funcs: Iterable[str] = RPC_SERVE_FUNCS,
+                        callback_kwargs: Iterable[str]
+                        = CALLBACK_ROOT_KWARGS) -> List[Finding]:
+    """Whole-tree lockset race detection (see module docstring)."""
+    graph = index.call_graph()
+    roots, _ = discover_thread_roots(index, rpc_serve_funcs,
+                                     callback_kwargs)
+    if not roots:
+        return []
+
+    # -- thread-entry -> reachable-methods map ------------------------
+    # Root identity is the ENTRY FUNCTION (+kind): two spawn sites of
+    # the same loop are one logical thread body.
+    root_reach: Dict[Tuple[str, str], Set[FuncKey]] = {}
+    for root in roots:
+        rid = (str(root.key), root.kind)
+        if rid not in root_reach:
+            root_reach[rid] = graph.reachable(root.key)
+
+    func_roots: Dict[FuncKey, Set[Tuple[str, str]]] = {}
+    for rid, reach in root_reach.items():
+        for key in reach:
+            func_roots.setdefault(key, set()).add(rid)
+
+    # -- analyzed class families --------------------------------------
+    touched_classes = {key.cls for key in func_roots if key.cls}
+    families: Set[str] = set()
+    for cls in touched_classes:
+        for name in graph.mro(cls):
+            families.add(name)
+        for name in graph.subclasses(cls):
+            families.add(name)
+    if not families:
+        return []
+
+    # -- the implicit main root: public surface of analyzed classes ---
+    # The driving thread (a script's main, a test) can call any public
+    # method; __init__ is excluded (pre-escape construction).
+    MAIN = ("<main>", "main")
+    for cls in sorted(families):
+        info = graph.classes[cls]
+        for mname, fi in info.methods.items():
+            if mname.startswith("_") or "." in mname:
+                continue
+            for key in graph.reachable(fi.key):
+                func_roots.setdefault(key, set()).add(MAIN)
+
+    # -- collect accesses per defining class --------------------------
+    per_class: Dict[str, List[Access]] = {}
+    for key, fi in graph.funcs.items():
+        if fi.cls is None or fi.cls not in families:
+            continue
+        if key.name == "__init__" or key.name.startswith("__init__.<locals>"):
+            continue
+        if key not in func_roots:
+            continue  # unreached: construction helper or dead code
+        per_class.setdefault(fi.cls, []).extend(_collect_accesses(graph, fi))
+
+    # -- merge up the hierarchy: accesses in base-class methods join
+    #    the most-derived analyzed family member's field table ---------
+    findings: List[Finding] = []
+    fields: Dict[Tuple[str, str], List[Access]] = {}
+    for cls in sorted(per_class):
+        # Anchor each class's accesses at the ROOT of its family so
+        # PhysicalScheduler + Scheduler share one table.
+        mro = graph.mro(cls)
+        anchor = mro[-1] if mro else cls
+        for access in per_class[cls]:
+            fields.setdefault((anchor, access.field), []).append(access)
+
+    registry_memo: Dict[str, Set[str]] = {}
+    for (anchor, field_name) in sorted(fields,
+                                       key=lambda k: (k[0], k[1])):
+        accesses = fields[(anchor, field_name)]
+        cls = accesses[0].func.cls or anchor
+        if anchor not in registry_memo:
+            registry_memo[anchor] = _class_registry(graph, anchor)
+        if field_name in registry_memo[anchor]:
+            continue  # documented verdict (lock-discipline enforces
+            # the _LOCK_PROTECTED half lexically)
+        if _sync_kind(graph, cls, field_name) in SAFE_SYNC_KINDS:
+            continue
+        if _is_lock_attr(graph, cls, field_name):
+            continue
+        rooted = [a for a in accesses if func_roots.get(a.func)]
+        if not rooted:
+            continue
+        writes = [a for a in rooted if a.write]
+        if not writes:
+            continue  # written only during construction: immutable
+        distinct: Set[Tuple[str, str]] = set()
+        for a in rooted:
+            distinct |= func_roots[a.func]
+        concurrent = (len(distinct) > 1
+                      or any(kind in SELF_CONCURRENT_KINDS
+                             for _, kind in distinct))
+        if not concurrent:
+            continue
+        common = frozenset.intersection(*[a.locks for a in rooted])
+        if common:
+            continue  # a consistent lockset covers every access
+        # Anchor the finding at the most actionable site: a lock-free
+        # write if any, else a lock-free read, else the first write.
+        bare_writes = [a for a in writes if not a.locks]
+        bare_reads = [a for a in rooted if not a.locks]
+        anchor_access = min(bare_writes or bare_reads or writes,
+                            key=lambda a: (a.src.rel, a.line))
+        root_names = sorted({entry for entry, _ in distinct})
+        f = finding(
+            anchor_access.src, anchor_access.line, PASS_ID,
+            f"field 'self.{field_name}' of {cls} is reachable from "
+            f"{len(distinct)} thread root(s) ({', '.join(root_names[:4])}"
+            f"{', ...' if len(root_names) > 4 else ''}) with no common "
+            "lock across its access sites: hold one lock at every "
+            "access, or document the verdict in _LOCK_PROTECTED / "
+            "_EXTERNALLY_SYNCHRONIZED")
+        if f is not None:
+            findings.append(f)
+    return findings
+
+
+def _sync_kind(graph: CallGraph, cls: str, attr: str) -> Optional[str]:
+    for name in graph.mro(cls):
+        kind = graph.sync_fields.get((name, attr))
+        if kind is not None:
+            return kind
+    return None

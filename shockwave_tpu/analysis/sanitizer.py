@@ -23,6 +23,12 @@ The tier-1 conftest turns it on for every ``runtime``/``recovery``/
 production the wrapper is never installed (``maybe_wrap`` returns the
 raw lock), so there is zero steady-state overhead.
 
+Under ``SWTPU_SANITIZE_EXPLORE=<seed>`` (analysis/explorer.py) every
+instrumented acquire/release additionally injects a seeded scheduling
+perturbation, so N seeds exercise N deterministic-by-seed
+interleavings of the same critical sections with all of the above
+checks evaluated on each.
+
 The wrapper deliberately implements the private RLock hooks
 (``_is_owned`` / ``_release_save`` / ``_acquire_restore``) so a
 ``threading.Condition`` built on it — the scheduler's ``self._cv`` —
@@ -35,6 +41,8 @@ import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Set
+
+from . import explorer
 
 
 def enabled() -> bool:
@@ -200,6 +208,9 @@ class SanitizedLock:
             # trylock still records the ordering fact, which is what
             # the discipline is about.
             _monitor.note_waiting(self.name)
+            # Seeded interleaving exploration: perturb WHICH thread
+            # wins the inner acquire (no-op unless installed).
+            explorer.on_lock_event("acquire", self.name)
         got = self._inner.acquire(blocking, timeout)
         if got:
             if outermost:
@@ -213,6 +224,8 @@ class SanitizedLock:
         self._local.depth = max(depth - 1, 0)
         if depth <= 1:
             self._on_outermost_release()
+            # Post-release perturbation: vary who enters next.
+            explorer.on_lock_event("release", self.name)
 
     def __enter__(self):
         self.acquire()
@@ -239,6 +252,7 @@ class SanitizedLock:
     def _acquire_restore(self, state) -> None:
         inner_state, depth = state
         _monitor.note_waiting(self.name)
+        explorer.on_lock_event("acquire", self.name)
         self._inner._acquire_restore(inner_state)
         self._on_outermost_acquire()
         self._local.depth = depth

@@ -1,0 +1,652 @@
+"""Whole-tree thread-root discovery and the static call graph.
+
+The concurrency passes need to know *which code runs on which thread*.
+This module walks the indexed tree once and answers two questions:
+
+1. **Where do threads start?** (`discover_thread_roots`) Every
+   ``threading.Thread(target=...)`` / ``threading.Timer(..., cb)``
+   spawn, every ``ThreadingHTTPServer`` request-handler class (its
+   ``do_*`` methods run on per-request threads), every gRPC servicer
+   callback (the dict handed to ``serve_scheduler``/``serve_worker`` —
+   each value runs on a server-pool thread), and every callable handed
+   to a component that invokes it from its own thread (the
+   ``health_fn``/``history_fn`` exporter callbacks, the HA
+   ``on_fenced`` hook). A spawn whose target the resolver cannot pin to
+   a function in the tree is itself a finding (pass ``thread-roots``):
+   code the race detector cannot see behind is an unchecked thread.
+
+2. **What does each thread reach?** (`CallGraph`) An AST-level
+   call graph over the indexed tree: ``self.m()`` resolves through the
+   class hierarchy, ``self.attr.m()`` and local-variable calls resolve
+   through constructor-assignment type inference
+   (``self.attr = ClassName(...)`` / ``ClassName.from_config(...)`` /
+   annotations), bare names resolve to local/nested/module functions.
+   Reachability from each discovered root gives the race detector its
+   thread-entry -> reachable-methods map.
+
+The resolver is deliberately modest: dynamic dispatch through unknown
+callables (e.g. the return value of ``fork.thaw``) is not followed.
+That keeps detached-twin rollouts — objects constructed *inside* a
+thread and never shared — out of the cross-thread state, which is the
+behavior a lockset analysis wants.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, RepoIndex, SourceFile, call_name, finding
+
+#: Spawn call sites whose argument is a new thread's entry point.
+THREAD_SPAWN_CALLS = frozenset({"threading.Thread", "Thread"})
+TIMER_SPAWN_CALLS = frozenset({"threading.Timer", "Timer"})
+HTTP_SERVER_CALLS = frozenset({"ThreadingHTTPServer",
+                               "http.server.ThreadingHTTPServer"})
+#: Server constructors taking a {rpc-name: callable} dict: every value
+#: runs on a gRPC server-pool thread (concurrently with itself).
+RPC_SERVE_FUNCS = frozenset({"serve_scheduler", "serve_worker"})
+#: Keyword arguments that hand a callable to a component which invokes
+#: it from its own thread (exporter request threads, the HA renewal
+#: thread). Kept small and explicit: each entry is a real cross-thread
+#: contract in this tree.
+CALLBACK_ROOT_KWARGS = frozenset({"health_fn", "history_fn", "on_fenced"})
+
+#: Roots of these kinds run CONCURRENTLY WITH THEMSELVES (thread pools:
+#: one root, many threads), so a single such root is already a race
+#: surface on its own.
+SELF_CONCURRENT_KINDS = frozenset({"rpc-handler", "http-handler",
+                                   "callback"})
+
+
+# ----------------------------------------------------------------------
+# Graph nodes
+# ----------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class FuncKey:
+    """One function node: a method ((class, name)), a nested function
+    ((class, 'method.<locals>.fn')), or a module-level function
+    ((None, 'module.py:fn'))."""
+    cls: Optional[str]
+    name: str
+
+    def __str__(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+@dataclass
+class FuncInfo:
+    key: FuncKey
+    node: ast.AST            # FunctionDef / AsyncFunctionDef
+    src: SourceFile
+    #: Defining class (None for module functions); the class whose
+    #: fields `self.X` refers to inside this function.
+    cls: Optional[str] = None
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    node: ast.ClassDef
+    src: SourceFile
+    bases: List[str] = field(default_factory=list)
+    methods: Dict[str, FuncInfo] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class ThreadRoot:
+    """One discovered thread entry point."""
+    key: FuncKey
+    kind: str                # thread | timer | rpc-handler | http-handler | callback
+    src_rel: str
+    line: int
+
+    @property
+    def label(self) -> str:
+        return f"{self.kind}:{self.key}@{self.src_rel}:{self.line}"
+
+
+# ----------------------------------------------------------------------
+# Small AST helpers
+# ----------------------------------------------------------------------
+
+def _base_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _annotation_classes(node: ast.AST, known: Set[str]) -> Set[str]:
+    """Class names appearing anywhere inside an annotation expression
+    (handles Optional[X], "X" string annotations, Dict[_, X])."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in known:
+            out.add(sub.id)
+        elif (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+              and sub.value in known):
+            out.add(sub.value)
+    return out
+
+
+class CallGraph:
+    """Classes, attribute types, and call resolution over one index.
+
+    Built once per analyzer run (``RepoIndex.call_graph()`` memoizes)
+    and shared by the thread-roots and race-detector passes.
+    """
+
+    def __init__(self, index: RepoIndex):
+        self.index = index
+        self.classes: Dict[str, ClassInfo] = {}
+        #: Module functions: (src.rel, name) -> FuncInfo, plus nested
+        #: functions keyed by their FuncKey.
+        self.module_funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        self.funcs: Dict[FuncKey, FuncInfo] = {}
+        #: (class, attr) -> possible class names of the attribute.
+        self.attr_types: Dict[Tuple[str, str], Set[str]] = {}
+        #: (class, attr) -> True for fields holding locks/queues/events
+        #: (their own synchronization).
+        self.sync_fields: Dict[Tuple[str, str], str] = {}
+        #: Per-class lock aliasing: attr -> canonical lock attr (e.g.
+        #: `_cv = threading.Condition(self._lock)` makes _cv ≡ _lock).
+        self.lock_alias: Dict[Tuple[str, str], str] = {}
+        self._reach_memo: Dict[FuncKey, Set[FuncKey]] = {}
+        self._callee_memo: Dict[FuncKey, Set[FuncKey]] = {}
+        self._local_types_memo: Dict[FuncKey, Dict[str, Set[str]]] = {}
+        self._nested_memo: Dict[FuncKey, Dict[str, FuncKey]] = {}
+        self._local_assigns_memo: Dict[FuncKey, Dict[str, list]] = {}
+        self._build()
+
+    # -- construction --------------------------------------------------
+
+    def _build(self) -> None:
+        for src in self.index.files:
+            self._collect_defs(src)
+        known = set(self.classes)
+        for info in self.classes.values():
+            self._infer_attr_types(info, known)
+
+    def _collect_defs(self, src: SourceFile) -> None:
+        def visit(node: ast.AST, cls: Optional[ClassInfo],
+                  prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    info = ClassInfo(child.name, child, src,
+                                     bases=[b for b in
+                                            (_base_name(x)
+                                             for x in child.bases)
+                                            if b])
+                    # First definition wins on a tree-wide name clash
+                    # (rare; fixture classes are scanned separately).
+                    self.classes.setdefault(child.name, info)
+                    visit(child, info, "")
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    name = prefix + child.name
+                    if cls is not None:
+                        key = FuncKey(cls.name, name)
+                        fi = FuncInfo(key, child, src, cls=cls.name)
+                        cls.methods.setdefault(name, fi)
+                    else:
+                        key = FuncKey(None, f"{src.rel}:{name}")
+                        fi = FuncInfo(key, child, src)
+                        self.module_funcs.setdefault((src.rel, child.name
+                                                      if not prefix
+                                                      else name), fi)
+                    self.funcs.setdefault(key, fi)
+                    visit(child, cls, name + ".<locals>.")
+                else:
+                    visit(child, cls, prefix)
+
+        visit(src.tree, None, "")
+
+    _SYNC_CONSTRUCTORS = {
+        "threading.Lock": "lock", "threading.RLock": "lock",
+        "threading.Condition": "lock", "maybe_wrap": "lock",
+        "sanitizer.maybe_wrap": "lock",
+        "threading.Event": "event", "threading.local": "tls",
+        "queue.Queue": "queue", "queue.SimpleQueue": "queue",
+        "collections.deque": "deque",
+    }
+
+    def _infer_attr_types(self, info: ClassInfo, known: Set[str]) -> None:
+        for fi in info.methods.values():
+            for node in ast.walk(fi.node):
+                target = None
+                value = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    target, value = node.target, node.value
+                    if (isinstance(target, ast.Attribute)
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == "self"):
+                        for cname in _annotation_classes(node.annotation,
+                                                         known):
+                            self.attr_types.setdefault(
+                                (info.name, target.attr), set()).add(cname)
+                if (target is None or not isinstance(target, ast.Attribute)
+                        or not isinstance(target.value, ast.Name)
+                        or target.value.id != "self"):
+                    continue
+                attr = target.attr
+                if not isinstance(value, ast.Call):
+                    continue
+                name = call_name(value)
+                kind = self._SYNC_CONSTRUCTORS.get(
+                    name) or self._SYNC_CONSTRUCTORS.get(
+                    name.rsplit(".", 1)[-1] if "." in name else name)
+                if kind is not None:
+                    self.sync_fields[(info.name, attr)] = kind
+                    if name.rsplit(".", 1)[-1] == "Condition" and value.args:
+                        inner = value.args[0]
+                        if (isinstance(inner, ast.Attribute)
+                                and isinstance(inner.value, ast.Name)
+                                and inner.value.id == "self"):
+                            self.lock_alias[(info.name, attr)] = inner.attr
+                    if name.rsplit(".", 1)[-1] == "maybe_wrap":
+                        continue  # wrapped lock: type stays "lock"
+                    continue
+                # Constructor / classmethod-constructor type inference.
+                head = name.split(".", 1)[0]
+                tail = name.rsplit(".", 1)[0] if "." in name else name
+                for candidate in (name, tail, head):
+                    if candidate in known:
+                        self.attr_types.setdefault(
+                            (info.name, attr), set()).add(candidate)
+                        break
+
+    # -- class hierarchy ----------------------------------------------
+
+    def mro(self, cls: str) -> List[str]:
+        """The class plus its indexed ancestors (linearized, cycles
+        guarded)."""
+        out, frontier, seen = [], [cls], set()
+        while frontier:
+            name = frontier.pop(0)
+            if name in seen or name not in self.classes:
+                continue
+            seen.add(name)
+            out.append(name)
+            frontier.extend(self.classes[name].bases)
+        return out
+
+    def subclasses(self, cls: str) -> List[str]:
+        return sorted(name for name, info in self.classes.items()
+                      if cls in self.mro(name) and name != cls)
+
+    def lookup_method(self, cls: str, method: str) -> Optional[FuncInfo]:
+        for name in self.mro(cls):
+            fi = self.classes[name].methods.get(method)
+            if fi is not None:
+                return fi
+        return None
+
+    def attr_classes(self, cls: str, attr: str) -> Set[str]:
+        out: Set[str] = set()
+        for name in self.mro(cls):
+            out |= self.attr_types.get((name, attr), set())
+        return out
+
+    def is_sync_field(self, cls: str, attr: str) -> bool:
+        return any((name, attr) in self.sync_fields
+                   for name in self.mro(cls))
+
+    def canonical_lock(self, cls: str, attr: str) -> str:
+        for name in self.mro(cls):
+            alias = self.lock_alias.get((name, attr))
+            if alias is not None:
+                return alias
+        return attr
+
+    # -- call resolution ----------------------------------------------
+
+    def _local_types(self, fi: FuncInfo) -> Dict[str, Set[str]]:
+        """var name -> possible classes, from constructor assignments
+        and `var = self.attr` aliases inside one function."""
+        memo = self._local_types_memo.get(fi.key)
+        if memo is not None:
+            return memo
+        out: Dict[str, Set[str]] = {}
+        known = set(self.classes)
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            var = node.targets[0].id
+            value = node.value
+            if isinstance(value, ast.Call):
+                name = call_name(value)
+                for candidate in (name,
+                                  name.rsplit(".", 1)[0] if "." in name
+                                  else name,
+                                  name.split(".", 1)[0]):
+                    if candidate in known:
+                        out.setdefault(var, set()).add(candidate)
+                        break
+            elif (isinstance(value, ast.Name) and value.id == "self"
+                    and fi.cls is not None):
+                out.setdefault(var, set()).add(fi.cls)
+            elif (isinstance(value, ast.Attribute)
+                    and isinstance(value.value, ast.Name)
+                    and value.value.id == "self" and fi.cls is not None):
+                for cname in sorted(self.attr_classes(fi.cls, value.attr)):
+                    out.setdefault(var, set()).add(cname)
+        self._local_types_memo[fi.key] = out
+        return out
+
+    def _nested_funcs(self, fi: FuncInfo) -> Dict[str, FuncKey]:
+        """Immediate nested function defs of `fi` by bare name."""
+        memo = self._nested_memo.get(fi.key)
+        if memo is not None:
+            return memo
+        out: Dict[str, FuncKey] = {}
+        base = (fi.key.name if fi.cls is not None
+                else fi.key.name.split(":", 1)[1])
+        for child in ast.walk(fi.node):
+            if (isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and child is not fi.node):
+                nested_name = f"{base}.<locals>.{child.name}"
+                if fi.cls is not None:
+                    key = FuncKey(fi.cls, nested_name)
+                else:
+                    key = FuncKey(None, f"{fi.src.rel}:{nested_name}")
+                if key in self.funcs:
+                    out.setdefault(child.name, key)
+        self._nested_memo[fi.key] = out
+        return out
+
+    def resolve_callable(self, expr: ast.AST, fi: FuncInfo,
+                         local_types: Optional[Dict[str, Set[str]]] = None,
+                         _depth: int = 0) -> List[FuncKey]:
+        """Function nodes a callable expression may refer to (empty =
+        unresolvable). Used for call edges AND thread-spawn targets."""
+        if _depth > 4:
+            return []
+        if local_types is None:
+            local_types = self._local_types(fi)
+        nested = self._nested_funcs(fi)
+        # Conditional callback: `fn if cond else None` resolves to the
+        # union of its resolvable branches (a literal-None branch is
+        # "no callback", not an opaque target).
+        if isinstance(expr, ast.IfExp):
+            out = []
+            for branch in (expr.body, expr.orelse):
+                if isinstance(branch, ast.Constant) and branch.value is None:
+                    continue
+                out.extend(self.resolve_callable(branch, fi, local_types,
+                                                 _depth + 1))
+            return out
+        # self.m / self.attr.m
+        if isinstance(expr, ast.Attribute):
+            holder = expr.value
+            method = expr.attr
+            if isinstance(holder, ast.Name):
+                if holder.id == "self" and fi.cls is not None:
+                    target = self.lookup_method(fi.cls, method)
+                    return [target.key] if target else []
+                classes: Set[str] = set()
+                if holder.id in self.classes:   # ClassName.m
+                    classes.add(holder.id)
+                classes |= local_types.get(holder.id, set())
+                out = []
+                for cname in sorted(classes):
+                    target = self.lookup_method(cname, method)
+                    if target is not None:
+                        out.append(target.key)
+                return out
+            if (isinstance(holder, ast.Attribute)
+                    and isinstance(holder.value, ast.Name)
+                    and holder.value.id == "self" and fi.cls is not None):
+                out = []
+                for cname in sorted(self.attr_classes(fi.cls, holder.attr)):
+                    target = self.lookup_method(cname, method)
+                    if target is not None:
+                        out.append(target.key)
+                return out
+            return []
+        if isinstance(expr, ast.Name):
+            if expr.id in nested:
+                return [nested[expr.id]]
+            mf = self.module_funcs.get((fi.src.rel, expr.id))
+            if mf is not None:
+                return [mf.key]
+            # A bare name bound to a class: calling it constructs; the
+            # interesting entry for reachability is __init__.
+            if expr.id in self.classes:
+                target = self.lookup_method(expr.id, "__init__")
+                return [target.key] if target else []
+            # Local callable alias: `cb = self._kill_job` (possibly on
+            # several branches) then Timer(..., cb) — union over every
+            # assignment the name receives in this function.
+            out = []
+            for value in self._local_assigns(fi).get(expr.id, ()):
+                if not isinstance(value, ast.Name):
+                    out.extend(self.resolve_callable(value, fi,
+                                                     local_types,
+                                                     _depth + 1))
+            return out
+        return []
+
+    def _local_assigns(self, fi: FuncInfo) -> Dict[str, list]:
+        """var name -> every value expression assigned to it in `fi`
+        (one walk, memoized)."""
+        memo = self._local_assigns_memo.get(fi.key)
+        if memo is not None:
+            return memo
+        out: Dict[str, list] = {}
+        for node in ast.walk(fi.node):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                out.setdefault(node.targets[0].id, []).append(node.value)
+        self._local_assigns_memo[fi.key] = out
+        return out
+
+    def callees(self, key: FuncKey) -> Set[FuncKey]:
+        memo = self._callee_memo.get(key)
+        if memo is not None:
+            return memo
+        fi = self.funcs.get(key)
+        if fi is None:
+            return set()
+        local_types = self._local_types(fi)
+        out: Set[FuncKey] = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                for target in self.resolve_callable(node.func, fi,
+                                                    local_types):
+                    out.add(target)
+        self._callee_memo[key] = out
+        return out
+
+    def reachable(self, key: FuncKey) -> Set[FuncKey]:
+        """All function nodes reachable from `key` (inclusive)."""
+        if key in self._reach_memo:
+            return self._reach_memo[key]
+        seen: Set[FuncKey] = set()
+        frontier = [key]
+        while frontier:
+            cur = frontier.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            frontier.extend(self.callees(cur))
+        self._reach_memo[key] = seen
+        return seen
+
+
+# ----------------------------------------------------------------------
+# Thread-root discovery
+# ----------------------------------------------------------------------
+
+def _spawn_target(node: ast.Call, kw: str, pos: int) -> Optional[ast.AST]:
+    for k in node.keywords:
+        if k.arg == kw:
+            return k.value
+    if len(node.args) > pos:
+        return node.args[pos]
+    return None
+
+
+def _resolve_dict_literal(expr: ast.AST, fi: FuncInfo,
+                          graph: CallGraph) -> Optional[ast.Dict]:
+    if isinstance(expr, ast.Dict):
+        return expr
+    if isinstance(expr, ast.Name):
+        for node in ast.walk(fi.node):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == expr.id
+                    and isinstance(node.value, ast.Dict)):
+                return node.value
+    return None
+
+
+def discover_thread_roots(index: RepoIndex,
+                          rpc_serve_funcs: Iterable[str] = RPC_SERVE_FUNCS,
+                          callback_kwargs: Iterable[str]
+                          = CALLBACK_ROOT_KWARGS,
+                          ) -> Tuple[List[ThreadRoot], List[Finding]]:
+    """Walk the tree for thread entry points. Returns (roots, findings);
+    a finding is a spawn whose target could not be resolved to a
+    function in the indexed tree."""
+    pass_id = "thread-roots"
+    graph = index.call_graph()
+    rpc_serve_funcs = frozenset(rpc_serve_funcs)
+    callback_kwargs = frozenset(callback_kwargs)
+    # One discovery per analyzer run: the thread-roots pass and the
+    # race detector both call this with identical inputs. The memo
+    # lives on the index and is cleared by reset_suppression_hits (a
+    # new run must re-consult suppressions, or the audit would flag
+    # the load-bearing thread-roots ignores as stale).
+    memo = getattr(index, "_thread_roots_memo", None)
+    if memo is None:
+        memo = index._thread_roots_memo = {}
+    memo_key = (rpc_serve_funcs, callback_kwargs)
+    if memo_key in memo:
+        return memo[memo_key]
+    roots: List[ThreadRoot] = []
+    findings: List[Finding] = []
+    seen: Set[Tuple[FuncKey, str]] = set()
+
+    def add_root(key: FuncKey, kind: str, src: SourceFile,
+                 line: int) -> None:
+        if (key, kind) in seen:
+            return
+        seen.add((key, kind))
+        roots.append(ThreadRoot(key, kind, src.rel, line))
+
+    def unresolved(src: SourceFile, node: ast.AST, what: str) -> None:
+        f = finding(src, node, pass_id,
+                    f"{what} cannot be statically resolved to a "
+                    "function in the tree: the race detector cannot "
+                    "see behind this thread entry (name the target "
+                    "directly, or suppress with a justification)")
+        if f is not None:
+            findings.append(f)
+
+    def resolve_or_flag(expr: ast.AST, fi: FuncInfo, kind: str,
+                        src: SourceFile, node: ast.AST,
+                        what: str) -> None:
+        targets = graph.resolve_callable(expr, fi)
+        if not targets:
+            unresolved(src, node, what)
+            return
+        for key in targets:
+            add_root(key, kind, src, node.lineno)
+
+    def handle_call(node: ast.Call, fi: FuncInfo,
+                    src: SourceFile) -> None:
+            name = call_name(node)
+            tail = name.rsplit(".", 1)[-1] if "." in name else name
+            if name in THREAD_SPAWN_CALLS:
+                target = _spawn_target(node, "target", 1)
+                if target is None:
+                    # Thread() with no target runs an overridden run();
+                    # not used in this tree — flag so it can't hide.
+                    unresolved(src, node, "threading.Thread with no "
+                                          "resolvable target")
+                else:
+                    resolve_or_flag(target, fi, "thread", src, node,
+                                    "threading.Thread target")
+            elif name in TIMER_SPAWN_CALLS:
+                target = _spawn_target(node, "function", 1)
+                if target is None:
+                    unresolved(src, node, "threading.Timer callback")
+                else:
+                    resolve_or_flag(target, fi, "timer", src, node,
+                                    "threading.Timer callback")
+            elif tail == "ThreadingHTTPServer":
+                if len(node.args) >= 2:
+                    handler = node.args[1]
+                    cname = handler.id if isinstance(handler, ast.Name) \
+                        else None
+                    info = graph.classes.get(cname) if cname else None
+                    if info is None:
+                        unresolved(src, node,
+                                   "ThreadingHTTPServer handler class")
+                    else:
+                        for mname in sorted(info.methods):
+                            if mname.startswith("do_"):
+                                add_root(info.methods[mname].key,
+                                         "http-handler", src, node.lineno)
+            elif tail in rpc_serve_funcs:
+                for arg in list(node.args) + [k.value for k in
+                                              node.keywords
+                                              if k.arg not in
+                                              callback_kwargs]:
+                    d = _resolve_dict_literal(arg, fi, graph)
+                    if d is None:
+                        continue
+                    for value in d.values:
+                        resolve_or_flag(value, fi, "rpc-handler", src,
+                                        node, "gRPC servicer callback")
+            for k in node.keywords:
+                if k.arg in callback_kwargs:
+                    resolve_or_flag(k.value, fi, "callback", src, node,
+                                    f"{k.arg}= callback")
+
+    for key in sorted(graph.funcs, key=lambda k: (k.cls or "", k.name)):
+        fi = graph.funcs[key]
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                handle_call(node, fi, fi.src)
+
+    # Module-level statements spawn threads too (driver scripts,
+    # `if __name__` blocks): scan top-level code with a per-module
+    # pseudo-function context so local vars / module functions resolve.
+    # Function/class bodies are skipped — they were handled above.
+    for src in index.files:
+        module_fi = FuncInfo(FuncKey(None, f"{src.rel}:<module>"),
+                             src.tree, src)
+        stack = list(src.tree.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(node, ast.Call):
+                handle_call(node, module_fi, src)
+            stack.extend(ast.iter_child_nodes(node))
+
+    roots.sort(key=lambda r: (r.src_rel, r.line, r.kind, str(r.key)))
+    memo[memo_key] = (roots, findings)
+    return roots, findings
+
+
+def check_thread_roots(index: RepoIndex,
+                       rpc_serve_funcs: Iterable[str] = RPC_SERVE_FUNCS,
+                       callback_kwargs: Iterable[str]
+                       = CALLBACK_ROOT_KWARGS) -> List[Finding]:
+    """Pass entry point: every thread spawn in the tree must have a
+    statically resolvable entry function — an opaque target is a thread
+    the race detector cannot check, which is how unchecked concurrency
+    sneaks in."""
+    _, findings = discover_thread_roots(index, rpc_serve_funcs,
+                                        callback_kwargs)
+    return findings

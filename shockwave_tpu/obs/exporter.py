@@ -76,7 +76,10 @@ class ObsHttpServer:
 
         self._httpd = ThreadingHTTPServer((addr, port), _Handler)
         self._httpd.daemon_threads = True
-        self._thread = threading.Thread(
+        # Stdlib accept loop: request handling enters the tree through
+        # _Handler.do_GET, which the thread-roots pass discovers via
+        # the ThreadingHTTPServer constructor above.
+        self._thread = threading.Thread(  # swtpu-check: ignore[thread-roots]
             target=self._httpd.serve_forever, name="swtpu-obs-http",
             daemon=True)
         self._started = False

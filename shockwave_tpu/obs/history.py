@@ -37,6 +37,7 @@ import threading
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..core.locking import requires_lock
 from . import names
 from .clock import Clock
 from .registry import MetricsRegistry
@@ -74,6 +75,16 @@ def _median(values: List[float]) -> float:
 class TelemetryHistory:
     """Bounded, durable telemetry rings over one MetricsRegistry."""
 
+    #: Ring state shared between the scheduler round loop (sample/
+    #: record under the scheduler lock), the exporter's request thread
+    #: (/history.json serializes `payload`) and the done-callback gRPC
+    #: threads (`record_observation`) — guarded by the history's own
+    #: leaf lock; enforced by the lock-discipline pass and checked
+    #: cross-thread by the race detector.
+    _LOCK_PROTECTED = frozenset({
+        "_rounds", "_observations", "_alerts", "_samples_since_flush",
+    })
+
     def __init__(self, registry: MetricsRegistry, clock: Clock,
                  path: str,
                  time_per_iteration: Optional[float] = None,
@@ -95,7 +106,8 @@ class TelemetryHistory:
         from ..analysis.sanitizer import maybe_wrap
         self._lock = maybe_wrap(threading.Lock(),
                                 "TelemetryHistory._lock")
-        self._load()
+        with self._lock:
+            self._load()
 
     @classmethod
     def from_config(cls, cfg: Optional[dict], registry, clock, path,
@@ -114,6 +126,7 @@ class TelemetryHistory:
 
     # -- durability -----------------------------------------------------
 
+    @requires_lock
     def _load(self) -> None:
         """Seed the rings from a previous incarnation's flush (crash
         recovery / HA takeover); a missing, foreign, future-schema or
@@ -205,6 +218,7 @@ class TelemetryHistory:
 
     # -- checks ---------------------------------------------------------
 
+    @requires_lock
     def _metric_delta(self, series_key: str, window: int) -> float:
         """Counter increase of `series_key` over the last `window`
         round samples (0.0 with insufficient history)."""
@@ -215,6 +229,7 @@ class TelemetryHistory:
         last = recent[-1]["metrics"].get(series_key, 0.0)
         return max(last - first, 0.0)
 
+    @requires_lock
     def _compute_checks_locked(self) -> Dict[str, int]:
         """All check verdicts; caller holds self._lock (the checks read
         the rings) and publishes the gauges outside it."""
@@ -224,12 +239,14 @@ class TelemetryHistory:
             CHECK_THROUGHPUT_REGRESSION: self._check_regression(),
         }
 
+    @requires_lock
     def _check_round_overrun(self) -> int:
         if self._time_per_iteration is None or len(self._rounds) < 2:
             return 0
         wall = self._rounds[-1]["t"] - self._rounds[-2]["t"]
         return int(wall > ROUND_OVERRUN_FACTOR * self._time_per_iteration)
 
+    @requires_lock
     def _check_dispatch_burn(self) -> int:
         window = DISPATCH_BURN_WINDOW_ROUNDS
         bad = (self._metric_delta(
@@ -240,6 +257,7 @@ class TelemetryHistory:
         total = ok + bad
         return int(total > 0 and bad / total > DISPATCH_BURN_RATIO)
 
+    @requires_lock
     def _check_regression(self) -> int:
         by_key: Dict[tuple, List[float]] = {}
         for rnd, job_type, bs, sf, wt, rate in self._observations:

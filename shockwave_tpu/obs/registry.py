@@ -27,6 +27,7 @@ import threading
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
+from ..core.locking import requires_lock
 from .clock import Clock, wall_clock
 from .names import MetricSpec
 
@@ -87,6 +88,7 @@ class MetricsRegistry:
 
     # -- spec/label plumbing -------------------------------------------
 
+    @requires_lock
     def _resolve(self, spec: MetricSpec, kind: str,
                  labels: dict) -> Tuple[str, Tuple[str, ...]]:
         """Validate kind/labels and return (name, label-value key).

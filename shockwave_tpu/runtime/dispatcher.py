@@ -63,7 +63,6 @@ class Dispatcher:
             self._chip_queue.put(chip_id)
         self._lock = threading.Lock()
         self._processes: Dict[int, subprocess.Popen] = {}  # job_id -> proc
-        self._pool = []
         self._shutdown = threading.Event()
         # RunJob is delivered at-least-once (the scheduler retries on
         # UNAVAILABLE, which gRPC can return even after the handler ran):
@@ -156,12 +155,14 @@ class Dispatcher:
             for old in [k for k, r in self._accepted_dispatches.items()
                         if r < round_id - 2]:
                 del self._accepted_dispatches[old]
-        thread = threading.Thread(
+        # Daemon thread, deliberately unreferenced: nothing ever joined
+        # the old `_pool` list, so keeping thread handles was dead state
+        # mutated concurrently by RunJob handlers (race-detector
+        # finding) — removed rather than locked.
+        threading.Thread(
             target=self._dispatch_jobs_helper,
             args=(jobs, worker_id, round_id, trace_parent),
-            daemon=True)
-        self._pool.append(thread)
-        thread.start()
+            daemon=True).start()
 
     def _dispatch_jobs_helper(self, jobs: List[dict], worker_id: int,
                               round_id: int, trace_parent=None):

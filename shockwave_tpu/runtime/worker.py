@@ -40,11 +40,18 @@ def detect_num_chips() -> int:
 
 
 class WorkerDaemon:
+    #: Written by RunJob handlers (gRPC pool threads), read by the obs
+    #: exporter's request thread (/healthz) — guarded by the daemon's
+    #: leaf lock. Surfaced by the race-detector pass.
+    _LOCK_PROTECTED = frozenset({"_last_dispatch_time"})
+
     def __init__(self, worker_type: str, sched_addr: str, sched_port: int,
                  worker_port: int, num_chips: int, run_dirs: dict,
                  data_dir: str, checkpoint_dir: str,
                  obs_port: int = None, trace_dir: str = None):
+        from ..analysis.sanitizer import maybe_wrap
         self._shutdown_event = threading.Event()
+        self._lock = maybe_wrap(threading.Lock(), "WorkerDaemon._lock")
         self._obs = get_observability()
         self._obs_server = None
         if obs_port is not None:
@@ -131,23 +138,26 @@ class WorkerDaemon:
         self._rpc_client.refresh_endpoint()
 
     def _obs_health(self) -> dict:
+        with self._lock:
+            last_dispatch = self._last_dispatch_time
         return {
             "worker_type": self._worker_type,
             "worker_ids": list(getattr(self, "_worker_ids", [])),
             "leader_epoch_seen": self._fence.epoch,
             "last_dispatch_age_s": round(
-                time.time() - self._last_dispatch_time, 3)
-            if self._last_dispatch_time else None,
+                time.time() - last_dispatch, 3)
+            if last_dispatch else None,
         }
 
     def _run_job(self, jobs, worker_id, round_id, trace=None):
         # Worker-side dispatch heartbeat: a daemon that stops receiving
         # RunJobs (partitioned, or starved by the scheduler) shows up as
         # a growing age on this stamp.
-        self._last_dispatch_time = time.time()
+        now = time.time()
+        with self._lock:
+            self._last_dispatch_time = now
         self._obs.inc(obs_names.WORKER_JOBS_DISPATCHED_TOTAL)
-        self._obs.set_gauge(obs_names.WORKER_LAST_DISPATCH_TIMESTAMP,
-                            self._last_dispatch_time)
+        self._obs.set_gauge(obs_names.WORKER_LAST_DISPATCH_TIMESTAMP, now)
         parent, send_ts = trace if trace is not None else (None, None)
         if self._span_shard is not None:
             # The runjob span records this host's RECEIVE stamp beside

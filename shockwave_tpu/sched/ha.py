@@ -347,6 +347,13 @@ class HotStandby:
     the follower falls behind compaction.
     """
 
+    #: All standby state is written by the single standby main thread
+    #: (poll/promote loop); the obs exporter's request thread reads
+    #: `follower` through `health()` — an advisory telemetry read of an
+    #: atomically rebound reference (worst case: one stale /healthz
+    #: sample during a twin rebuild). Documented for the race detector.
+    _EXTERNALLY_SYNCHRONIZED = frozenset({"follower", "twin"})
+
     def __init__(self, state_dir: str, cfg: HAConfig,
                  twin_factory: Optional[Callable[[], object]] = None,
                  obs=None, clock=time.time):

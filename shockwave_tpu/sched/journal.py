@@ -633,7 +633,10 @@ class DurabilityLayer:
 
     @property
     def last_seq(self) -> int:
-        return self._seq
+        # Read under the lock: /healthz probes this from the exporter's
+        # request thread while gRPC handlers append (race-detector).
+        with self._lock:
+            return self._seq
 
     @property
     def epoch(self) -> Optional[int]:

@@ -249,6 +249,31 @@ class Scheduler:
     """The scheduling core. Construct with a policy, then either call
     `simulate(...)` or drive it with worker callbacks (physical mode)."""
 
+    #: Documented for the race detector (analysis/races.py):
+    #: `_current_timestamp` is the simulator's virtual clock, advanced
+    #: only by the single-threaded sim event loop (the physical
+    #: subclass overrides get_current_timestamp with the wall clock and
+    #: never touches it); `_replaying` is flipped only during recovery/
+    #: journal replay, which runs before any worker thread exists (or
+    #: on a single-threaded standby twin); `_journal` is bound once by
+    #: attach_durability during construction (under the physical lock)
+    #: and read-only afterwards. The scheduling-core maps in the second
+    #: group are mutated by THESE base-class methods from add_job /
+    #: register_worker / round-loop paths whose physical callers all
+    #: hold PhysicalScheduler._lock (and whose sim callers are the
+    #: single-threaded event loop) — externally synchronized by the
+    #: subclass's lock, which a per-class lexical check cannot see; the
+    #: physical-side helpers touching them are @requires_lock
+    #: (sanitizer-verified). Fields whose access sites live in
+    #: physical.py itself belong in PhysicalScheduler._LOCK_PROTECTED
+    #: instead, where the lock-discipline pass genuinely checks them.
+    _EXTERNALLY_SYNCHRONIZED = frozenset({
+        "_current_timestamp", "_replaying", "_journal",
+        "_throughputs", "_priorities", "_deficits", "_last_reset_time",
+        "_scheduled_jobs_in_prev_round", "_scheduled_jobs_in_current_round",
+        "_rounds_since_reopt", "_shockwave_job_completed",
+    })
+
     def __init__(self, policy, simulate: bool = False,
                  throughputs_file: Optional[str] = None,
                  profiles: Optional[List[dict]] = None,

@@ -132,6 +132,18 @@ class ServingService:
 class ServingTier:
     """Coordinator for all serving services of one scheduler."""
 
+    #: Tier state is mutated from the locked round pipeline
+    #: (`plan_round`) and the scheduler's job-lifecycle hooks (add_job
+    #: / replica removal, gRPC handler paths) — all call sites hold the
+    #: owning scheduler's lock, which a per-class static lockset cannot
+    #: see; in simulation the tier is single-threaded. Documented here
+    #: for the race detector; the sanitizer + explorer check the claim
+    #: dynamically. `_sched` is rebound once by `bind()` on restore.
+    _EXTERNALLY_SYNCHRONIZED = frozenset({
+        "services", "_replica_service", "_retired_unreaped",
+        "last_reserved", "_sched",
+    })
+
     def __init__(self, sched, config: Optional[dict] = None):
         self._sched = sched
         self.autoscaler_config = AutoscalerConfig.from_dict(config or {})

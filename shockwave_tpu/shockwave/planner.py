@@ -91,6 +91,21 @@ class PlanResult:
 
 
 class ShockwavePlanner:
+    #: Planner state is mutated from the scheduler round loop, the
+    #: job-lifecycle paths (add/remove via gRPC handlers) and
+    #: `commit_result` — every one of those call sites holds the OWNING
+    #: scheduler's lock (sched/physical.py `_LOCK_PROTECTED` covers the
+    #: planner handoff), which a per-class static lockset cannot see,
+    #: so the verdict is documented here. The solve thread deliberately
+    #: touches none of these: `solve_prepared` is a pure function of an
+    #: immutable PlanRequest plus init-frozen config (ngpus/opts/...).
+    #: Checked dynamically by the sanitizer + interleaving explorer.
+    _EXTERNALLY_SYNCHRONIZED = frozenset({
+        "metadata", "completed", "schedules", "round_ptr", "_resolve",
+        "_resolve_gen", "_reestimate_share", "share_series",
+        "solve_stats", "reserved_gpus", "pipelined", "journal", "obs",
+    })
+
     def __init__(self, ngpus: int, future_nrounds: int, round_duration: float,
                  opts: Optional[MilpOptions] = None):
         assert ngpus > 0 and future_nrounds > 0 and round_duration > 0

@@ -144,9 +144,28 @@ class WhatIfPlane:
     event loop's hooks; the physical scheduler captures under its lock
     and rolls on a background thread (sched/physical.py)."""
 
+    #: Decision/telemetry state shared between the physical what-if
+    #: thread (rollouts append their verdicts), the round pipeline
+    #: (capture bookkeeping under the scheduler lock) and the obs
+    #: exporter's request thread (status() inside /healthz). Guarded by
+    #: the plane's own leaf lock — surfaced by the race-detector pass:
+    #: forecast/shadow appends ran OFF the scheduler lock while
+    #: status() iterated the same lists.
+    _LOCK_PROTECTED = frozenset({
+        "decision_log", "knob_log", "forecast_log", "shadow_log",
+        "max_fork_s", "forks", "rollouts", "captured",
+        "_defer_counts", "_last_tune_round", "_last_forecast_round",
+    })
+
     def __init__(self, sched, config: Optional[dict] = None):
+        import threading
+
+        from ..analysis.sanitizer import maybe_wrap
         self._sched = sched
         self.cfg = WhatIfConfig.from_dict(config)
+        # Leaf lock (never held across a rollout or another subsystem's
+        # lock): protects the _LOCK_PROTECTED registry above.
+        self._lock = maybe_wrap(threading.Lock(), "WhatIfPlane._lock")
         self.decision_log: List[dict] = []
         self.knob_log: List[dict] = []
         self.forecast_log: List[dict] = []
@@ -171,10 +190,10 @@ class WhatIfPlane:
         import time as _time  # fork wall cost is telemetry, not state
         t0 = _time.monotonic()  # swtpu-check: ignore[determinism]
         blob = fork.capture(self._sched)
-        self.max_fork_s = max(
-            self.max_fork_s,
-            _time.monotonic() - t0)  # swtpu-check: ignore[determinism]
-        self.forks += 1
+        elapsed = _time.monotonic() - t0  # swtpu-check: ignore[determinism]
+        with self._lock:
+            self.max_fork_s = max(self.max_fork_s, elapsed)
+            self.forks += 1
         return blob
 
     def _roll(self, blob: bytes, *, seed: Optional[int], purpose: str,
@@ -195,7 +214,8 @@ class WhatIfPlane:
                          timestamp=now0)
         fork.rollforward(twin, horizon_rounds=horizon,
                          fault_events=fault_events)
-        self.rollouts += 1
+        with self._lock:
+            self.rollouts += 1
         sched.obs.inc(obs_names.WHATIF_ROLLOUTS_TOTAL, purpose=purpose)
         return self._score(twin, now0, steps0, completed0, serving0,
                            cf=cf)
@@ -294,7 +314,8 @@ class WhatIfPlane:
             return 0.0
         key = id(job)
         now = sched.get_current_timestamp()
-        defers = self._defer_counts.get(key, 0)
+        with self._lock:
+            defers = self._defer_counts.get(key, 0)
         if defers >= cfg.admission_max_defers:
             self._log_admission(job, now, "admit", defers,
                                 reason="max_defers")
@@ -324,7 +345,8 @@ class WhatIfPlane:
         self._log_admission(job, now, decision, defers, reason=reason,
                             scores=scores)
         if defer:
-            self._defer_counts[key] = defers + 1
+            with self._lock:
+                self._defer_counts[key] = defers + 1
             return defer_s
         return 0.0
 
@@ -402,7 +424,8 @@ class WhatIfPlane:
         if reason:
             record["reason"] = reason
         record["scores"] = scores
-        self.decision_log.append(record)
+        with self._lock:
+            self.decision_log.append(record)
         self._sched.obs.inc(obs_names.WHATIF_ADMISSION_DECISIONS_TOTAL,
                             decision=decision)
         self._sched._emit_whatif_admission(record)
@@ -422,7 +445,8 @@ class WhatIfPlane:
             record["reason"] = reason
         if scores:
             record["scores"] = scores
-        self.decision_log.append(record)
+        with self._lock:
+            self.decision_log.append(record)
         sched._emit_whatif_admission(record)
 
     # ------------------------------------------------------------------
@@ -436,21 +460,29 @@ class WhatIfPlane:
         scheduled). Physical mode drives the same work through
         maybe_capture_locked + run_background_step instead."""
         cfg = self.cfg
-        if cfg.capture_at_round is not None \
-                and current_round == cfg.capture_at_round \
-                and self.captured is None:
-            self.captured = (self._capture(),
-                             pickle.loads(pickle.dumps(list(queued))),
-                             remaining_jobs)
-        if cfg.tune_knob is not None and (
+        with self._lock:
+            want_capture = (cfg.capture_at_round is not None
+                            and current_round == cfg.capture_at_round
+                            and self.captured is None)
+            want_tune = cfg.tune_knob is not None and (
                 current_round - self._last_tune_round
-                >= cfg.tune_interval_rounds):
-            self._last_tune_round = current_round
-            self.tune_once(current_round)
-        if cfg.forecast_interval_rounds and (
+                >= cfg.tune_interval_rounds)
+            if want_tune:
+                self._last_tune_round = current_round
+            want_forecast = cfg.forecast_interval_rounds and (
                 current_round - self._last_forecast_round
-                >= cfg.forecast_interval_rounds):
-            self._last_forecast_round = current_round
+                >= cfg.forecast_interval_rounds)
+            if want_forecast:
+                self._last_forecast_round = current_round
+        if want_capture:
+            captured = (self._capture(),
+                        pickle.loads(pickle.dumps(list(queued))),
+                        remaining_jobs)
+            with self._lock:
+                self.captured = captured
+        if want_tune:
+            self.tune_once(current_round)
+        if want_forecast:
             self.forecast_once(current_round)
 
     def tune_once(self, current_round: int,
@@ -520,7 +552,8 @@ class WhatIfPlane:
             record = {"round": current_round, "knob": knob.name,
                       "previous": current, "chosen": chosen,
                       "changed": changed, "sweep": sweep}
-            self.knob_log.append(record)
+            with self._lock:
+                self.knob_log.append(record)
             # Durable (replayed) event: a resumed scheduler re-applies
             # the chosen value before its first round.
             sched._emit_whatif_knob(knob=knob.name, value=chosen,
@@ -562,7 +595,8 @@ class WhatIfPlane:
                             record["attainment_p50"], quantile="p50")
         sched.obs.set_gauge(obs_names.WHATIF_FORECAST_ATTAINMENT,
                             record["attainment_p99"], quantile="p99")
-        self.forecast_log.append(record)
+        with self._lock:
+            self.forecast_log.append(record)
         if cfg.shadow_chaos:
             self._shadow_chaos_once(current_round, blob)
         return record
@@ -605,7 +639,8 @@ class WhatIfPlane:
             fork.rollforward(
                 twin, horizon_rounds=self.cfg.forecast_horizon_rounds,
                 fault_events=events)
-            self.rollouts += 2
+            with self._lock:
+                self.rollouts += 2
             sched.obs.inc(obs_names.WHATIF_ROLLOUTS_TOTAL, amount=2,
                           purpose="shadow_chaos")
             failed = twin.obs.registry.value(
@@ -623,7 +658,8 @@ class WhatIfPlane:
                   "outcome": outcome}
         if detail:
             record["detail"] = detail
-        self.shadow_log.append(record)
+        with self._lock:
+            self.shadow_log.append(record)
 
     # ------------------------------------------------------------------
     # Physical-mode split (capture under lock; roll on a thread)
@@ -636,16 +672,20 @@ class WhatIfPlane:
         blob) for the background thread, or None."""
         cfg = self.cfg
         current_round = self._sched.rounds.num_completed_rounds
-        if cfg.tune_knob is not None and (
-                current_round - self._last_tune_round
-                >= cfg.tune_interval_rounds):
-            self._last_tune_round = current_round
-            return ("tune", current_round, self._capture())
-        if cfg.forecast_interval_rounds and (
-                current_round - self._last_forecast_round
-                >= cfg.forecast_interval_rounds):
-            self._last_forecast_round = current_round
-            return ("forecast", current_round, self._capture())
+        with self._lock:
+            kind = None
+            if cfg.tune_knob is not None and (
+                    current_round - self._last_tune_round
+                    >= cfg.tune_interval_rounds):
+                self._last_tune_round = current_round
+                kind = "tune"
+            elif cfg.forecast_interval_rounds and (
+                    current_round - self._last_forecast_round
+                    >= cfg.forecast_interval_rounds):
+                self._last_forecast_round = current_round
+                kind = "forecast"
+        if kind is not None:
+            return (kind, current_round, self._capture())
         return None
 
     def run_background_step(self, work: Tuple[str, int, bytes],
@@ -665,22 +705,24 @@ class WhatIfPlane:
     # ------------------------------------------------------------------
 
     def status(self) -> dict:
-        out = {
-            "admission": self.cfg.admission,
-            "forks": self.forks,
-            "rollouts": self.rollouts,
-            "max_fork_s": round(self.max_fork_s, 6),
-            "decisions": len(self.decision_log),
-            # Physical advisory verdicts count too (would_defer).
-            "deferrals": sum(1 for d in self.decision_log
-                             if d["decision"] in ("defer", "would_defer")),
-        }
-        if self.knob_log:
-            out["knob"] = self.knob_log[-1]
-        if self.forecast_log:
-            out["forecast"] = self.forecast_log[-1]
-        if self.shadow_log:
-            out["shadow_chaos"] = self.shadow_log[-1]
+        with self._lock:
+            out = {
+                "admission": self.cfg.admission,
+                "forks": self.forks,
+                "rollouts": self.rollouts,
+                "max_fork_s": round(self.max_fork_s, 6),
+                "decisions": len(self.decision_log),
+                # Physical advisory verdicts count too (would_defer).
+                "deferrals": sum(1 for d in self.decision_log
+                                 if d["decision"] in ("defer",
+                                                      "would_defer")),
+            }
+            if self.knob_log:
+                out["knob"] = self.knob_log[-1]
+            if self.forecast_log:
+                out["forecast"] = self.forecast_log[-1]
+            if self.shadow_log:
+                out["shadow_chaos"] = self.shadow_log[-1]
         return out
 
 

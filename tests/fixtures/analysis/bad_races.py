@@ -1,0 +1,18 @@
+"""Seeded violation for the race-detector pass: a field written by a
+spawned thread's loop with no lock, read from the public (main-thread)
+surface — the lockset intersection is empty."""
+import threading
+
+
+class UnlockedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        for _ in range(100):
+            self._total += 1  # SEEDED
+
+    def read(self):
+        return self._total

@@ -1,0 +1,18 @@
+"""Seeded violation for the suppression-audit pass: one suppression
+that still matches a real determinism finding (quiet), one that
+matches nothing (stale -> finding), and one naming an unknown pass id
+(always a finding)."""
+import time
+
+
+def now():
+    # Load-bearing: the determinism pass fires here and is suppressed.
+    return time.time()  # swtpu-check: ignore[determinism]
+
+
+def stale():
+    return 1.0  # swtpu-check: ignore[determinism]  # SEEDED
+
+
+def typo():
+    return 2.0  # swtpu-check: ignore[determinsm]  # SEEDED

@@ -1,0 +1,40 @@
+"""Seeded violations for the thread-roots pass.
+
+An unresolvable spawn target is a thread the race detector cannot see
+behind; resolvable spawns (named function, bound method) must NOT be
+flagged.
+"""
+import threading
+
+HANDLERS = {"run": print}
+
+# Module-level spawn (driver-script shape): discovery must look at
+# top-level statements too, not just function bodies.
+SPAWNED_AT_IMPORT = threading.Thread(target=HANDLERS["run"], daemon=True)  # SEEDED
+
+
+def work():
+    return 1
+
+
+def spawn_resolvable():
+    # Named module function: resolves, no finding.
+    threading.Thread(target=work, daemon=True).start()
+
+
+def spawn_opaque():
+    threading.Thread(target=HANDLERS["run"], daemon=True).start()  # SEEDED
+
+
+class Looper:
+    def __init__(self, callbacks):
+        self._callbacks = dict(callbacks)
+
+    def start(self):
+        # Bound method: resolves, no finding.
+        threading.Thread(target=self._loop, daemon=True).start()
+        # Dynamic callable out of a runtime dict: opaque.
+        threading.Timer(1.0, self._callbacks["tick"]).start()  # SEEDED
+
+    def _loop(self):
+        return self._callbacks

@@ -1,0 +1,43 @@
+"""Negative control for the race-detector pass: the same cross-thread
+shape as bad_races.py, but every access holds the lock — plus the
+exemption surfaces (thread-safe field types, init-frozen config,
+documented registries) that must all stay quiet."""
+import queue
+import threading
+
+
+class LockedCounter:
+    def __init__(self, limit):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._limit = limit                  # init-frozen: read-only
+        self._inbox = queue.Queue()          # thread-safe by type
+        self._stop = threading.Event()       # thread-safe by type
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._inbox.get()
+            with self._lock:
+                self._total += 1
+
+    def read(self):
+        with self._lock:
+            return min(self._total, self._limit)
+
+
+class DocumentedCounter:
+    """Registry verdict: the field is documented externally
+    synchronized, so the detector stays quiet without a lexical lock."""
+
+    _EXTERNALLY_SYNCHRONIZED = frozenset({"_total"})
+
+    def __init__(self):
+        self._total = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self._total += 1
+
+    def read(self):
+        return self._total

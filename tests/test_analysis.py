@@ -69,15 +69,47 @@ class TestRepoIsClean:
         assert out.returncode == 0, out.stdout + out.stderr
         assert "0 finding(s)" in out.stdout
 
-    def test_cli_lists_all_six_passes(self):
+    def test_cli_lists_all_passes_with_walls(self):
         out = subprocess.run(
             [sys.executable, "-m", "shockwave_tpu.analysis", "--list"],
             capture_output=True, text=True, cwd=REPO)
         assert out.returncode == 0
         for pass_id in ("lock-discipline", "journal-coverage",
                         "durability", "determinism", "exception-hygiene",
-                        "obs-discipline"):
+                        "obs-discipline", "thread-roots", "race-detector",
+                        "suppression-audit"):
             assert pass_id in out.stdout
+        # Per-pass wall reporting (the analyzer-performance satellite).
+        assert "[wall " in out.stdout
+        assert "total analyzer wall:" in out.stdout
+
+    def test_cli_json_report(self):
+        import json
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.analysis",
+             "--root", REPO, "--json"],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        report = json.loads(out.stdout)
+        assert report["count"] == 0
+        assert report["findings"] == []
+        pass_ids = {p["id"] for p in report["passes"]}
+        assert {"race-detector", "thread-roots",
+                "suppression-audit"} <= pass_ids
+        assert all("wall_s" in p and "findings" in p
+                   for p in report["passes"])
+
+    def test_findings_output_is_deterministic(self):
+        """The CI analysis-smoke gate: two runs over the same tree are
+        byte-identical (the analyzer itself must be deterministic)."""
+        runs = []
+        for _ in range(2):
+            out = subprocess.run(
+                [sys.executable, "-m", "shockwave_tpu.analysis",
+                 "--root", REPO],
+                capture_output=True, text=True, cwd=REPO)
+            runs.append(out.stdout)
+        assert runs[0] == runs[1]
 
 
 class TestNegativeFixtures:
@@ -146,6 +178,47 @@ class TestNegativeFixtures:
         assert obs_names.SHARD_DIR_ENV in reserved
         assert obs_names.SHARD_FILE_PREFIX in reserved
 
+    def test_thread_roots(self):
+        from shockwave_tpu.analysis.threads import check_thread_roots
+        findings = check_thread_roots(fixture_index("bad_threads.py"))
+        assert_exactly_seeded(findings, "bad_threads.py", "thread-roots")
+
+    def test_race_detector(self):
+        from shockwave_tpu.analysis.races import check_race_detector
+        findings = check_race_detector(fixture_index("bad_races.py"))
+        assert_exactly_seeded(findings, "bad_races.py", "race-detector")
+
+    def test_race_detector_clean_on_locked_and_documented(self):
+        """The negative control: consistent locksets, thread-safe field
+        types, init-frozen config and registry verdicts all stay
+        quiet."""
+        from shockwave_tpu.analysis.races import check_race_detector
+        assert check_race_detector(fixture_index("good_races.py")) == []
+
+    def test_suppression_audit(self):
+        from shockwave_tpu.analysis.passes import check_suppression_audit
+        index = fixture_index("bad_suppression.py")
+        live = passes.check_determinism(
+            index, scope_globs=("bad_suppression.py",), allow_globs=())
+        # The load-bearing suppression ate the real finding...
+        assert live == []
+        # ...and the audit flags exactly the stale one + the typo'd id.
+        findings = check_suppression_audit(
+            index, ran_pass_ids=["determinism"])
+        assert_exactly_seeded(findings, "bad_suppression.py",
+                              "suppression-audit")
+
+    def test_suppression_audit_skips_unran_passes(self):
+        """A --select subset must not misreport other passes'
+        suppressions as stale."""
+        from shockwave_tpu.analysis.passes import check_suppression_audit
+        index = fixture_index("bad_suppression.py")
+        findings = check_suppression_audit(
+            index, ran_pass_ids=["durability"])
+        # Only the unknown-id finding survives (flagged regardless).
+        assert [f.pass_id for f in findings] == ["suppression-audit"]
+        assert "unknown pass id" in findings[0].message
+
     def test_cli_exits_one_on_violations(self, tmp_path):
         """End-to-end exit-1 proof: a copy of a broken fixture placed
         where the default scan looks is reported with file:line and
@@ -175,6 +248,47 @@ class TestNegativeFixtures:
         idx = RepoIndex([SourceFile(str(path), "mod.py", src)],
                         str(tmp_path))
         assert passes.check_exception_hygiene(idx) == []
+
+
+class TestLiveTreeThreadRoots:
+    """Discovery over the real tree names every background-thread
+    entry the concurrency story depends on — if a rename or a new
+    spawn pattern makes one vanish, this fails before the race
+    detector silently loses coverage of it."""
+
+    def test_named_roots_discovered(self):
+        from shockwave_tpu.analysis import __main__ as main_mod
+        from shockwave_tpu.analysis.core import cached_index
+        from shockwave_tpu.analysis.threads import discover_thread_roots
+        index = cached_index(REPO,
+                             include_dirs=main_mod.DEFAULT_INCLUDE_DIRS,
+                             exclude_globs=main_mod.DEFAULT_EXCLUDE_GLOBS)
+        roots, findings = discover_thread_roots(index)
+        assert findings == [], [str(f) for f in findings]
+        entries = {str(r.key) for r in roots}
+        for expected in (
+                # the six thread-root families named in the PR
+                "PhysicalScheduler._planner_solve_loop",   # pipelined solve
+                "PhysicalScheduler._allocation_thread",
+                "PhysicalScheduler._liveness_loop",
+                "PhysicalScheduler._whatif_loop",          # what-if rollouts
+                "HAController._renew_loop",                # HA deadman
+                "HotStandby.health",                       # standby /healthz
+                "_Handler.do_GET",                         # exporter HTTP
+                "TelemetryHistory.payload",                # /history.json
+                "PhysicalScheduler.obs_health",            # /healthz callback
+                "PhysicalScheduler._on_ha_fenced",         # renewal callback
+                "WorkerDaemon._run_job",                   # gRPC servicer
+                "PhysicalScheduler.done_callback",         # gRPC servicer
+                "Dispatcher._dispatch_jobs_helper",        # per-dispatch
+                "PhysicalScheduler._kill_job",             # watchdog timer
+        ):
+            assert expected in entries, (
+                f"{expected} not discovered; roots: {sorted(entries)}")
+
+    def test_rpc_and_http_roots_are_self_concurrent(self):
+        from shockwave_tpu.analysis.threads import SELF_CONCURRENT_KINDS
+        assert {"rpc-handler", "http-handler"} <= SELF_CONCURRENT_KINDS
 
 
 class TestSanitizer:

@@ -95,6 +95,32 @@ class TestMilpAssemblyBench:
         assert json.loads(out.strip().splitlines()[-1])["assembler"] == "loop"
 
 
+class TestAnalysisBench:
+    def test_smoke_gate_and_row_shape(self, tmp_path):
+        """bench_analysis honors --smoke and reports cold/warm wall +
+        the per-pass table (the analyzer-performance floor)."""
+        out_file = tmp_path / "analysis.json"
+        out = run_script(["scripts/microbenchmarks/bench_analysis.py",
+                          "--smoke", "--runs", "1",
+                          "--max_cold_s", "30", "--max_warm_s", "20",
+                          "--output", str(out_file)])
+        row = json.loads(out.strip().splitlines()[-1])
+        assert row["findings"] == 0
+        assert row["warm_wall_s"] <= row["cold_wall_s"] * 1.5
+        assert "race-detector" in row["per_pass_wall_s"]
+        assert "suppression-audit" in row["per_pass_wall_s"]
+        assert json.loads(out_file.read_text())["bench"] == "analysis"
+
+    def test_smoke_fails_above_ceiling(self):
+        out = subprocess.run(
+            [sys.executable,
+             "scripts/microbenchmarks/bench_analysis.py", "--smoke",
+             "--runs", "1", "--max_cold_s", "0.000001"],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 1
+        assert "SMOKE FAIL" in out.stderr
+
+
 class TestTracingBench:
     def test_smoke_gate_and_row_shape(self):
         """bench_tracing honors --smoke and emits the bench.py row
