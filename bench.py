@@ -170,6 +170,31 @@ def tracing_phase():
             "tracing_shard_flush_mean_s": row["shard_flush_mean_s"]}
 
 
+def serving_phase():
+    """Serving decode throughput: the ROADMAP-named tokens/s-per-chip
+    row (scripts/microbenchmarks/bench_serving_decode.py) — the
+    measured number the serving tier's declared decode rate (and so
+    its analytic mu) is calibrated against; the measured-vs-analytic
+    p99 envelope lives in reproduce/serving/measured_calibration.json."""
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO,
+                          "scripts/microbenchmarks/bench_serving_decode.py")],
+            capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return {"serving_decode_error": "bench_serving_decode timeout"}
+    if out.returncode != 0:
+        return {"serving_decode_error": out.stderr[-300:]}
+    try:
+        row = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001
+        return {"serving_decode_error": out.stdout[-300:]}
+    return {"serving_tokens_per_s_per_chip": row["tokens_per_s_per_chip"],
+            "serving_requests_per_s": row["requests_per_s"],
+            "serving_decode_backend": row["backend"]}
+
+
 def main():
     sim_start = time.monotonic()
     out = subprocess.run(
@@ -208,6 +233,7 @@ def main():
     line.update(sweep_phase())
     line.update(whatif_phase())
     line.update(tracing_phase())
+    line.update(serving_phase())
     line.update(tpu_phase())
     print(json.dumps(line))
 

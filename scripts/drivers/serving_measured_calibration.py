@@ -1,0 +1,398 @@
+#!/usr/bin/env python3
+"""Measured-vs-analytic serving-latency calibration study.
+
+Drives the ENTIRE measured-serving pipeline — seeded arrival clock ->
+per-replica virtual queue (`serving/measured.ReplicaMeter`) -> sketch
+deltas -> heartbeat wire encode/decode -> per-service merge -> quantile
+readback -> online mu estimation — across a grid of load levels, and
+tabulates measured p50/p99 against the analytic Erlang-C model the
+autoscaler plans with (`serving/latency_model.py`).
+
+Service times are drawn from a SEEDED exponential at the declared rate
+``mu`` (the virtual-step stand-in for a decode wall), so the whole
+study is a pure function of its seeds: two runs produce byte-identical
+artifacts, which is what lets CI ``cmp`` them and commit the result as
+``reproduce/serving/measured_calibration.json``. Every row also merges
+its replica deltas in several seeded shuffles of arrival order and
+asserts the merged sketch encodes byte-identically — the
+order-independence contract of ``obs/quantiles.py``.
+
+The headline calibration finding the table documents: at one replica
+the measured p99 tracks Erlang-C within a few percent, but at higher
+replica counts the round-robin request split (c independent queues)
+measures markedly WORSE than the central-queue M/M/c idealization —
+the analytic model is optimistic exactly where the autoscaler most
+needs headroom, which is why measured p99 (not the model) is the
+scaling signal once samples exist.
+
+``--loopback`` appends a physical-loopback smoke: a REAL
+PhysicalScheduler + stub worker daemon exchange measured deltas over
+the live gRPC Done path, and the artifact records the (deterministic)
+outcome booleans — measured samples reached the tier, measured p99 was
+exported, the autoscaler's scale-up was driven by the measured breach
+(the analytic model alone wanted fewer replicas), and mu was refined.
+
+``--check`` gates: every row inside the calibration envelope, mu
+recovered within tolerance, and (with --loopback) every outcome true.
+
+The committed study:
+    python scripts/drivers/serving_measured_calibration.py \
+        --out reproduce/serving/measured_calibration.json --check
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+from shockwave_tpu.core.durable_io import write_text_atomic  # noqa: E402
+from shockwave_tpu.obs.quantiles import QuantileSketch, merge_all  # noqa: E402
+from shockwave_tpu.serving.latency_model import (p50_latency,  # noqa: E402
+                                                 p99_latency)
+from shockwave_tpu.serving.load import DiurnalLoad  # noqa: E402
+from shockwave_tpu.serving.measured import (ArrivalClock,  # noqa: E402
+                                            ReplicaMeter,
+                                            ServiceMeasuredState)
+
+ARTIFACT_SCHEMA = 1
+#: Steps between delta takes inside one replica drive (exercises the
+#: multi-delta merge path, not just one big sketch).
+DELTA_EVERY_STEPS = 256
+#: Merge-order shuffles per row (plus the sorted order).
+MERGE_SHUFFLES = 3
+
+
+def drive_replica(load, seed, horizon_s, replica_index, num_replicas,
+                  mu, batch_size, tokens_per_request):
+    """One replica's full measured pipeline at virtual speed: seeded
+    exponential service walls stand in for decode-step timing. Returns
+    the list of wire-encoded deltas the replica would heartbeat."""
+    service_rng = np.random.RandomState(seed * 1009 + replica_index)
+    meter = ReplicaMeter(
+        ArrivalClock(load, seed, horizon_s, replica_index=replica_index,
+                     num_replicas=num_replicas),
+        batch_size=batch_size, tokens_per_request=tokens_per_request)
+    deltas, steps = [], 0
+    # Event-driven virtual drive: jump idle gaps (the driver owns the
+    # timeline), then serve one batch per step with a service wall
+    # ~ Exp(batch/mu) — length-proportional KV-cached decode at the
+    # declared rate.
+    while meter.idle_to_next_arrival():
+        meter.step(float(service_rng.exponential(batch_size / mu)))
+        steps += 1
+        if steps % DELTA_EVERY_STEPS == 0:
+            delta = meter.take_delta()
+            if delta is not None:
+                deltas.append(delta)
+    final = meter.take_delta()
+    if final is not None:
+        deltas.append(final)
+    return deltas
+
+
+def merged_order_independent(deltas, seed):
+    """Merge the deltas in sorted order plus seeded shuffles; return
+    (merged sketch, True iff every order encoded byte-identically)."""
+    sketches = [QuantileSketch.from_payload(d["sketch"]) for d in deltas]
+    reference = merge_all(sketches).encode()
+    rng = np.random.RandomState(seed)
+    ok = True
+    for _ in range(MERGE_SHUFFLES):
+        order = list(rng.permutation(len(sketches)))
+        ok = ok and merge_all([sketches[i] for i in order]
+                              ).encode() == reference
+    return QuantileSketch.decode(reference), ok
+
+
+def calibration_row(rho, replicas, args):
+    lam = rho * replicas * args.mu
+    load = DiurnalLoad(base_rps=lam, peak_rps=lam, period_s=0.0)
+    state = ServiceMeasuredState(args.mu, args.tokens_per_request,
+                                 mu_prior_weight=args.mu_prior_weight)
+    all_deltas = []
+    for r in range(replicas):
+        deltas = drive_replica(load, args.seed, args.horizon_s, r,
+                               replicas, args.mu, args.batch_size,
+                               args.tokens_per_request)
+        all_deltas.extend(deltas)
+        for delta in deltas:
+            state.ingest(delta)
+    merged, order_ok = merged_order_independent(all_deltas, args.seed)
+    assert merged.count == state.requests_total
+    analytic_p99 = p99_latency(lam, replicas, args.mu)
+    analytic_p50 = p50_latency(lam, replicas, args.mu)
+    measured_p99 = merged.quantile(0.99)
+    measured_p50 = merged.quantile(0.5)
+    return {
+        "rho": rho,
+        "replicas": replicas,
+        "lambda_rps": round(lam, 4),
+        "samples": merged.count,
+        "deltas_merged": len(all_deltas),
+        "merge_order_independent": order_ok,
+        "measured_p50_s": round(measured_p50, 6),
+        "measured_p99_s": round(measured_p99, 6),
+        "analytic_p50_s": round(analytic_p50, 6),
+        "analytic_p99_s": round(analytic_p99, 6),
+        "p99_ratio": round(measured_p99 / analytic_p99, 4),
+        "tokens_per_s_busy": round(state.measured_tokens_per_s(), 3),
+        "mu_estimate": round(state.mu_estimate(), 4),
+        "mu_declared": args.mu,
+    }
+
+
+# ----------------------------------------------------------------------
+# Physical loopback: measured telemetry over the live gRPC Done path
+# ----------------------------------------------------------------------
+
+class LoopbackWorkerStub:
+    """Stub worker daemon for the loopback: every dispatched replica
+    inits its lease, then Done-reports with the prepared measured
+    sketch blob on the iterator-log channel — the live gRPC path the
+    real worker daemon uses."""
+
+    def __init__(self, sched_port, worker_port, report_blob):
+        import threading
+
+        from shockwave_tpu.runtime.clients import WorkerToSchedulerClient
+        from shockwave_tpu.runtime.servers import serve_worker
+        self._threading = threading
+        self._sched_port = sched_port
+        self._report_blob = report_blob
+        self._client = WorkerToSchedulerClient("localhost", sched_port)
+        self.server = serve_worker(worker_port, {
+            "RunJob": self._run_job, "KillJob": self._kill_job,
+            "Reset": self._reset, "Shutdown": self._reset,
+        })
+        self.worker_ids, _ = self._client.register_worker(
+            "v5e", "127.0.0.1", worker_port, 4)
+
+    def _kill_job(self, job_id):
+        pass
+
+    def _reset(self):
+        pass
+
+    def _run_job(self, jobs, worker_id, round_id):
+        self._threading.Thread(target=self._execute,
+                               args=(jobs, worker_id),
+                               daemon=True).start()
+
+    def _execute(self, jobs, worker_id):
+        import time as _time
+
+        from shockwave_tpu.runtime.clients import IteratorToSchedulerClient
+        try:
+            for j in jobs:
+                it = IteratorToSchedulerClient(
+                    j["job_id"], worker_id, "localhost", self._sched_port)
+                it.init()
+            _time.sleep(0.3)
+            self._client.notify_done(
+                [j["job_id"] for j in jobs], worker_id,
+                [25] * len(jobs), [0.8] * len(jobs),
+                iterator_logs=[self._report_blob] * len(jobs))
+        except Exception as e:  # noqa: BLE001 - teardown race
+            print(f"loopback stub report dropped: {e}", file=sys.stderr)
+
+    def stop(self):
+        self.server.stop(grace=0)
+
+
+def run_loopback(args):
+    """Real PhysicalScheduler + stub worker daemon: replica dispatches
+    come back with measured sketch deltas on the Done heartbeat whose
+    p99 breaches the SLO the analytic model says is safe — the
+    autoscaler must scale up on the MEASURED evidence. Returns
+    deterministic outcome booleans for the artifact."""
+    import socket
+    import threading
+    import time as _time
+
+    from shockwave_tpu.core.trace import make_serving_job
+    from shockwave_tpu.obs import names as obs_names
+    from shockwave_tpu.sched.physical import PhysicalScheduler
+    from shockwave_tpu.sched.scheduler import SchedulerConfig
+    from shockwave_tpu.serving.latency_model import replicas_for_slo
+    from shockwave_tpu.serving.measured import encode_report
+    from shockwave_tpu.solver import get_policy
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
+
+    slo_p99_s = 0.5
+    # Low offered load: the ANALYTIC model wants exactly one replica.
+    base_rps, mu = 2.0, 25.0
+    assert replicas_for_slo(base_rps * 1.15, mu, slo_p99_s, 4) == 1
+
+    # Measured evidence of a breach: the replica actually serves at
+    # HALF the declared rate (chip slower than the trace claims), so an
+    # overloaded virtual queue produces a p99 well over the SLO and the
+    # mu estimate must pull away from the analytic prior — both signals
+    # the loopback asserts end to end (seeded, deterministic).
+    hot = DiurnalLoad(40.0, 40.0, 0.0)
+    rng = np.random.RandomState(args.seed)
+    meter = ReplicaMeter(ArrivalClock(hot, args.seed, 30.0), 1, 64)
+    while meter.idle_to_next_arrival():
+        meter.step(float(rng.exponential(2.0 / mu)))
+    breach_delta = meter.take_delta()
+    breach_sketch = QuantileSketch.from_payload(breach_delta["sketch"])
+    assert breach_sketch.quantile(0.99) > slo_p99_s
+    report_blob = "\n".join([
+        "[2026-01-01 00:00:00] [PROGRESS] [STEPS] 25",
+        "[2026-01-01 00:00:00] [PROGRESS] [DURATION] 0.8",
+        "[2026-01-01 00:00:00] [SERVING] [MEASURED] "
+        + encode_report(breach_delta),
+    ])
+
+    sched_port, worker_port = free_port(), free_port()
+    sched = PhysicalScheduler(
+        get_policy("max_min_fairness"),
+        throughputs_file=args.throughputs,
+        config=SchedulerConfig(
+            time_per_iteration=2.0, max_rounds=8,
+            serving={"measured_min_samples": 1, "mu_prior_weight": 16.0}),
+        expected_num_workers=4, port=sched_port)
+
+    stub = LoopbackWorkerStub(sched_port, worker_port, report_blob)
+    outcome = {"measured_samples_reported": False,
+               "measured_p99_exported": False,
+               "measured_drove_scale_up": False,
+               "mu_refined": False,
+               "analytic_only_target": 1}
+    try:
+        svc = make_serving_job(
+            base_rps=base_rps, peak_rps=base_rps, period_s=0.0,
+            lifetime_s=3600.0, slo_p99_s=slo_p99_s, tokens_per_request=64,
+            decode_tokens_per_s=64 * mu, max_replicas=4)
+        sched.add_job(svc)
+        threading.Thread(target=sched.run, daemon=True).start()
+        reg = sched.obs
+        deadline = _time.time() + 40  # swtpu-check: ignore[determinism]
+        while _time.time() < deadline:  # swtpu-check: ignore[determinism]
+            with sched._lock:
+                samples = reg.registry.value(
+                    obs_names.SERVING_MEASURED_SAMPLES_TOTAL, service="0")
+                target = reg.registry.value(
+                    obs_names.SERVING_TARGET_REPLICAS, service="0")
+            if samples > 0 and target >= 2:
+                break
+            _time.sleep(0.2)
+        with sched._lock:
+            registry = reg.registry
+            samples = registry.value(
+                obs_names.SERVING_MEASURED_SAMPLES_TOTAL, service="0")
+            measured_p99 = registry.value(
+                obs_names.SERVING_MEASURED_P99_SECONDS, service="0")
+            target = registry.value(obs_names.SERVING_TARGET_REPLICAS,
+                                    service="0")
+            mu_est = registry.value(obs_names.SERVING_MU_ESTIMATE,
+                                    service="0")
+            tier_svc = (list(sched._serving_tier.services.values())[0]
+                        if sched._serving_tier is not None else None)
+        outcome["measured_samples_reported"] = samples > 0
+        outcome["measured_p99_exported"] = measured_p99 > slo_p99_s
+        outcome["measured_drove_scale_up"] = target >= 2
+        outcome["mu_refined"] = (
+            tier_svc is not None
+            and abs(mu_est - tier_svc.mu_analytic) > 1e-9
+            and abs(tier_svc.mu - mu_est) < 1e-9)
+    finally:
+        sched._done_event.set()
+        stub.stop()
+        sched._server.stop(grace=0)
+    return outcome
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rhos", default="0.2,0.4,0.6,0.8,0.9",
+                   help="offered-load levels (lambda / (c * mu))")
+    p.add_argument("--replicas", default="1,2,4",
+                   help="replica counts to calibrate at")
+    p.add_argument("--mu", type=float, default=20.0,
+                   help="declared per-replica service rate (req/s)")
+    p.add_argument("--horizon_s", type=float, default=2000.0,
+                   help="virtual drive length per (rho, replicas) cell")
+    p.add_argument("--batch_size", type=int, default=1)
+    p.add_argument("--tokens_per_request", type=int, default=64)
+    p.add_argument("--mu_prior_weight", type=float, default=64.0)
+    p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--envelope", default="0.7:6.0",
+                   help="--check: measured/analytic p99 ratio bounds")
+    p.add_argument("--mu_tolerance", type=float, default=0.05,
+                   help="--check: |mu_estimate/mu - 1| bound")
+    p.add_argument("--loopback", action="store_true",
+                   help="append the physical gRPC loopback smoke")
+    p.add_argument("--throughputs",
+                   default=os.path.join(os.path.dirname(__file__), "..",
+                                        "..", "data",
+                                        "tacc_throughputs.json"))
+    p.add_argument("--out", default="serving_measured_calibration.json")
+    p.add_argument("--check", action="store_true",
+                   help="exit nonzero outside the calibration envelope")
+    args = p.parse_args(argv)
+
+    rhos = [float(x) for x in args.rhos.split(",") if x]
+    replica_counts = [int(x) for x in args.replicas.split(",") if x]
+    rows = [calibration_row(rho, c, args)
+            for c in replica_counts for rho in rhos]
+
+    artifact = {
+        "schema": ARTIFACT_SCHEMA,
+        "study": "serving_measured_calibration",
+        "config": {
+            "rhos": rhos, "replicas": replica_counts, "mu": args.mu,
+            "horizon_s": args.horizon_s, "batch_size": args.batch_size,
+            "tokens_per_request": args.tokens_per_request,
+            "mu_prior_weight": args.mu_prior_weight, "seed": args.seed,
+        },
+        "rows": rows,
+        "merge_order_independent": all(r["merge_order_independent"]
+                                       for r in rows),
+        "measured_sample_coverage": sum(r["samples"] for r in rows),
+    }
+    if args.loopback:
+        artifact["loopback"] = run_loopback(args)
+
+    write_text_atomic(args.out,
+                      json.dumps(artifact, sort_keys=True, indent=1)
+                      + "\n")
+    print(json.dumps({"out": args.out, "rows": len(rows),
+                      "samples": artifact["measured_sample_coverage"],
+                      "merge_order_independent":
+                      artifact["merge_order_independent"]}))
+
+    if not args.check:
+        return 0
+    lo, hi = (float(x) for x in args.envelope.split(":"))
+    failures = []
+    if artifact["measured_sample_coverage"] <= 0:
+        failures.append("no measured samples at all")
+    if not artifact["merge_order_independent"]:
+        failures.append("sketch merge depended on delta order")
+    for row in rows:
+        if not lo <= row["p99_ratio"] <= hi:
+            failures.append(
+                f"rho={row['rho']} c={row['replicas']}: p99 ratio "
+                f"{row['p99_ratio']} outside [{lo}, {hi}]")
+        if abs(row["mu_estimate"] / args.mu - 1.0) > args.mu_tolerance:
+            failures.append(
+                f"rho={row['rho']} c={row['replicas']}: mu estimate "
+                f"{row['mu_estimate']} off by more than "
+                f"{args.mu_tolerance:.0%}")
+    for key, value in artifact.get("loopback", {}).items():
+        if value is False:
+            failures.append(f"loopback outcome {key} is false")
+    for failure in failures:
+        print(f"CHECK FAIL: {failure}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""Serving decode-throughput microbenchmark: tokens/s per chip.
+
+Times the exact request-batch decode the serving replica runs
+(`workloads/serving/serve.py`: KV-cached greedy decode through
+`models/decoder.py`) on whatever backend is available, and reports the
+ROADMAP-named ``tokens/s-per-chip`` row bench.py embeds — the measured
+number the serving tier's declared ``decode_tokens_per_s`` (and so the
+analytic ``mu``) must be calibrated against.
+
+Prints ONE JSON line. ``--smoke`` exits nonzero when tokens/s falls
+under ``--min_tokens_per_s`` — the CI floor gate (the CPU-backend floor
+is deliberately modest; real-chip floors live with the TPU evidence
+capture).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from shockwave_tpu.models.decoder import DecoderLM  # noqa: E402
+
+
+def build_decode(args):
+    max_len = args.prompt_len + args.tokens_per_request + 1
+    model = DecoderLM(dim=args.model_dim, num_layers=args.model_layers,
+                      num_heads=args.model_heads,
+                      mlp_dim=2 * args.model_dim, max_len=max_len)
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(
+        rng, (args.batch_size, args.prompt_len), 0, model.vocab_size,
+        dtype=jnp.int32)
+    params = model.init(rng, prompt)
+
+    @jax.jit
+    def serve_request_batch(params, prompt):
+        caches = model.init_cache(args.batch_size)
+
+        def step(carry, token_in):
+            caches, pos = carry
+            logits, caches = model.apply(params, token_in, caches, pos,
+                                         method=DecoderLM.decode_step)
+            next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return (caches, pos + 1), next_tok[:, None]
+
+        carry = (caches, jnp.int32(0))
+        token = prompt[:, :1]
+        for i in range(args.prompt_len):
+            carry, token = step(carry, prompt[:, i:i + 1])
+
+        def body(i, state):
+            carry, token = state
+            carry, token = step(carry, token)
+            return (carry, token)
+
+        carry, token = jax.lax.fori_loop(
+            0, args.tokens_per_request, body, (carry, token))
+        return token
+
+    return serve_request_batch, params, prompt
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch_size", type=int, default=8)
+    p.add_argument("--tokens_per_request", type=int, default=32)
+    p.add_argument("--prompt_len", type=int, default=8)
+    p.add_argument("--model_dim", type=int, default=128)
+    p.add_argument("--model_layers", type=int, default=2)
+    p.add_argument("--model_heads", type=int, default=4)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--steps", type=int, default=8,
+                   help="timed request batches")
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--min_tokens_per_s", type=float, default=200.0,
+                   help="--smoke: fail below this decode throughput")
+    p.add_argument("--output", default=None, help="also write the JSON")
+    args = p.parse_args()
+
+    serve_request_batch, params, prompt = build_decode(args)
+    for _ in range(max(args.warmup, 1)):     # includes the jit compile
+        jax.block_until_ready(serve_request_batch(params, prompt))
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(args.steps):
+        last = serve_request_batch(params, prompt)
+    jax.block_until_ready(last)
+    wall = time.perf_counter() - t0
+
+    device = jax.devices()[0]
+    tokens = args.steps * args.batch_size * args.tokens_per_request
+    tokens_per_s = tokens / wall
+    row = {
+        "bench": "serving_decode",
+        "backend": device.platform,
+        "device_kind": getattr(device, "device_kind", device.platform),
+        "batch_size": args.batch_size,
+        "tokens_per_request": args.tokens_per_request,
+        "model_dim": args.model_dim,
+        "model_layers": args.model_layers,
+        "steps": args.steps,
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(tokens_per_s, 1),
+        # One replica owns one chip (JAX_VISIBLE_DEVICES pinning in the
+        # dispatcher), so per-chip == per-replica here.
+        "tokens_per_s_per_chip": round(tokens_per_s, 1),
+        "requests_per_s": round(tokens_per_s / args.tokens_per_request, 2),
+    }
+    print(json.dumps(row))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(row, f)
+    if args.smoke and row["tokens_per_s"] < args.min_tokens_per_s:
+        print(f"SMOKE FAIL: {row['tokens_per_s']} tokens/s < "
+              f"{args.min_tokens_per_s}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -328,6 +328,14 @@ DETERMINISM_SCOPE_GLOBS = (
     "scripts/drivers/sweep_scenarios.py",
     "scripts/drivers/chaos_campaign.py",
     "scripts/drivers/whatif_overload_study.py",
+    # The measured-serving path: the replica-side arrival clock and
+    # the mergeable quantile sketch must be pure functions of (spec,
+    # seed, measured durations) — a wall clock or unseeded RNG here
+    # would fork replica request streams across dispatches and break
+    # the byte-stable calibration artifact CI cmp's.
+    "shockwave_tpu/serving/*.py",
+    "shockwave_tpu/obs/quantiles.py",
+    "scripts/drivers/serving_measured_calibration.py",
 )
 #: Wall-clock measurement utilities (two-point marginal timing) are the
 #: sanctioned home for real clocks.
@@ -491,8 +499,12 @@ OBS_NAMES_GLOBS = ("shockwave_tpu/obs/names.py",)
 OBS_MODULE_GLOBS = ("shockwave_tpu/obs/*.py",)
 #: ...plus every span-emitting runtime module: span timestamps must be
 #: stamped through the injected obs clock (obs/shard.py), so a raw wall
-#: clock here would fork the fleet-trace timebase.
-OBS_CLOCK_EXTRA_GLOBS = ("shockwave_tpu/runtime/spans.py",)
+#: clock here would fork the fleet-trace timebase — and the measured-
+#: serving reporter, whose virtual request clock is driven ONLY by
+#: caller-injected durations (serve.py measures; the module never
+#: reads a clock itself).
+OBS_CLOCK_EXTRA_GLOBS = ("shockwave_tpu/runtime/spans.py",
+                         "shockwave_tpu/serving/measured.py")
 #: ...except the one designated clock adapter.
 OBS_CLOCK_ALLOW_GLOBS = ("shockwave_tpu/obs/clock.py",)
 #: Instrument entry points whose first argument is a metric/span name.

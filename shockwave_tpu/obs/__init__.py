@@ -71,13 +71,15 @@ class Observability:
         self.set_gauge = self.registry.set_gauge
         self.observe = self.registry.observe
         self.timed = self.registry.timed
+        self.remove_series = self.registry.remove_series
         self.span = self.tracer.span
 
     def __getstate__(self):
         # The bound delegates would pickle whole object subgraphs;
         # rebind from the unpickled registry/tracer instead.
         state = dict(self.__dict__)
-        for name in ("inc", "set_gauge", "observe", "timed", "span"):
+        for name in ("inc", "set_gauge", "observe", "timed",
+                     "remove_series", "span"):
             state.pop(name, None)
         return state
 

@@ -12,7 +12,11 @@ events. This module keeps two bounded rings:
 - **observations**: one ``(job_type, batch_size, scale_factor,
   worker_type) -> observed steps/s`` point per completed micro-task —
   exactly the training set a learned performance model consumes
-  (PAPERS.md 2008.01040).
+  (PAPERS.md 2008.01040);
+- **serving**: one measured-serving row per (service, round) with
+  samples — measured p50/p99, tokens/s, the analytic p99 and the
+  online mu estimate (serving/tier.take_measured_rows) — the
+  ``mu``-estimation / latency-calibration training set.
 
 Both rings are flushed to ONE file (``history.json`` in the state dir)
 through `core/durable_io.write_text_atomic` every few rounds, so a
@@ -43,9 +47,10 @@ from .clock import Clock
 from .registry import MetricsRegistry
 
 #: Ring bounds: ~512 rounds of snapshots (days at 360 s rounds) and a
-#: few thousand throughput points.
+#: few thousand throughput / measured-serving points.
 DEFAULT_MAX_ROUNDS = 512
 DEFAULT_MAX_OBSERVATIONS = 8192
+DEFAULT_MAX_SERVING = 4096
 DEFAULT_FLUSH_INTERVAL_ROUNDS = 8
 
 HISTORY_SCHEMA = 1
@@ -82,7 +87,8 @@ class TelemetryHistory:
     #: leaf lock; enforced by the lock-discipline pass and checked
     #: cross-thread by the race detector.
     _LOCK_PROTECTED = frozenset({
-        "_rounds", "_observations", "_alerts", "_samples_since_flush",
+        "_rounds", "_observations", "_serving", "_alerts",
+        "_samples_since_flush",
     })
 
     def __init__(self, registry: MetricsRegistry, clock: Clock,
@@ -90,6 +96,7 @@ class TelemetryHistory:
                  time_per_iteration: Optional[float] = None,
                  max_rounds: int = DEFAULT_MAX_ROUNDS,
                  max_observations: int = DEFAULT_MAX_OBSERVATIONS,
+                 max_serving: int = DEFAULT_MAX_SERVING,
                  flush_interval_rounds: int = DEFAULT_FLUSH_INTERVAL_ROUNDS):
         self._registry = registry
         self._clock = clock
@@ -98,6 +105,7 @@ class TelemetryHistory:
         self._flush_interval = max(int(flush_interval_rounds), 1)
         self._rounds: "deque[dict]" = deque(maxlen=max_rounds)
         self._observations: "deque[list]" = deque(maxlen=max_observations)
+        self._serving: "deque[dict]" = deque(maxlen=max_serving)
         self._alerts: Dict[str, int] = {}
         self._samples_since_flush = 0
         # Leaf lock: the round loop appends under the scheduler lock
@@ -120,6 +128,8 @@ class TelemetryHistory:
                                           DEFAULT_MAX_ROUNDS)),
                    max_observations=int(cfg.get(
                        "max_observations", DEFAULT_MAX_OBSERVATIONS)),
+                   max_serving=int(cfg.get("max_serving",
+                                           DEFAULT_MAX_SERVING)),
                    flush_interval_rounds=int(cfg.get(
                        "flush_interval_rounds",
                        DEFAULT_FLUSH_INTERVAL_ROUNDS)))
@@ -156,6 +166,10 @@ class TelemetryHistory:
         for entry in payload.get("observations", []):
             if isinstance(entry, list) and len(entry) == 6:
                 self._observations.append(entry)
+        for entry in payload.get("serving", []):
+            if (isinstance(entry, dict) and "service" in entry
+                    and "round" in entry):
+                self._serving.append(entry)
 
     def flush(self) -> str:
         from ..core.durable_io import write_text_atomic
@@ -215,6 +229,14 @@ class TelemetryHistory:
                  float(steps_per_s)])
         self._registry.inc(names.HISTORY_SAMPLES_TOTAL,
                            kind="observation")
+
+    def record_serving(self, row: dict, round_id: int) -> None:
+        """One measured-serving round row (serving/tier
+        `take_measured_rows` output): the latency-calibration and
+        mu-estimation training point."""
+        with self._lock:
+            self._serving.append(dict(row, round=int(round_id)))
+        self._registry.inc(names.HISTORY_SAMPLES_TOTAL, kind="serving")
 
     # -- checks ---------------------------------------------------------
 
@@ -285,5 +307,6 @@ class TelemetryHistory:
                 "schema": HISTORY_SCHEMA,
                 "rounds": list(self._rounds),
                 "observations": [list(o) for o in self._observations],
+                "serving": [dict(s) for s in self._serving],
                 "alerts": dict(self._alerts),
             }

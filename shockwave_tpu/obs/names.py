@@ -293,6 +293,45 @@ SERVING_SCALE_EVENTS_TOTAL = _counter(
     "swtpu_serving_scale_events_total",
     "Replica scale events, by direction (up / down); each unit is one "
     "replica spawned or drained", ("direction",))
+SERVING_SATURATED = _gauge(
+    "swtpu_serving_saturated",
+    "Whether the analytic model says each service's replica pool is "
+    "saturated this round (1 = offered load >= pool capacity; the p99 "
+    "gauge is dropped while saturated instead of freezing at its last "
+    "healthy value)", ("service",))
+
+# Measured serving path (serving/measured.py + obs/quantiles.py):
+# per-request telemetry from the physical replicas, merged per service.
+# Absent in simulation — the analytic gauges above are the sim story.
+SERVING_MEASURED_P50_SECONDS = _gauge(
+    "swtpu_serving_measured_p50_seconds",
+    "Measured p50 admission->last-token request latency over the "
+    "round's merged replica sketches (quantile-sketch upper edge; only "
+    "exported when the round saw measured samples)", ("service",))
+SERVING_MEASURED_P99_SECONDS = _gauge(
+    "swtpu_serving_measured_p99_seconds",
+    "Measured p99 admission->last-token request latency over the "
+    "round's merged replica sketches — the autoscaler's preferred "
+    "signal when samples exist", ("service",))
+SERVING_TOKENS_PER_S = _gauge(
+    "swtpu_serving_tokens_per_s",
+    "Measured decode throughput of each service's replica pool over "
+    "the round (tokens served / round seconds)", ("service",))
+SERVING_MEASURED_VS_ANALYTIC_P99 = _gauge(
+    "swtpu_serving_measured_vs_analytic_p99",
+    "Calibration error of the analytic latency model: measured p99 / "
+    "analytic p99 for the same round (1.0 = perfectly calibrated; "
+    "omitted while the analytic model reports saturation)", ("service",))
+SERVING_MEASURED_SAMPLES_TOTAL = _counter(
+    "swtpu_serving_measured_samples_total",
+    "Measured request-latency samples merged into each service's "
+    "sketches (the measured-path coverage gate in CI)", ("service",))
+SERVING_MU_ESTIMATE = _gauge(
+    "swtpu_serving_mu_estimate",
+    "Online per-replica service-rate estimate mu (requests/s): "
+    "measured tokens/s / tokens_per_request blended with the analytic "
+    "prior by sample count; equals the analytic value until samples "
+    "arrive", ("service",))
 
 # ----------------------------------------------------------------------
 # Fleet-scale simulation (vectorized sim core + Monte Carlo sweep:
@@ -389,7 +428,8 @@ HISTORY_SAMPLES_TOTAL = _counter(
     "swtpu_history_samples_total",
     "Telemetry-history ring appends, by kind (round: one full metric "
     "snapshot per round; observation: one per-microtask observed "
-    "steps/s point keyed by (job_type, bs, sf, worker_type))",
+    "steps/s point keyed by (job_type, bs, sf, worker_type); serving: "
+    "one measured-serving row per (service, round) with samples)",
     ("kind",))
 HISTORY_FLUSHES_TOTAL = _counter(
     "swtpu_history_flushes_total",

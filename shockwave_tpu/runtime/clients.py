@@ -397,11 +397,18 @@ class IteratorToSchedulerClient:
         return r.max_steps, r.max_duration, r.extra_time
 
     def update_lease(self, steps: int, duration: float, max_steps: int,
-                     max_duration: float) -> Tuple[int, float, float, float]:
+                     max_duration: float,
+                     measured_reports: Optional[Sequence[str]] = None
+                     ) -> Tuple[int, float, float, float]:
+        """`measured_reports` piggybacks serving sketch deltas
+        (serving/measured.py wire lines) on the renewal heartbeat —
+        the per-round telemetry channel for replicas whose extended
+        lease means Done only fires at drain."""
         r = self._call("UpdateLease", pb.UpdateLeaseRequest(
             job_id=self._job_id, worker_id=self._worker_id,
             steps=int(steps), duration=duration, max_steps=int(max_steps),
-            max_duration=max_duration))
+            max_duration=max_duration,
+            measured_reports=list(measured_reports or [])))
         return r.max_steps, r.max_duration, r.run_time_so_far, r.deadline
 
     def update_resource_requirement(self, big_bs: bool, small_bs: bool) -> None:

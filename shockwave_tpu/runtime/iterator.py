@@ -180,9 +180,13 @@ class LeaseIterator:
         self._cached_batch = None
         self._lease = Lease(0, 0)
         self._write_on_close = write_on_close
+        #: Measured-serving telemetry lines awaiting the next renewal.
+        self._measured_buffer: list = []
         atexit.register(self._close_log)
         if write_on_close:
             atexit.register(self._write_info)
+        # LIFO: flushes before the log handler closes above.
+        atexit.register(self._flush_measured_to_log)
         self._update_lease(init=True)
         self._write_info()
         # Start the clock at construction: shared-filesystem reads before the
@@ -202,6 +206,34 @@ class LeaseIterator:
         """Give the iterator a device value (e.g. the last loss) to sync on
         when honest timing is needed."""
         self._sync_ref = value
+
+    def log_measurement(self, payload: str) -> None:
+        """Append one measured-telemetry line to the iterator log. The
+        worker daemon ships the whole log back on the Done heartbeat,
+        so this is the job->scheduler telemetry channel that needs no
+        new RPC field — serving replicas use it for their request-
+        latency sketch deltas (serving/measured.py wire format; the
+        scheduler's log fold routes marked lines to the serving tier
+        instead of the job timeline)."""
+        self._logger.info(payload, extra={"event": "SERVING",
+                                          "status": "MEASURED"})
+
+    def queue_measurement(self, payload: str) -> None:
+        """Buffer one measured-telemetry line for the NEXT lease
+        renewal (UpdateLeaseRequest.measured_reports): a sticky serving
+        replica can hold one extended lease for its whole life, so
+        renewals — not Done — are its per-round channel. Whatever was
+        never shipped on a renewal is flushed to the iterator log at
+        exit and arrives with Done instead; the consumer dedupes by
+        the payload's (round, seq), so double delivery is harmless."""
+        self._measured_buffer.append(payload)
+
+    def _flush_measured_to_log(self) -> None:
+        """Exit path: unsent measured telemetry rides the Done report's
+        log channel (at-exit, and idempotent — the buffer drains)."""
+        buffered, self._measured_buffer = self._measured_buffer, []
+        for payload in buffered:
+            self.log_measurement(payload)
 
     def __next__(self):
         now = time.time()
@@ -409,10 +441,17 @@ class LeaseIterator:
         if init:
             max_steps, max_duration, extra_time = self._rpc.init()
         else:
+            # Piggyback buffered measured-serving telemetry on the
+            # renewal; cleared only after the RPC returned (a failed
+            # renewal keeps the deltas for the next attempt / the
+            # exit-path log flush — the consumer dedupes by seq).
+            shipping = list(self._measured_buffer)
             max_steps, max_duration, run_time_so_far, deadline = (
                 self._rpc.update_lease(self._steps, self._duration,
                                        self._lease.max_steps,
-                                       self._lease.max_duration))
+                                       self._lease.max_duration,
+                                       measured_reports=shipping or None))
+            del self._measured_buffer[:len(shipping)]
             extra_time = 0.0
             if self._duration + run_time_so_far > deadline:
                 # Deadline enforcement: scheduler says we have overrun 1.5x

@@ -132,10 +132,27 @@ def serve_scheduler(port: int, callbacks: Dict[str, Callable],
                                   max_duration=max_duration,
                                   extra_time=extra_time)
 
+    # Measured-serving telemetry rides the renewal heartbeat
+    # (UpdateLeaseRequest.measured_reports); handlers that predate the
+    # field (test stubs, chaos stubs) keep their 6-arg signature.
+    import inspect
+    try:
+        _ul_params = inspect.signature(callbacks["UpdateLease"]).parameters
+        update_lease_takes_reports = ("measured_reports" in _ul_params
+                                      or any(
+                                          p.kind is inspect.Parameter.VAR_KEYWORD
+                                          for p in _ul_params.values()))
+    except (KeyError, TypeError, ValueError):
+        update_lease_takes_reports = False
+
     def update_lease(request, context):
+        kwargs = {}
+        if update_lease_takes_reports and request.measured_reports:
+            kwargs["measured_reports"] = list(request.measured_reports)
         max_steps, max_duration, run_time_so_far, deadline = callbacks["UpdateLease"](
             JobIdPair(request.job_id), request.worker_id, request.steps,
-            request.duration, request.max_steps, request.max_duration)
+            request.duration, request.max_steps, request.max_duration,
+            **kwargs)
         return pb.UpdateLeaseResponse(
             max_steps=int(max_steps), max_duration=float(max_duration),
             run_time_so_far=float(run_time_so_far), deadline=float(deadline))

@@ -1441,9 +1441,22 @@ class PhysicalScheduler(Scheduler):
 
     def _update_lease_callback(self, job_id: JobIdPair, worker_id: int,
                                steps: int, duration: float, max_steps: int,
-                               max_duration: float):
-        """Renew a lease (reference: scheduler.py:4050-4180)."""
+                               max_duration: float, measured_reports=None):
+        """Renew a lease (reference: scheduler.py:4050-4180).
+
+        `measured_reports` (serving replicas only): sketch-delta wire
+        lines piggybacked on the renewal heartbeat — a sticky replica's
+        extended lease means Done only fires at drain, so renewals are
+        its per-round measured-telemetry channel. Ingested before any
+        early return below: the telemetry was measured regardless of
+        what this renewal decides."""
         with self._lock:
+            if (measured_reports
+                    and self._serving_tier is not None
+                    and job_id in self._serving_job_ids):
+                from ..serving import measured as measured_mod
+                for delta in measured_mod.find_reports(measured_reports):
+                    self._serving_tier.ingest_measured(job_id, delta)
             if job_id not in self.acct.jobs:
                 return (0, 0.0, 0.0, 0.0)
             if worker_id in self.workers.dead:
@@ -2219,6 +2232,13 @@ class PhysicalScheduler(Scheduler):
             # periodic flush is one atomic rewrite, same order of cost
             # as the compacting snapshot below.
             self._history.sample_round(self.rounds.num_completed_rounds)
+            if self._serving_tier is not None:
+                # Measured serving rows (per service, rounds with
+                # samples): the latency-calibration / mu-estimation
+                # training set, served as /history.json "serving".
+                for row in self._serving_tier.take_measured_rows():
+                    self._history.record_serving(
+                        row, self.rounds.num_completed_rounds)
         self._maybe_snapshot()
         if self._whatif is not None:
             # Pay only the state-copy cost under the lock (the

@@ -1997,6 +1997,20 @@ class Scheduler:
         # split so every line carries the greppable ITERATOR prefix.
         log_buffers = sorted(self._iterator_log_buffers.pop(job_id, []),
                              key=lambda u: u[0])
+        # Serving replicas piggyback measured request telemetry on the
+        # same log channel (serving/measured.py wire lines): route the
+        # deltas to the tier's per-service merge and keep them out of
+        # the human-readable timeline. Ingestion happens even for a
+        # drained replica's final report — the service outlives it.
+        measured_marker = None
+        if (self._serving_tier is not None and log_buffers
+                and job_id in self._serving_job_ids):
+            from ..serving import measured as measured_mod
+            measured_marker = measured_mod.MEASURED_REPORT_MARKER
+            for _w_id, blobs in log_buffers:
+                for blob in blobs:
+                    for delta in measured_mod.find_reports(blob):
+                        self._serving_tier.ingest_measured(job_id, delta)
         for j, m in enumerate(members):
             if not is_active[m]:
                 continue
@@ -2006,7 +2020,9 @@ class Scheduler:
                     continue
                 tl.extend(f"t={self.get_current_timestamp():.1f} "
                           f"ITERATOR worker={w_id} {line}"
-                          for line in blobs[j].splitlines())
+                          for line in blobs[j].splitlines()
+                          if measured_marker is None
+                          or measured_marker not in line)
 
         micro_task_succeeded = True
         agg_steps = [0] * len(members)

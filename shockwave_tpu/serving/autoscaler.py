@@ -20,6 +20,15 @@ replica target:
   may reserve ahead of the training planner, the knob that keeps
   training FTF inside the Shockwave envelope even under pathological
   spike traces.
+- **Measurement overrides the model**: when the physical replicas'
+  merged request telemetry (serving/measured.py) reports a p99 over
+  the SLO, the target escalates one replica above the committed level
+  that produced the breach — even when the analytic M/M/c model says
+  the pool is fine. Measured evidence of a breach beats a model that
+  predicted none; the escalation commits immediately (it is upward)
+  and decays through the ordinary patience window once measurement
+  recovers. Without measured samples (simulation, cold start) the
+  arithmetic is untouched.
 
 Pure state machine over (spec, clock); no wall time, no RNG — replays
 are bit-identical.
@@ -41,6 +50,12 @@ class AutoscalerConfig:
     min_requests_per_round: float = 0.5
     #: Fraction of total cluster chips serving may reserve (1.0 = all).
     max_cluster_fraction: float = 1.0
+    #: Measured samples a round must contribute before its measured
+    #: p99 / mu estimate may influence scaling (noise floor).
+    measured_min_samples: int = 8
+    #: Pseudo-sample weight of the analytic mu prior in the online
+    #: blend (serving/measured.ServiceMeasuredState).
+    mu_prior_weight: float = 64.0
 
     @classmethod
     def from_dict(cls, config: dict) -> "AutoscalerConfig":
@@ -63,10 +78,13 @@ class Autoscaler:
         self._pending_target: int = 0
 
     def target_replicas(self, peak_rate: float, mu: float, slo_p99_s: float,
-                        max_replicas: int, round_duration_s: float) -> int:
+                        max_replicas: int, round_duration_s: float,
+                        measured_p99_s: float = None) -> int:
         """Replica target for a round whose peak arrival rate is
         ``peak_rate`` req/s. Stateful: applies headroom, scale-to-zero,
-        and the scale-down patience window."""
+        and the scale-down patience window. ``measured_p99_s`` is the
+        last round's measured p99 when the replicas reported enough
+        samples (None otherwise — simulation and cold start)."""
         cfg = self.config
         if (max_replicas <= 0
                 or peak_rate * round_duration_s < cfg.min_requests_per_round):
@@ -76,6 +94,12 @@ class Autoscaler:
         else:
             raw = max(1, replicas_for_slo(peak_rate * cfg.headroom, mu,
                                           slo_p99_s, max_replicas))
+            if (measured_p99_s is not None and measured_p99_s > slo_p99_s
+                    and self._committed > 0):
+                # Measured breach at the committed level: the pool that
+                # produced those samples is demonstrably too small,
+                # whatever the model says — escalate one above it.
+                raw = min(max(raw, self._committed + 1), max_replicas)
         if raw >= self._committed:
             # Scale up (or hold): commit immediately, clear hysteresis.
             self._committed = raw

@@ -88,5 +88,16 @@ def replicas_for_slo(lam: float, mu: float, slo_p99_s: float,
     return max_replicas
 
 
+def mu_from_tokens_per_s(tokens_per_s: float,
+                         tokens_per_request: int) -> float:
+    """Per-replica service rate (requests/s) from a measured decode
+    throughput — the measured-path counterpart of
+    ``core/trace.serving_service_rate`` (which reads the declared
+    decode rate off the trace command). 0.0 when nothing was measured."""
+    if tokens_per_request <= 0 or tokens_per_s <= 0.0:
+        return 0.0
+    return tokens_per_s / tokens_per_request
+
+
 __all__ = ["SATURATED", "erlang_c", "latency_quantile", "p50_latency",
-           "p99_latency", "replicas_for_slo"]
+           "p99_latency", "replicas_for_slo", "mu_from_tokens_per_s"]

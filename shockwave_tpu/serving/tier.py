@@ -42,6 +42,7 @@ from ..obs import names as obs_names
 from .autoscaler import Autoscaler, AutoscalerConfig
 from .latency_model import p99_latency
 from .load import DiurnalLoad, Spike, seeded_spikes
+from .measured import ServiceMeasuredState
 
 logger = logging.getLogger("shockwave_tpu.serving")
 
@@ -80,7 +81,24 @@ class ServingService:
         self.arrival_ts = float(arrival_ts)
         self.lifetime_s = float(job._duration)
         self.slo_p99_s = float(job.SLO) if job.SLO is not None else 1.0
-        self.mu = serving_service_rate(job.command)
+        #: Declared (trace) per-replica service rate — the analytic
+        #: prior. `mu` is the live effective value: identical to the
+        #: prior until measured samples refine it (never in sim).
+        self.mu_analytic = serving_service_rate(job.command)
+        self.mu = self.mu_analytic
+        self.tokens_per_request = int(params.get("tokens_per_request", 1)
+                                      or 1)
+        self.measured = ServiceMeasuredState(
+            self.mu_analytic, self.tokens_per_request,
+            mu_prior_weight=autoscaler_config.mu_prior_weight)
+        #: Per-replica (round, seq) high-water of ingested deltas:
+        #: reports ride BOTH the renewal heartbeat and the Done log
+        #: (exit flush), and renewals retry on transport failure — the
+        #: seq stamp makes double delivery harmless.
+        self.measured_seen: Dict[int, Tuple[int, int]] = {}
+        #: Last accounted round's measured window (take_window output),
+        #: consumed by the NEXT round's scaling decision.
+        self.last_measured_window: Optional[dict] = None
         self.max_replicas = int(params.get("max_replicas", 8))
         self.load = _load_from_params(params, self.lifetime_s)
         self.autoscaler = Autoscaler(autoscaler_config)
@@ -113,11 +131,23 @@ class ServingService:
             return 1.0
         return self.requests_ok / self.requests_offered
 
+    def measured_p99_for_scaling(self,
+                                 min_samples: int) -> Optional[float]:
+        """The previous round's measured p99 when it carried enough
+        samples to act on, else None (analytic-only scaling)."""
+        window = self.last_measured_window
+        if window is None or window["requests"] < min_samples:
+            return None
+        return window["p99_s"]
+
     def summary(self) -> dict:
         return {
             "service": self.int_id,
             "slo_p99_s": self.slo_p99_s,
             "mu_requests_per_s": self.mu,
+            "mu_analytic_requests_per_s": self.mu_analytic,
+            "measured_requests": self.measured.requests_total,
+            "measured_p99_s": self.measured.sketch_total.quantile(0.99),
             "requests_offered": round(self.requests_offered, 2),
             "requests_within_slo": round(self.requests_ok, 2),
             "slo_attainment": round(self.attainment(), 6),
@@ -141,7 +171,7 @@ class ServingTier:
     #: dynamically. `_sched` is rebound once by `bind()` on restore.
     _EXTERNALLY_SYNCHRONIZED = frozenset({
         "services", "_replica_service", "_retired_unreaped",
-        "last_reserved", "_sched",
+        "last_reserved", "_sched", "_measured_rows",
     })
 
     def __init__(self, sched, config: Optional[dict] = None):
@@ -155,6 +185,9 @@ class ServingTier:
         #: worker_type -> chips reserved by the LAST plan_round (what
         #: _allocation_state subtracts from the cluster the LP sees).
         self.last_reserved: Dict[str, int] = {}
+        #: Measured per-round rows awaiting the telemetry history
+        #: (drained by take_measured_rows in the physical round loop).
+        self._measured_rows: List[dict] = []
 
     # The scheduler reference must not ride into snapshots/checkpoints
     # (it would drag a ghost scheduler copy along); restore re-binds.
@@ -209,6 +242,38 @@ class ServingTier:
         if svc is not None:
             svc.replicas.pop(job_id, None)
             svc.draining.pop(job_id, None)
+
+    def ingest_measured(self, job_id: JobIdPair, delta: dict) -> None:
+        """Fold one replica's measured-telemetry delta (shipped on its
+        Done heartbeat, serving/measured.py wire format) into its
+        service: merge the latency sketch, advance the token/request
+        counters, and refine the live `mu` estimate (analytic prior,
+        measurement takes over with evidence). Called under the
+        scheduler lock from the Done fold; never in simulation."""
+        service_id = self._replica_service.get(job_id.integer_job_id())
+        if service_id is None:
+            return
+        svc = self.services.get(service_id)
+        if svc is None:
+            return
+        stamp = (int(delta.get("round", -1)), int(delta.get("seq", -1)))
+        if stamp != (-1, -1):
+            last = svc.measured_seen.get(job_id.integer_job_id())
+            if last is not None and stamp <= last:
+                return      # duplicate delivery (renewal retry / Done replay)
+            svc.measured_seen[job_id.integer_job_id()] = stamp
+        try:
+            svc.measured.ingest(delta)
+        except (KeyError, ValueError, TypeError) as e:
+            logger.warning("dropping malformed measured delta from "
+                           "replica %s of service %d: %s", job_id,
+                           service_id, e)
+            return
+        svc.mu = svc.measured.mu_estimate()
+        requests = int(delta.get("requests", 0))
+        if requests > 0:
+            self._obs().inc(obs_names.SERVING_MEASURED_SAMPLES_TOTAL,
+                            amount=requests, service=svc.label)
 
     def force_retire(self, int_id: int, ts: float) -> None:
         """Journal replay of a service retirement (no planning runs
@@ -316,8 +381,14 @@ class ServingTier:
         cap = min(svc.max_replicas, max(budget, 0))
         # min(): the autoscaler's committed level may predate a budget
         # shrink (another service scaled up, chips died) — the cap wins.
+        # `svc.mu` is the measurement-refined service rate (== the
+        # analytic prior until replicas report); the measured p99 of
+        # the last accounted round escalates past a model that missed
+        # a breach (None without enough samples — always in sim).
         target = min(svc.autoscaler.target_replicas(
-            peak, svc.mu, svc.slo_p99_s, cap, round_s), cap)
+            peak, svc.mu, svc.slo_p99_s, cap, round_s,
+            measured_p99_s=svc.measured_p99_for_scaling(
+                self.autoscaler_config.measured_min_samples)), cap)
         svc.target = target
         active = len(svc.replicas)
         if target > active:
@@ -341,10 +412,20 @@ class ServingTier:
         index = svc.next_replica_index
         svc.next_replica_index += 1
         anchor = svc.job
+        # The replica's measured request clock needs two values the
+        # anchor command does not carry: the service lifetime (seeded
+        # spikes are drawn over it — the replica must place them where
+        # the analytic model does) and the service-relative spawn time
+        # (a replica spawned at the diurnal peak must sample peak load,
+        # not the t=0 trough). Journaled with the job, so replay
+        # reconstructs the same stream.
+        t_rel = max(sched.get_current_timestamp() - svc.arrival_ts, 0.0)
         replica = Job(
             job_id=None, job_type=anchor.job_type,
             command=(f"{anchor.command} --replica_of {svc.int_id} "
-                     f"--replica_index {index}"),
+                     f"--replica_index {index} "
+                     f"--service_lifetime_s {svc.lifetime_s:g} "
+                     f"--arrival_phase_s {t_rel:g}"),
             working_directory=anchor.working_directory,
             num_steps_arg=anchor.num_steps_arg,
             # Effectively unbounded step budget: a replica retires by
@@ -521,20 +602,48 @@ class ServingTier:
                 svc.rounds_violated += 1
             if n == 0 and svc.target == 0:
                 svc.rounds_at_zero += 1
-            svc.history.append(dict(
+            window = svc.measured.take_window()
+            svc.last_measured_window = window
+            history_row = dict(
                 round=sched.rounds.num_completed_rounds, t=round(now, 3),
                 target=svc.target, assigned=n, offered=round(offered, 3),
                 p99_s=(None if worst_p99 == float("inf")
                        else round(worst_p99, 6)),
-                ok=not violated))
-            if len(svc.history) > HISTORY_LIMIT:
-                del svc.history[: len(svc.history) - HISTORY_LIMIT]
+                ok=not violated)
             obs.set_gauge(obs_names.SERVING_REPLICAS, n, service=svc.label)
             obs.set_gauge(obs_names.SERVING_TARGET_REPLICAS, svc.target,
                           service=svc.label)
-            if worst_p99 != float("inf"):
+            saturated = worst_p99 == float("inf")
+            obs.set_gauge(obs_names.SERVING_SATURATED, int(saturated),
+                          service=svc.label)
+            if saturated:
+                # A saturated pool has no finite modeled p99: DROP the
+                # series rather than freeze it at its last healthy
+                # value (the stale-gauge bug) — the saturated gauge
+                # above is the round's latency story.
+                obs.remove_series(obs_names.SERVING_P99_SECONDS,
+                                  service=svc.label)
+            else:
                 obs.set_gauge(obs_names.SERVING_P99_SECONDS, worst_p99,
                               service=svc.label)
+            if window is not None:
+                self._export_measured(svc, window, worst_p99, round_s,
+                                      history_row, now)
+            elif svc.measured.has_samples:
+                # The service HAS measured before but this round saw no
+                # fresh samples (replicas quiet, draining, worker
+                # death): drop the window-scoped series rather than
+                # freeze a possibly-breaching round forever — the same
+                # stale-gauge rule as the saturated p99 above. The mu
+                # gauge stays: it is cumulative state, not a window.
+                for spec in (obs_names.SERVING_MEASURED_P50_SECONDS,
+                             obs_names.SERVING_MEASURED_P99_SECONDS,
+                             obs_names.SERVING_TOKENS_PER_S,
+                             obs_names.SERVING_MEASURED_VS_ANALYTIC_P99):
+                    obs.remove_series(spec, service=svc.label)
+            svc.history.append(history_row)
+            if len(svc.history) > HISTORY_LIMIT:
+                del svc.history[: len(svc.history) - HISTORY_LIMIT]
             obs.set_gauge(obs_names.SERVING_SLO_ATTAINMENT,
                           svc.attainment(), service=svc.label)
             if offered > 0:
@@ -546,6 +655,67 @@ class ServingTier:
                             slo="violated")
         obs.set_gauge(obs_names.SERVING_RESERVED_CHIPS,
                       self.reserved_total())
+
+    def _export_measured(self, svc: ServingService, window: dict,
+                         analytic_p99: float, round_s: float,
+                         history_row: dict, now: float) -> None:
+        """Export one service's measured round window: gauges, the
+        measured-vs-analytic calibration error, and the /history.json
+        training row (collected by the physical round loop through
+        `take_measured_rows`). Only ever reached when replicas shipped
+        samples — never in simulation."""
+        obs = self._obs()
+        tokens_per_s = window["tokens"] / round_s if round_s > 0 else 0.0
+        obs.set_gauge(obs_names.SERVING_MEASURED_P50_SECONDS,
+                      window["p50_s"], service=svc.label)
+        obs.set_gauge(obs_names.SERVING_MEASURED_P99_SECONDS,
+                      window["p99_s"], service=svc.label)
+        obs.set_gauge(obs_names.SERVING_TOKENS_PER_S, tokens_per_s,
+                      service=svc.label)
+        obs.set_gauge(obs_names.SERVING_MU_ESTIMATE, svc.mu,
+                      service=svc.label)
+        ratio = None
+        if analytic_p99 not in (float("inf"), 0.0):
+            ratio = window["p99_s"] / analytic_p99
+            obs.set_gauge(obs_names.SERVING_MEASURED_VS_ANALYTIC_P99,
+                          ratio, service=svc.label)
+        else:
+            # Saturated analytic model: no finite ratio exists — drop
+            # the series instead of freezing the last finite one.
+            obs.remove_series(obs_names.SERVING_MEASURED_VS_ANALYTIC_P99,
+                              service=svc.label)
+        history_row.update(
+            measured_p50_s=round(window["p50_s"], 6),
+            measured_p99_s=round(window["p99_s"], 6),
+            measured_requests=window["requests"],
+            tokens_per_s=round(tokens_per_s, 3),
+            mu_estimate=round(svc.mu, 6))
+        self._measured_rows.append({
+            "service": svc.int_id, "t": round(now, 3),
+            "requests": window["requests"],
+            "measured_p50_s": round(window["p50_s"], 6),
+            "measured_p99_s": round(window["p99_s"], 6),
+            "analytic_p99_s": (None if analytic_p99 == float("inf")
+                               else round(analytic_p99, 6)),
+            "measured_vs_analytic_p99": (None if ratio is None
+                                         else round(ratio, 4)),
+            "tokens_per_s": round(tokens_per_s, 3),
+            "mu_estimate": round(svc.mu, 6),
+            "mu_analytic": round(svc.mu_analytic, 6),
+        })
+        if len(self._measured_rows) > HISTORY_LIMIT:
+            # Bounded even when no history collector drains the rows
+            # (physical drive without --history).
+            del self._measured_rows[: len(self._measured_rows)
+                                    - HISTORY_LIMIT]
+
+    def take_measured_rows(self) -> List[dict]:
+        """Drain the measured per-round rows accumulated since the last
+        call — the physical round loop feeds them into the telemetry
+        history (`/history.json`), the mu-estimation training set
+        ROADMAP item 2 consumes. Caller holds the scheduler lock."""
+        rows, self._measured_rows = self._measured_rows, []
+        return rows
 
 
 __all__ = ["ServingTier", "ServingService", "WINDOW_SAMPLES"]

@@ -11,9 +11,17 @@ exits cooperatively at expiry — so "progress" reported to the scheduler
 is requests served, the serving tier's unit of work.
 
 Dispatched with the trace's `serving_command` (core/trace.py) plus the
-scheduler's --replica_of/--replica_index markers; load-curve flags are
-accepted (they parameterize the simulator's analytic twin) but only the
-decode-shape flags matter here.
+scheduler's --replica_of/--replica_index markers. The load-curve flags
+parameterize BOTH the simulator's analytic twin and this process's
+measured request clock: a seeded Poisson arrival stream drawn from the
+same `serving/load.py` curve (serving/measured.ArrivalClock, split
+round-robin across max_replicas) feeds a virtual queue whose service
+times are the MEASURED decode-step walls — so every step admits and
+completes concrete synthetic requests with admission->last-token
+latencies. Samples accumulate into a mergeable quantile sketch
+(obs/quantiles.py) and ship as compact deltas on the lease-renewal
+heartbeat (unsent ones flush to the iterator log at exit and ride
+Done), closing the autoscaler's measured-latency loop.
 """
 import os
 import sys
@@ -29,8 +37,15 @@ from shockwave_tpu.models.train_common import (common_parser,
                                                enable_compile_cache,
                                                parse_args)
 from shockwave_tpu.runtime.iterator import LeaseIterator
+from shockwave_tpu.serving.load import DiurnalLoad, Spike, seeded_spikes
+from shockwave_tpu.serving.measured import (ArrivalClock, ReplicaMeter,
+                                            derive_arrival_seed,
+                                            encode_report)
 
 THROUGHPUT_LOG_INTERVAL = 50
+#: Cap on the synthetic arrival stream (arrivals are generated lazily,
+#: so this only bounds a replica that outlives every realistic lease).
+ARRIVAL_HORIZON_S = 7 * 86400.0
 
 
 def build_parser():
@@ -53,6 +68,16 @@ def build_parser():
     p.add_argument("--spike_duration_s", type=float, default=1800.0)
     p.add_argument("--replica_of", type=int, default=None)
     p.add_argument("--replica_index", type=int, default=0)
+    # Measured request clock: seed override for the synthetic arrival
+    # stream (default derives deterministically from spike_seed +
+    # replica_index, so every dispatch of a replica replays the same
+    # requests); the tier appends the service lifetime (seeded spikes
+    # are drawn over it, matching the analytic model's placement) and
+    # the service-relative spawn offset (a replica spawned at the
+    # diurnal peak measures peak load, not the t=0 trough).
+    p.add_argument("--arrival_seed", type=int, default=None)
+    p.add_argument("--service_lifetime_s", type=float, default=None)
+    p.add_argument("--arrival_phase_s", type=float, default=0.0)
     # Decode model shape (defaults sized for a single chip).
     p.add_argument("--model_dim", type=int, default=128)
     p.add_argument("--model_layers", type=int, default=2)
@@ -119,11 +144,68 @@ def main():
     else:
         iterator = None
 
+    # Measured request clock: seeded synthetic arrivals from the same
+    # load curve the simulator's analytic twin reads, split round-robin
+    # across the service's replica slots. Each decode step's measured
+    # wall duration services one admitted batch on the virtual queue;
+    # latency sketch deltas ship on the iterator log (-> Done heartbeat).
+    spikes = tuple(Spike(*(float(x) for x in entry.split(":")))
+                   for entry in args.spike_at)
+    lifetime_s = (float(args.service_lifetime_s)
+                  if args.service_lifetime_s else ARRIVAL_HORIZON_S)
+    if args.spike_seed is not None and args.num_spikes > 0:
+        # Same draw the tier/simulator make (over the service LIFETIME,
+        # not the horizon): the measured stream and the analytic model
+        # must place the seeded spikes identically.
+        spikes = spikes + seeded_spikes(
+            int(args.spike_seed), lifetime_s, int(args.num_spikes),
+            float(args.spike_mult), float(args.spike_duration_s))
+    load = DiurnalLoad(base_rps=args.base_rps,
+                       peak_rps=max(args.peak_rps, args.base_rps),
+                       period_s=args.period_s, phase_s=args.phase_s,
+                       spikes=spikes)
+    arrival_seed = (args.arrival_seed if args.arrival_seed is not None
+                    else derive_arrival_seed(args.spike_seed,
+                                             args.replica_index))
+    horizon_s = max(min(lifetime_s, ARRIVAL_HORIZON_S)
+                    - float(args.arrival_phase_s), 0.0)
+    meter = ReplicaMeter(
+        ArrivalClock(load, arrival_seed, horizon_s,
+                     replica_index=args.replica_index,
+                     num_replicas=max(args.max_replicas, 1),
+                     phase_s=float(args.arrival_phase_s)),
+        batch_size=args.batch_size,
+        tokens_per_request=args.tokens_per_request)
+
     served = 0
     window_start = time.time()
     window_steps = 0
     last = None
     budget = args.num_steps
+
+    report_seq = 0
+    dispatch_round = int(os.environ.get("SWTPU_ROUND_ID", "0") or 0)
+
+    def meter_window() -> None:
+        """Account the just-synced window: JAX dispatch is async, so
+        per-step walls are only honest AFTER a device sync — amortize
+        the window's synced wall evenly over its steps, then queue the
+        sketch delta for the next lease renewal (unsent deltas flush
+        to the iterator log at exit and ride Done instead; the (round,
+        seq) stamp lets the tier dedupe double delivery)."""
+        nonlocal window_start, window_steps, report_seq
+        now = time.time()
+        if window_steps > 0:
+            per_step = max(now - window_start, 0.0) / window_steps
+            for _ in range(window_steps):
+                meter.step(per_step)
+        window_start, window_steps = now, 0
+        delta = meter.take_delta()
+        if delta is not None and iterator is not None:
+            report_seq += 1
+            delta["round"] = dispatch_round
+            delta["seq"] = report_seq
+            iterator.queue_measurement(encode_report(delta))
 
     def serve_one(batch):
         nonlocal last, served, window_steps, window_start
@@ -136,7 +218,7 @@ def main():
             jax.block_until_ready(last)
             print(f"[THROUGHPUT_ESTIMATION]\t{time.time()}\t{served}",
                   flush=True)
-            window_start, window_steps = time.time(), 0
+            meter_window()
 
     try:
         if iterator is not None:
@@ -155,6 +237,7 @@ def main():
     finally:
         if last is not None:
             jax.block_until_ready(last)
+        meter_window()                   # final partial-window delta
     print(f"SERVED {served} request batches "
           f"(x{args.batch_size} requests, {args.tokens_per_request} "
           f"tokens each)", flush=True)

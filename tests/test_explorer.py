@@ -50,7 +50,14 @@ def _locked_workload(n_ops=25):
     t2 = threading.Thread(target=body, args=(a, b), name="exp-t2")
     t1.start(), t2.start()
     t1.join(), t2.join()
-    return explorer.active().trace()
+    # Only this workload's threads: a sanitize-enabled scheduler from an
+    # earlier test may have a background thread in its (bounded, <=1 s)
+    # post-shutdown linger whose lock ops would otherwise pollute the
+    # trace — decisions are per-thread pure (asserted below), so the
+    # filter cannot mask a determinism break.
+    return {name: events
+            for name, events in explorer.active().trace().items()
+            if name.startswith("exp-")}
 
 
 class TestExplorerDeterminism:

@@ -1246,3 +1246,111 @@ class TestCanonicalObsDeterminism:
             recorded = strip_wall(json.load(f))
         assert enabled == recorded
         assert enabled["makespan"] == 33207.58
+
+
+# ----------------------------------------------------------------------
+# Mergeable quantile sketch (obs/quantiles.py)
+# ----------------------------------------------------------------------
+
+class TestQuantileSketch:
+    def _sketch(self, values):
+        from shockwave_tpu.obs.quantiles import QuantileSketch
+        s = QuantileSketch()
+        for v in values:
+            s.add(v)
+        return s
+
+    def test_quantile_bounded_relative_error(self):
+        from shockwave_tpu.obs.quantiles import GAMMA
+        import numpy as np
+        rng = np.random.RandomState(3)
+        values = list(rng.exponential(0.2, 5000))
+        s = self._sketch(values)
+        for q in (0.5, 0.9, 0.99):
+            exact = float(np.quantile(values, q, method="higher"))
+            got = s.quantile(q)
+            # Upper bucket edge: never under-reports by more than one
+            # bucket, never over-reports by more than the bucket width.
+            assert exact / GAMMA <= got <= exact * GAMMA * GAMMA
+
+    def test_empty_and_mean(self):
+        from shockwave_tpu.obs.quantiles import QuantileSketch
+        s = QuantileSketch()
+        assert s.quantile(0.99) is None
+        assert s.mean() is None
+        s.add(0.25)
+        assert s.mean() == 0.25
+
+    def test_merge_commutative_and_associative(self):
+        """Exact merge algebra: any association/order of merges yields
+        the same sketch — the property that lets shards arrive in any
+        order on the heartbeat path."""
+        from shockwave_tpu.obs.quantiles import QuantileSketch, merge_all
+        import numpy as np
+        rng = np.random.RandomState(7)
+        parts = [self._sketch(rng.exponential(s * 0.1 + 0.01, 400))
+                 for s in range(4)]
+        ab_cd = merge_all([merge_all(parts[:2]), merge_all(parts[2:])])
+        dcba = merge_all(parts[::-1])
+        one_by_one = QuantileSketch()
+        for p in parts:
+            one_by_one.merge(p)
+        assert ab_cd == dcba == one_by_one
+        assert ab_cd.encode() == dcba.encode() == one_by_one.encode()
+
+    def test_byte_deterministic_across_shard_orders(self):
+        """Every permutation of shard arrival order must ENCODE
+        byte-identically (the CI cmp contract), not just compare
+        equal."""
+        import itertools
+
+        import numpy as np
+
+        from shockwave_tpu.obs.quantiles import merge_all
+        rng = np.random.RandomState(11)
+        shards = [self._sketch(rng.exponential(0.1, 100))
+                  for _ in range(3)]
+        encodings = {merge_all([shards[i] for i in order]).encode()
+                     for order in itertools.permutations(range(3))}
+        assert len(encodings) == 1
+
+    def test_wire_round_trip_and_validation(self):
+        import pytest as _pytest
+
+        from shockwave_tpu.obs.quantiles import QuantileSketch
+        s = self._sketch([0.01, 0.5, 2.0, 2.0])
+        rt = QuantileSketch.decode(s.encode())
+        assert rt == s and rt.count == 4
+        with _pytest.raises(ValueError):
+            QuantileSketch.from_payload({"v": 99, "b": [], "n": 0, "s": 0})
+        with _pytest.raises(ValueError):
+            QuantileSketch.from_payload(
+                {"v": 1, "b": [[3, 2]], "n": 5, "s": 0.0})
+
+    def test_clamping_at_layout_edges(self):
+        from shockwave_tpu.obs.quantiles import (MAX_BUCKET, MAX_VALUE,
+                                                 MIN_VALUE, bucket_index)
+        assert bucket_index(0.0) == 0
+        assert bucket_index(MIN_VALUE / 10) == 0
+        assert bucket_index(MAX_VALUE * 10) == MAX_BUCKET
+
+
+class TestTelemetryHistoryServingRing:
+    def test_record_serving_rides_payload_and_reload(self, tmp_path):
+        """Measured-serving rows land in the /history.json payload and
+        survive a flush/reload cycle (the crash-safe training set)."""
+        from shockwave_tpu.obs.history import TelemetryHistory
+        from shockwave_tpu.obs.registry import MetricsRegistry
+        clock = SteppingClock()
+        path = str(tmp_path / "history.json")
+        hist = TelemetryHistory(MetricsRegistry(clock=clock), clock, path)
+        row = {"service": 0, "measured_p99_s": 0.42,
+               "analytic_p99_s": 0.3, "tokens_per_s": 1500.0,
+               "mu_estimate": 23.4, "mu_analytic": 25.0, "requests": 80}
+        hist.record_serving(row, round_id=7)
+        payload = hist.payload()
+        assert payload["serving"] == [dict(row, round=7)]
+        hist.flush()
+        reloaded = TelemetryHistory(MetricsRegistry(clock=clock), clock,
+                                    path)
+        assert reloaded.payload()["serving"] == [dict(row, round=7)]

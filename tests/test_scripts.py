@@ -314,3 +314,78 @@ class TestGraftEntry:
             env=ambient_accelerator_env())
         assert out.returncode == 0, out.stderr[-2000:]
         assert "dryrun_multichip(8)" in out.stdout
+
+
+class TestServingDecodeBench:
+    def test_smoke_gate_and_row_shape(self, tmp_path):
+        """bench_serving_decode honors --smoke and emits the bench.py
+        row fields (the ROADMAP tokens/s-per-chip number)."""
+        out_file = tmp_path / "decode.json"
+        out = run_script(["scripts/microbenchmarks/bench_serving_decode.py",
+                          "--smoke", "--steps", "4", "--warmup", "1",
+                          "--batch_size", "4", "--tokens_per_request", "16",
+                          "--min_tokens_per_s", "50",
+                          "--output", str(out_file)])
+        row = json.loads(out.strip().splitlines()[-1])
+        for key in ("tokens_per_s", "tokens_per_s_per_chip",
+                    "requests_per_s", "backend"):
+            assert key in row
+        assert row["tokens_per_s"] > 50
+        assert json.loads(out_file.read_text())["bench"] == "serving_decode"
+
+    def test_smoke_fails_below_floor(self):
+        from conftest import cpu_subprocess_env
+        out = subprocess.run(
+            [sys.executable,
+             "scripts/microbenchmarks/bench_serving_decode.py", "--smoke",
+             "--steps", "2", "--warmup", "1", "--batch_size", "2",
+             "--tokens_per_request", "8", "--min_tokens_per_s", "1e15"],
+            capture_output=True, text=True, cwd=REPO,
+            env=cpu_subprocess_env())
+        assert out.returncode == 1
+        assert "SMOKE FAIL" in out.stderr
+
+
+class TestServingMeasuredCalibrationDriver:
+    def test_byte_stable_and_envelope_checked(self, tmp_path):
+        """Two runs of the calibration study produce byte-identical
+        artifacts (the CI cmp gate) and pass their own envelope
+        --check; coverage > 0 rides in the artifact."""
+        args = ["scripts/drivers/serving_measured_calibration.py",
+                "--rhos", "0.4,0.8", "--replicas", "1,2",
+                "--horizon_s", "400", "--check"]
+        a, b = tmp_path / "cal_a.json", tmp_path / "cal_b.json"
+        run_script(args + ["--out", str(a)])
+        run_script(args + ["--out", str(b)])
+        assert a.read_bytes() == b.read_bytes()
+        doc = json.loads(a.read_text())
+        assert doc["measured_sample_coverage"] > 0
+        assert doc["merge_order_independent"] is True
+        assert len(doc["rows"]) == 4
+
+    def test_check_fails_outside_envelope(self, tmp_path):
+        from conftest import cpu_subprocess_env
+        out = subprocess.run(
+            [sys.executable,
+             "scripts/drivers/serving_measured_calibration.py",
+             "--rhos", "0.4", "--replicas", "4", "--horizon_s", "300",
+             "--envelope", "0.9:1.1", "--check",
+             "--out", str(tmp_path / "cal.json")],
+            capture_output=True, text=True, cwd=REPO,
+            env=cpu_subprocess_env())
+        assert out.returncode == 1
+        assert "CHECK FAIL" in out.stderr
+
+    def test_committed_artifact_reproduces(self, tmp_path):
+        """The committed calibration study is exactly what the driver
+        produces at its defaults (minus the loopback section, which CI
+        exercises live)."""
+        committed_path = os.path.join(REPO, "reproduce", "serving",
+                                      "measured_calibration.json")
+        committed = json.loads(open(committed_path).read())
+        out_path = tmp_path / "cal.json"
+        run_script(["scripts/drivers/serving_measured_calibration.py",
+                    "--out", str(out_path)])
+        fresh = json.loads(out_path.read_text())
+        committed.pop("loopback", None)
+        assert fresh == committed
