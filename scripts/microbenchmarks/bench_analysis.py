@@ -42,6 +42,12 @@ def main():
                    help="cold full-run ceiling (parse + graph + passes)")
     p.add_argument("--max_warm_s", type=float, default=3.0,
                    help="warm (cached-index) full-run ceiling")
+    p.add_argument("--max_lockflow_warm_s", type=float, default=0.5,
+                   help="warm per-pass ceiling for EACH of the "
+                        "deadlock and hold-discipline passes (the "
+                        "shared lockflow dataflow is memoized on the "
+                        "index, so warm reruns must be re-derivation "
+                        "cost only)")
     p.add_argument("--output", default=None,
                    help="also write the JSON record here")
     args = p.parse_args()
@@ -86,6 +92,13 @@ def main():
         if warm_s > args.max_warm_s:
             failures.append(f"warm wall {warm_s:.2f}s > "
                             f"{args.max_warm_s}s")
+        # timing holds the LAST (warm) run's per-pass walls.
+        for lockflow_pass in ("deadlock", "hold-discipline"):
+            wall = timing.get(lockflow_pass, {}).get("wall_s", 0.0)
+            if wall > args.max_lockflow_warm_s:
+                failures.append(
+                    f"{lockflow_pass} warm wall {wall:.3f}s > "
+                    f"{args.max_lockflow_warm_s}s")
         if findings:
             failures.append(f"{len(findings)} unexpected finding(s)")
         if failures:

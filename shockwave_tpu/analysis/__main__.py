@@ -12,10 +12,19 @@ finding, so stale exceptions cannot rot in place.
 ``--json`` emits a machine-readable report: the findings list plus a
 per-pass ``{id, findings, wall_s}`` timing table (the findings content
 is deterministic — byte-identical across runs; wall times are
-telemetry). ``--list`` runs each pass once to report its wall beside
-its description. The parsed-AST index (and the concurrency passes'
-shared call graph) is cached process-wide with mtime validation, so
-repeated runs parse each file once.
+telemetry). ``--sarif`` emits the findings as a SARIF 2.1.0 log for
+code-scanning UIs (CI uploads it from the analysis-smoke job).
+``--list`` runs each pass once to report its wall beside its
+description. The parsed-AST index (and the concurrency passes' shared
+call graph) is cached process-wide with mtime validation, so repeated
+runs parse each file once.
+
+Two lockflow-specific modes skip the passes entirely:
+``--lock-graph`` prints the static lock-order graph as JSON, and
+``--assert-contains RUNTIME.json`` checks that a runtime graph dumped
+by the sanitizer (``SWTPU_SANITIZE_GRAPH_OUT``) is a subgraph of the
+static one — the runtime ⊆ static containment gate. Exit 1 names any
+runtime edge the static analysis missed.
 
 The tier-1 gate (tests/test_analysis.py) runs exactly this entry
 point, so CI and a local ``scripts/utils/check.py`` see the same
@@ -83,6 +92,83 @@ def run(root: Optional[str] = None,
     return run_timed(root=root, select=select)[0]
 
 
+def sarif_report(findings: List[Finding]) -> dict:
+    """The findings as a SARIF 2.1.0 log (code-scanning upload shape).
+
+    One rule per pass id (description = the pass docstring's first
+    line); every finding is an ``error``-level result. Deterministic:
+    rules sorted by id, results already location-sorted by the caller.
+    """
+    rules = []
+    for name, fn in sorted(ALL_PASSES.items()):
+        first_line = (fn.__doc__ or name).strip().splitlines()[0]
+        rules.append({
+            "id": name,
+            "shortDescription": {"text": first_line},
+        })
+    rules.append({
+        "id": SUPPRESSION_AUDIT_ID,
+        "shortDescription": {
+            "text": check_suppression_audit.__doc__
+            .strip().splitlines()[0]},
+    })
+    results = [{
+        "ruleId": f.pass_id,
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": f.line},
+            },
+        }],
+    } for f in findings]
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "swtpu-check",
+                "informationUri":
+                    "https://github.com/shockwave-tpu/shockwave-tpu",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+
+
+def assert_contains(root: Optional[str], runtime_path: str) -> int:
+    """The containment gate: every lock-order edge observed at runtime
+    must appear in the static lock-order graph. Returns an exit code;
+    prints the verdict (and any missing edges) to stdout/stderr."""
+    from .lockflow import static_lock_order_graph
+    try:
+        with open(runtime_path, "r", encoding="utf-8") as f:
+            runtime = json.load(f)
+    except (OSError, ValueError) as exc:
+        print(f"cannot read runtime graph {runtime_path!r}: {exc}",
+              file=sys.stderr)
+        return 2
+    index = cached_index(root or default_root(),
+                         include_dirs=DEFAULT_INCLUDE_DIRS,
+                         exclude_globs=DEFAULT_EXCLUDE_GLOBS)
+    static = static_lock_order_graph(index)
+    runtime_edges = set(runtime.get("edges", []))
+    missing = sorted(runtime_edges - set(static["edges"]))
+    if missing:
+        print("runtime lock-order edges NOT in the static graph "
+              "(the analyzer is blind to a real acquisition order):",
+              file=sys.stderr)
+        for edge in missing:
+            print(f"  {edge}", file=sys.stderr)
+        return 1
+    print(f"containment OK: {len(runtime_edges)} runtime edge(s) "
+          f"⊆ {len(static['edges'])} static edge(s)")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m shockwave_tpu.analysis",
@@ -100,7 +186,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--json", action="store_true",
                         help="emit a JSON report (findings + per-pass "
                              "wall) instead of text")
+    parser.add_argument("--sarif", action="store_true",
+                        help="emit the findings as a SARIF 2.1.0 log "
+                             "(for code-scanning upload)")
+    parser.add_argument("--lock-graph", action="store_true",
+                        help="print the static lock-order graph as "
+                             "JSON and exit (no passes run)")
+    parser.add_argument("--assert-contains", metavar="RUNTIME_JSON",
+                        default=None,
+                        help="check that the runtime order graph "
+                             "dumped by SWTPU_SANITIZE_GRAPH_OUT is a "
+                             "subgraph of the static one; exit 1 on "
+                             "any uncovered runtime edge")
     args = parser.parse_args(argv)
+
+    if args.lock_graph:
+        from .lockflow import static_lock_order_graph
+        index = cached_index(args.root or default_root(),
+                             include_dirs=DEFAULT_INCLUDE_DIRS,
+                             exclude_globs=DEFAULT_EXCLUDE_GLOBS)
+        print(json.dumps(static_lock_order_graph(index),
+                         indent=1, sort_keys=True))
+        return 0
+
+    if args.assert_contains:
+        return assert_contains(args.root, args.assert_contains)
 
     if args.list:
         _, timing = run_timed(root=args.root)
@@ -133,7 +243,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
 
     findings, timing = run_timed(root=args.root, select=select)
-    if args.json:
+    if args.sarif:
+        print(json.dumps(sarif_report(findings), indent=1,
+                         sort_keys=True))
+    elif args.json:
         report = {
             "findings": [{"file": f.path, "line": f.line,
                           "pass": f.pass_id, "message": f.message}

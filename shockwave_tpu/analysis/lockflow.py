@@ -1,0 +1,950 @@
+"""Interprocedural held-locks dataflow: static deadlock & blocking-
+under-lock detection, cross-checked against the runtime sanitizer.
+
+PR 14's race detector proves every cross-thread field *holds a* lock;
+this module proves two properties about the locks themselves:
+
+- **deadlock** — every acquire-while-holding site (and every call made
+  with a lock held whose callees transitively acquire more locks)
+  contributes an edge to ONE static lock-order graph. A cycle in that
+  graph reachable from two or more thread roots (or one self-concurrent
+  root — a gRPC/HTTP handler pool) is a deadlock an unlucky
+  interleaving can hit, including interleavings no explorer seed
+  schedules. Lock identities are the sanitizer's display names
+  (``maybe_wrap(lock, "PhysicalScheduler._lock")``), so the runtime
+  order graph the sanitizer exports (``SWTPU_SANITIZE_GRAPH_OUT``) is
+  directly comparable: CI asserts **runtime edges ⊆ static edges**
+  every explorer run — the dynamic tool audits the static tool's
+  soundness.
+
+- **hold-discipline** — a taxonomy of blocking operations (gRPC stub
+  methods and the ``runtime/clients.py`` wrappers, ``os.fsync``,
+  subprocess ``wait``/``communicate``, ``time.sleep``, timeout-less
+  ``Condition.wait``, queue/socket ops, the planner MILP solve) is a
+  finding whenever one is statically reachable with any lock held. A
+  blocking call under a lock is a latency cliff for every thread that
+  wants that lock — and under the scheduler ``_cv`` it stalls the round
+  pipeline the paper's restart-overhead numbers depend on.
+
+Verdicts can be *documented* instead of restructured, mirroring the
+race detector's ``_EXTERNALLY_SYNCHRONIZED``:
+
+- ``_LOCK_ORDER_JUSTIFIED = frozenset({"A->B", ...})`` (class body) —
+  the named directed edges are sanctioned; a cycle is reported only if
+  at least one of its edges is NOT justified. Stale entries (naming an
+  edge the analysis no longer sees) are themselves findings.
+- ``_HOLD_DISCIPLINE_JUSTIFIED = frozenset({"method:kind", ...})``
+  (class body; ``"method:*"`` covers every kind) — the named method may
+  perform that class of blocking call under a lock, with the
+  declaration's comment carrying the justification (e.g. a bounded-
+  deadline RPC that is part of the dispatch protocol). Stale entries
+  are findings too.
+
+The dataflow itself: for every function in the memoized call graph,
+a lexical walk folds the held-lock set through ``with self._lock:``
+frames, ``@requires_lock`` contracts (implies the receiver's canonical
+``_lock``), Condition aliasing (``_cv`` ≡ ``_lock``), and explicit
+statement-level ``self._cv.release()`` / ``.acquire()`` toggles (the
+release-sleep-reacquire idiom in ``_finish_round``). Acquire facts and
+blocking facts then propagate bottom-up through a fixpoint over the
+call graph, so "calls a helper that fsyncs" is the same finding as
+fsyncing inline. Thread-root reachability (analysis/threads.py) scopes
+findings to code a real thread can execute.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .core import (Finding, RepoIndex, SourceFile, call_name, const_str,
+                   decorated_requires_lock, finding, is_self_attr,
+                   literal_str_set)
+from .threads import (CALLBACK_ROOT_KWARGS, RPC_SERVE_FUNCS,
+                      SELF_CONCURRENT_KINDS, CallGraph, FuncInfo, FuncKey,
+                      discover_thread_roots)
+
+PASS_DEADLOCK = "deadlock"
+PASS_HOLD = "hold-discipline"
+
+#: Class-body registry of sanctioned lock-order edges ("A->B" strings,
+#: sanitizer display names).
+ORDER_REGISTRY_NAME = "_LOCK_ORDER_JUSTIFIED"
+#: Class-body registry of sanctioned blocking-under-lock sites
+#: ("method:kind", or "method:*" for every kind).
+HOLD_REGISTRY_NAME = "_HOLD_DISCIPLINE_JUSTIFIED"
+
+#: Mirrors races.DEFAULT_LOCK_ATTRS: honored as locks even without a
+#: detected constructor assignment.
+DEFAULT_LOCK_ATTRS = frozenset({"_lock", "_cv"})
+
+#: RPC wrapper methods looked up BY NAME when the receiver cannot be
+#: resolved (clients pulled out of dicts: `self._worker_connections[w]`,
+#: `host["client"]`). Deliberately excludes generic names like "reset"
+#: or "shutdown" — `self.breaker.reset()` is not an RPC.
+RPC_FALLBACK_METHODS = frozenset({
+    "run_job", "kill_job", "notify_done", "register_worker",
+    "update_lease", "ping",
+})
+
+#: Known blocking sinks seeded by (file, bare function name, kind):
+#: the resolver reaches these through normal call edges, and the
+#: fixpoint then carries the fact to every caller.
+BLOCKING_SINKS: Tuple[Tuple[str, FrozenSet[str], str], ...] = (
+    ("shockwave_tpu/runtime/resilience.py",
+     frozenset({"call_with_retry"}), "rpc"),
+    ("shockwave_tpu/shockwave/milp.py",
+     frozenset({"plan_schedule", "_solve"}), "solve"),
+)
+
+#: The same sinks BY NAME, for call sites the resolver cannot follow
+#: (cross-module `from .milp import plan_schedule` — module functions
+#: resolve per-file only). A call to one of these names that resolves
+#: to nothing still records the blocking fact.
+SINK_NAME_KINDS = {
+    "call_with_retry": "rpc",
+    "plan_schedule": "solve",
+    "_solve": "solve",
+}
+
+#: Callees whose *blocking* facts are NOT propagated to callers (their
+#: acquire facts still are). One entry today: `_emit_audit` events ride
+#: DurabilityLayer.record's sync=False non-fsync path by design
+#: (physical.py documents it at the call site), so attributing an
+#: fsync to every audit emitter would be a false positive.
+FACT_STOP_FUNCS = frozenset({"_emit_audit"})
+
+#: Human-readable blurb per blocking kind, for the finding message.
+KIND_BLURB = {
+    "rpc": "a gRPC call",
+    "fsync": "an fsync-backed durable write",
+    "solve": "a MILP solve",
+    "sleep": "time.sleep",
+    "cv-wait": "a timeout-less Condition.wait on a DIFFERENT lock",
+    "event-wait": "a timeout-less Event.wait",
+    "wait": "a timeout-less .wait()",
+    "subprocess": "a subprocess wait/communicate",
+    "queue": "a blocking queue op",
+    "socket": "a blocking socket op",
+}
+
+
+# ----------------------------------------------------------------------
+# Per-function facts
+# ----------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class _Acquire:
+    lock: str
+    line: int
+    held_before: FrozenSet[str]
+
+
+@dataclass(frozen=True)
+class _CallSite:
+    targets: Tuple[FuncKey, ...]
+    line: int
+    held: FrozenSet[str]
+
+
+@dataclass(frozen=True)
+class _Prim:
+    kind: str
+    detail: str
+    line: int
+    held: FrozenSet[str]
+    #: For cv-wait: the lock the condition wraps (waiting on your OWN
+    #: cv releases it — only ADDITIONAL held locks are a finding).
+    cv_lock: Optional[str] = None
+
+
+@dataclass
+class _Facts:
+    acquires: List[_Acquire] = field(default_factory=list)
+    calls: List[_CallSite] = field(default_factory=list)
+    prims: List[_Prim] = field(default_factory=list)
+    #: Locks this function acquires anywhere (for callee summaries).
+    acquired_locks: Set[str] = field(default_factory=set)
+    #: Locks held at function entry (@requires_lock contract).
+    entry_held: FrozenSet[str] = frozenset()
+
+
+@dataclass(frozen=True)
+class _BFact:
+    """One transitive blocking fact in a function's summary."""
+    kind: str
+    detail: str
+    cv_lock: Optional[str]
+    #: Locks the fact's path EXPLICITLY RELEASED before blocking (the
+    #: release-sleep-reacquire idiom): a caller holding one of these
+    #: is not actually holding it at the blocking site.
+    shed: FrozenSet[str]
+    #: Locks already reported as held over this fact deeper in the
+    #: chain: a caller re-holding one adds nothing new.
+    blamed: FrozenSet[str]
+
+
+def _problem_locks(kind: str, cv_lock: Optional[str],
+                   held: FrozenSet[str]) -> FrozenSet[str]:
+    """The held locks that make a blocking fact a finding: waiting on
+    your OWN condition releases its lock, so only other locks count."""
+    if kind == "cv-wait" and cv_lock is not None:
+        return held - {cv_lock}
+    return held
+
+
+# ----------------------------------------------------------------------
+# Lock identity
+# ----------------------------------------------------------------------
+
+def _family(graph: CallGraph, cls: str) -> List[str]:
+    out = list(graph.mro(cls))
+    for sub in graph.subclasses(cls):
+        if sub not in out:
+            out.append(sub)
+    return out
+
+
+def _is_lock_attr(graph: CallGraph, cls: str, attr: str) -> bool:
+    if attr in DEFAULT_LOCK_ATTRS:
+        return True
+    return any(graph.sync_fields.get((name, attr)) == "lock"
+               for name in _family(graph, cls))
+
+
+def _sync_kind(graph: CallGraph, cls: str, attr: str) -> Optional[str]:
+    for name in graph.mro(cls):
+        kind = graph.sync_fields.get((name, attr))
+        if kind is not None:
+            return kind
+    return None
+
+
+def lock_display(graph: CallGraph, cls: str, attr: str) -> str:
+    """The sanitizer display name for `cls.attr`: the `maybe_wrap`
+    label when one exists anywhere in the class family, else
+    ``Class._attr`` anchored at the family member that declares the
+    lock (so `Scheduler._cv` and `PhysicalScheduler._lock` are ONE
+    graph node, matching the one runtime lock object)."""
+    canon = graph.canonical_lock(cls, attr)
+    fam = _family(graph, cls)
+    for name in fam:
+        label = graph.lock_names.get((name, canon))
+        if label is not None:
+            return label
+    for name in fam:
+        if graph.sync_fields.get((name, canon)) == "lock":
+            return f"{name}.{canon}"
+    return f"{cls}.{canon}"
+
+
+def _module_locks(src: SourceFile) -> Dict[str, str]:
+    """Top-level `VAR = threading.Lock()/RLock()/Condition()` in one
+    module: var name -> display name (`file.py:VAR`)."""
+    out: Dict[str, str] = {}
+    for node in src.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        name = call_name(node.value)
+        tail = name.rsplit(".", 1)[-1]
+        if tail in ("Lock", "RLock", "Condition"):
+            var = node.targets[0].id
+            out[var] = f"{src.rel}:{var}"
+    return out
+
+
+# ----------------------------------------------------------------------
+# Registries (family-wide, mirroring races._class_registry)
+# ----------------------------------------------------------------------
+
+def _harvest_registry(graph: CallGraph, cls: str, registry_name: str
+                      ) -> Dict[str, Tuple[SourceFile, int]]:
+    """Registry entries declared anywhere in the class family:
+    entry -> (declaring source, declaration line)."""
+    out: Dict[str, Tuple[SourceFile, int]] = {}
+    for name in _family(graph, cls):
+        info = graph.classes.get(name)
+        if info is None:
+            continue
+        for stmt in info.node.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == registry_name):
+                declared = literal_str_set(stmt.value)
+                for entry in declared or ():
+                    out.setdefault(entry, (info.src, stmt.lineno))
+    return out
+
+
+# ----------------------------------------------------------------------
+# The held-locks walk (one function)
+# ----------------------------------------------------------------------
+
+#: Local import-alias map for the two modules the taxonomy names
+#: directly (lockflow must not import passes.py — circular).
+_TAXONOMY_MODULES = {"time", "os"}
+
+
+def _local_aliases(tree: ast.AST) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in _TAXONOMY_MODULES:
+                    aliases[alias.asname or alias.name] = alias.name
+        elif (isinstance(node, ast.ImportFrom)
+              and node.module in _TAXONOMY_MODULES):
+            for alias in node.names:
+                aliases[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}")
+    return aliases
+
+
+def _canonical_name(name: str, aliases: Dict[str, str]) -> str:
+    head, _, rest = name.partition(".")
+    base = aliases.get(head)
+    if base is None:
+        return name
+    return f"{base}.{rest}" if rest else base
+
+
+def _has_timeout(node: ast.Call) -> bool:
+    """`.wait()`/`.get()` with any positional arg or a timeout=
+    keyword is bounded — not in the blocking taxonomy."""
+    if node.args:
+        return True
+    return any(kw.arg == "timeout" for kw in node.keywords)
+
+
+def _queue_get_nonblocking(node: ast.Call) -> bool:
+    """`q.get(False)` / `q.get(block=False)` / `q.get_nowait()`."""
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and node.args[0].value is False:
+        return True
+    return any(kw.arg == "block"
+               and isinstance(kw.value, ast.Constant)
+               and kw.value.value is False
+               for kw in node.keywords)
+
+
+class _FunctionScanner:
+    """Folds the held-lock set through one function body, recording
+    acquires, resolvable call sites, and blocking primitives."""
+
+    def __init__(self, analysis: "LockflowAnalysis", fi: FuncInfo):
+        self.a = analysis
+        self.graph = analysis.graph
+        self.fi = fi
+        self.cls = fi.cls
+        self.facts = _Facts()
+        self.aliases = analysis.aliases_for(fi.src)
+        self.mod_locks = analysis.module_locks_for(fi.src)
+        self.local_types = self.graph._local_types(fi)
+
+    # -- lock identity of an expression --------------------------------
+
+    def lock_of(self, expr: ast.AST) -> Optional[str]:
+        """Display name when `expr` denotes a lock this analysis
+        tracks: `self._lock`, a module-level lock var, or
+        `self.<obj>.<lockattr>` through attribute type inference."""
+        graph, cls = self.graph, self.cls
+        if is_self_attr(expr) and cls is not None \
+                and _is_lock_attr(graph, cls, expr.attr):
+            return lock_display(graph, cls, expr.attr)
+        if isinstance(expr, ast.Name) and expr.id in self.mod_locks:
+            return self.mod_locks[expr.id]
+        if (isinstance(expr, ast.Attribute)
+                and is_self_attr(expr.value) and cls is not None):
+            for owner in sorted(graph.attr_classes(cls, expr.value.attr)):
+                if _is_lock_attr(graph, owner, expr.attr):
+                    return lock_display(graph, owner, expr.attr)
+        return None
+
+    # -- recording ------------------------------------------------------
+
+    def record_acquire(self, lock: str, line: int,
+                       held: FrozenSet[str]) -> None:
+        if lock in held:
+            return  # re-entrant: no new edge, no new hold
+        self.facts.acquires.append(_Acquire(lock, line, held))
+        self.facts.acquired_locks.add(lock)
+
+    def record_prim(self, kind: str, detail: str, line: int,
+                    held: FrozenSet[str],
+                    cv_lock: Optional[str] = None) -> None:
+        self.facts.prims.append(_Prim(kind, detail, line, held, cv_lock))
+
+    # -- call classification --------------------------------------------
+
+    def handle_call(self, node: ast.Call, held: FrozenSet[str]) -> None:
+        name = _canonical_name(call_name(node), self.aliases)
+        if name == "time.sleep":
+            self.record_prim("sleep", "time.sleep", node.lineno, held)
+            return
+        if name == "os.fsync":
+            self.record_prim("fsync", "os.fsync", node.lineno, held)
+            return
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            method = fn.attr
+            recv = fn.value
+            if method == "wait" and not _has_timeout(node):
+                lock = self.lock_of(recv) if not isinstance(recv, ast.Name) \
+                    else None
+                kind = None
+                if is_self_attr(recv) and self.cls is not None:
+                    sk = _sync_kind(self.graph, self.cls, recv.attr)
+                    if sk == "lock":
+                        # locks have no .wait — a "lock"-kind field
+                        # with .wait IS a Condition (incl. aliased _cv)
+                        kind = ("cv-wait",
+                                lock_display(self.graph, self.cls,
+                                             recv.attr))
+                    elif sk == "event":
+                        kind = ("event-wait", None)
+                if kind is None and isinstance(recv, ast.Name) \
+                        and recv.id in self.mod_locks:
+                    kind = ("cv-wait", self.mod_locks[recv.id])
+                if kind is not None:
+                    self.record_prim(kind[0], call_name(node), node.lineno,
+                                     held, cv_lock=kind[1])
+                elif not self.resolve_targets(node):
+                    self.record_prim("wait", call_name(node), node.lineno,
+                                     held)
+                return
+            if method == "communicate":
+                self.record_prim("subprocess", call_name(node),
+                                 node.lineno, held)
+                return
+            if method in ("get",) and is_self_attr(recv) \
+                    and self.cls is not None \
+                    and _sync_kind(self.graph, self.cls, recv.attr) == "queue" \
+                    and not _queue_get_nonblocking(node):
+                self.record_prim("queue", call_name(node), node.lineno, held)
+                return
+            if method in ("recv", "accept", "sendall"):
+                if not self.resolve_targets(node):
+                    self.record_prim("socket", call_name(node), node.lineno,
+                                     held)
+                    return
+            if is_self_attr(recv, "_stub"):
+                self.record_prim("rpc", f"self._stub.{method}",
+                                 node.lineno, held)
+                return
+        targets = self.resolve_targets(node)
+        if targets:
+            self.facts.calls.append(
+                _CallSite(tuple(sorted(targets, key=str)), node.lineno,
+                          held))
+        elif isinstance(fn, ast.Attribute) \
+                and fn.attr in RPC_FALLBACK_METHODS:
+            # Unresolvable receiver (a client out of a dict) with an
+            # unmistakable RPC wrapper name.
+            self.record_prim("rpc", call_name(node) or f"?.{fn.attr}",
+                             node.lineno, held)
+        else:
+            tail = (call_name(node) or "").rsplit(".", 1)[-1]
+            sink_kind = SINK_NAME_KINDS.get(tail)
+            if sink_kind is not None:
+                self.record_prim(sink_kind, call_name(node) or tail,
+                                 node.lineno, held)
+
+    def resolve_targets(self, node: ast.Call) -> List[FuncKey]:
+        return self.graph.resolve_callable(node.func, self.fi,
+                                           self.local_types)
+
+    # -- the walk -------------------------------------------------------
+
+    def scan_stmts(self, stmts: Iterable[ast.stmt],
+                   held: FrozenSet[str]) -> FrozenSet[str]:
+        for stmt in stmts:
+            held = self.scan(stmt, held)
+        return held
+
+    def scan(self, node: ast.AST, held: FrozenSet[str]) -> FrozenSet[str]:
+        graph = self.graph
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return held  # its own FuncKey; analyzed separately
+        if isinstance(node, ast.Lambda):
+            # Runs later, on whatever thread calls it — but its facts
+            # belong to the enclosing function's summary (the notify
+            # lambdas), with NO lexical locks.
+            self.scan(node.body, frozenset())
+            return held
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in node.items:
+                lock = self.lock_of(item.context_expr)
+                if lock is not None:
+                    if lock not in inner:
+                        self.record_acquire(lock, item.context_expr.lineno,
+                                            frozenset(inner))
+                    inner.add(lock)
+                else:
+                    self.scan(item.context_expr, held)
+            self.scan_stmts(node.body, frozenset(inner))
+            return held
+        # Explicit statement-level toggles: `self._cv.release()` ...
+        # `self._cv.acquire()` (the release-sleep-reacquire idiom).
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in ("acquire", "release"):
+                lock = self.lock_of(call.func.value)
+                if lock is not None:
+                    if call.func.attr == "release":
+                        return frozenset(held - {lock})
+                    if lock not in held:
+                        self.record_acquire(lock, node.lineno, held)
+                    return frozenset(held | {lock})
+        if isinstance(node, ast.Try):
+            held = self.scan_stmts(node.body, held)
+            for handler in node.handlers:
+                self.scan_stmts(handler.body, held)
+            held = self.scan_stmts(node.orelse, held)
+            held = self.scan_stmts(node.finalbody, held)
+            return held
+        if isinstance(node, (ast.If, ast.While)):
+            self.scan(node.test, held)
+            self.scan_stmts(node.body, held)
+            self.scan_stmts(node.orelse, held)
+            return held
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self.scan(node.iter, held)
+            self.scan_stmts(node.body, held)
+            self.scan_stmts(node.orelse, held)
+            return held
+        if isinstance(node, ast.Call):
+            self.handle_call(node, held)
+            for child in ast.iter_child_nodes(node):
+                self.scan(child, held)
+            return held
+        for child in ast.iter_child_nodes(node):
+            self.scan(child, held)
+        return held
+
+    def run(self) -> _Facts:
+        base: FrozenSet[str] = frozenset()
+        if self.cls is not None and decorated_requires_lock(self.fi.node):
+            base = frozenset({lock_display(self.graph, self.cls, "_lock")})
+        self.facts.entry_held = base
+        self.scan_stmts(self.fi.node.body, base)
+        return self.facts
+
+
+# ----------------------------------------------------------------------
+# Whole-tree analysis
+# ----------------------------------------------------------------------
+
+def _bare(key: FuncKey) -> str:
+    return key.name.rsplit(":", 1)[-1].rsplit(".", 1)[-1]
+
+
+class LockflowAnalysis:
+    """Per-index lockflow state: facts, summaries, the static
+    lock-order graph, and root reachability. Memoized on the index
+    (pure static data; both passes share one build)."""
+
+    def __init__(self, index: RepoIndex):
+        self.index = index
+        self.graph: CallGraph = index.call_graph()
+        self._aliases: Dict[str, Dict[str, str]] = {}
+        self._mod_locks: Dict[str, Dict[str, str]] = {}
+        self.facts: Dict[FuncKey, _Facts] = {}
+        #: Transitive lock sets: every lock `key` (or a callee) acquires.
+        self.acq_summary: Dict[FuncKey, FrozenSet[str]] = {}
+        #: Transitive blocking facts (shed/blame-annotated _BFacts).
+        self.blocks_summary: Dict[FuncKey, FrozenSet[_BFact]] = {}
+        #: Static order graph: lock -> {lock acquired while held}, each
+        #: edge annotated with its first recording site.
+        self.edges: Dict[Tuple[str, str], Tuple[SourceFile, int, FuncKey]] \
+            = {}
+        self._build()
+
+    # -- per-file caches ------------------------------------------------
+
+    def aliases_for(self, src: SourceFile) -> Dict[str, str]:
+        got = self._aliases.get(src.rel)
+        if got is None:
+            got = self._aliases[src.rel] = _local_aliases(src.tree)
+        return got
+
+    def module_locks_for(self, src: SourceFile) -> Dict[str, str]:
+        got = self._mod_locks.get(src.rel)
+        if got is None:
+            got = self._mod_locks[src.rel] = _module_locks(src)
+        return got
+
+    # -- construction ---------------------------------------------------
+
+    def _build(self) -> None:
+        graph = self.graph
+        sink_kinds: Dict[FuncKey, str] = {}
+        for rel, names, kind in BLOCKING_SINKS:
+            for key, fi in graph.funcs.items():
+                if fi.src.rel == rel and _bare(key) in names:
+                    sink_kinds[key] = kind
+        for key in sorted(graph.funcs, key=str):
+            fi = graph.funcs[key]
+            self.facts[key] = _FunctionScanner(self, fi).run()
+
+        # Bottom-up fixpoint over acquire + blocking summaries.
+        acq: Dict[FuncKey, Set[str]] = {
+            key: set(f.acquired_locks) for key, f in self.facts.items()}
+        blocks: Dict[FuncKey, Set[_BFact]] = {}
+        for key, f in self.facts.items():
+            own: Set[_BFact] = set()
+            for p in f.prims:
+                problem = _problem_locks(p.kind, p.cv_lock, p.held)
+                own.add(_BFact(p.kind, p.detail, p.cv_lock,
+                               frozenset(f.entry_held - p.held),
+                               frozenset(problem)))
+            if key in sink_kinds:
+                own.add(_BFact(sink_kinds[key], str(key), None,
+                               frozenset(), frozenset()))
+            blocks[key] = own
+        changed = True
+        while changed:
+            changed = False
+            for key, f in self.facts.items():
+                for site in f.calls:
+                    for target in site.targets:
+                        tacq = acq.get(target)
+                        if tacq and not tacq <= acq[key]:
+                            acq[key] |= tacq
+                            changed = True
+                        if _bare(target) in FACT_STOP_FUNCS:
+                            continue
+                        for fact in list(blocks.get(target, ())):
+                            eff = site.held - fact.shed
+                            problem = _problem_locks(fact.kind,
+                                                     fact.cv_lock, eff)
+                            base = fact.detail.split(" via ")[0]
+                            nf = _BFact(
+                                fact.kind, f"{base} via {target}",
+                                fact.cv_lock,
+                                frozenset(fact.shed
+                                          | (f.entry_held - site.held)),
+                                frozenset(fact.blamed | problem))
+                            if nf not in blocks[key]:
+                                blocks[key].add(nf)
+                                changed = True
+        self.acq_summary = {k: frozenset(v) for k, v in acq.items()}
+        self.blocks_summary = {k: frozenset(v) for k, v in blocks.items()}
+
+        # The static lock-order graph: direct acquires-while-holding
+        # plus calls-under-lock into functions that acquire more.
+        for key in sorted(self.facts, key=str):
+            f = self.facts[key]
+            fi = self.graph.funcs[key]
+            for acq_fact in f.acquires:
+                for outer in sorted(acq_fact.held_before):
+                    self._add_edge(outer, acq_fact.lock, fi.src,
+                                   acq_fact.line, key)
+            for site in f.calls:
+                if not site.held:
+                    continue
+                for target in site.targets:
+                    for inner in sorted(self.acq_summary.get(target, ())):
+                        for outer in sorted(site.held):
+                            self._add_edge(outer, inner, fi.src,
+                                           site.line, key)
+
+        # Root reachability (the races.py pattern, including <main>).
+        roots, _ = discover_thread_roots(self.index)
+        root_reach: Dict[Tuple[str, str], Set[FuncKey]] = {}
+        for root in roots:
+            rid = (str(root.key), root.kind)
+            if rid not in root_reach:
+                root_reach[rid] = graph.reachable(root.key)
+        self.func_roots: Dict[FuncKey, Set[Tuple[str, str]]] = {}
+        for rid, reach in root_reach.items():
+            for key in reach:
+                self.func_roots.setdefault(key, set()).add(rid)
+        touched = {key.cls for key in self.func_roots if key.cls}
+        families: Set[str] = set()
+        for cls in touched:
+            families.update(graph.mro(cls))
+            families.update(graph.subclasses(cls))
+        MAIN = ("<main>", "main")
+        for cls in sorted(families):
+            info = graph.classes[cls]
+            for mname, fi in info.methods.items():
+                if mname.startswith("_") or "." in mname:
+                    continue
+                for key in graph.reachable(fi.key):
+                    self.func_roots.setdefault(key, set()).add(MAIN)
+
+    def _add_edge(self, outer: str, inner: str, src: SourceFile,
+                  line: int, key: FuncKey) -> None:
+        if outer == inner:
+            return
+        self.edges.setdefault((outer, inner), (src, line, key))
+
+    # -- queries ---------------------------------------------------------
+
+    def adjacency(self) -> Dict[str, Set[str]]:
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+        return adj
+
+    def edge_roots(self, a: str, b: str) -> Set[Tuple[str, str]]:
+        site = self.edges.get((a, b))
+        if site is None:
+            return set()
+        return set(self.func_roots.get(site[2], set()))
+
+
+_ANALYSIS_ATTR = "_lockflow_analysis"
+
+
+def lockflow_analysis(index: RepoIndex) -> LockflowAnalysis:
+    got = getattr(index, _ANALYSIS_ATTR, None)
+    if got is None:
+        got = LockflowAnalysis(index)
+        setattr(index, _ANALYSIS_ATTR, got)
+    return got
+
+
+def static_lock_order_graph(index: RepoIndex) -> dict:
+    """The static order graph in the sanitizer's export shape:
+    {"nodes": [...], "edges": ["A->B", ...]} — the containment gate
+    compares the runtime export against exactly this."""
+    analysis = lockflow_analysis(index)
+    nodes: Set[str] = set()
+    edges: Set[str] = set()
+    for (a, b) in analysis.edges:
+        nodes.add(a)
+        nodes.add(b)
+        edges.add(f"{a}->{b}")
+    return {"nodes": sorted(nodes), "edges": sorted(edges)}
+
+
+# ----------------------------------------------------------------------
+# Pass: deadlock
+# ----------------------------------------------------------------------
+
+def check_deadlock(index: RepoIndex) -> List[Finding]:
+    """Static lock-order acyclicity: every acquire-while-holding edge
+    (direct or through a call chain) joins one order graph; a cycle
+    whose edges are reachable from >= 2 distinct thread roots (or one
+    self-concurrent handler-pool root) is a deadlock some interleaving
+    can hit. `_LOCK_ORDER_JUSTIFIED = frozenset({"A->B"})` in a class
+    body sanctions an edge; stale entries are findings."""
+    analysis = lockflow_analysis(index)
+    graph = analysis.graph
+    findings: List[Finding] = []
+
+    # Harvest every _LOCK_ORDER_JUSTIFIED across the tree (anchored at
+    # the declaring class; edges are global names so a single registry
+    # covers the process-wide graph).
+    justified: Dict[str, Tuple[SourceFile, int]] = {}
+    for cls in sorted(graph.classes):
+        for entry, where in _harvest_registry(
+                graph, cls, ORDER_REGISTRY_NAME).items():
+            justified.setdefault(entry, where)
+    used: Set[str] = set()
+
+    adj = analysis.adjacency()
+    reported: Set[FrozenSet[str]] = set()
+    for (a, b) in sorted(analysis.edges):
+        # Shortest path b -> a closes the cycle through edge (a, b).
+        path = _shortest_path(adj, b, a)
+        if path is None:
+            continue
+        cycle_nodes = frozenset(path)
+        if cycle_nodes in reported:
+            continue
+        reported.add(cycle_nodes)
+        # The cycle: a -> b, then the path b .. a edge by edge.
+        cycle_edges = [(a, b)] + list(zip(path, path[1:]))
+        roots: Set[Tuple[str, str]] = set()
+        for (x, y) in cycle_edges:
+            roots |= analysis.edge_roots(x, y)
+        concurrent = (len({r for r in roots}) > 1
+                      or any(kind in SELF_CONCURRENT_KINDS
+                             for _, kind in roots))
+        edge_strs = [f"{x}->{y}" for (x, y) in cycle_edges]
+        hits = [e for e in edge_strs if e in justified]
+        if hits:
+            used.update(hits)
+            continue
+        if not concurrent:
+            continue
+        src, line, key = analysis.edges[(a, b)]
+        root_names = sorted({entry for entry, _ in roots})
+        f = finding(
+            src, line, PASS_DEADLOCK,
+            f"lock-order cycle {' / '.join(edge_strs)} (closed here in "
+            f"{key}); reachable from {len(roots)} thread root(s) "
+            f"({', '.join(root_names[:3])}"
+            f"{', ...' if len(root_names) > 3 else ''}) — an unlucky "
+            "interleaving deadlocks. Restructure so one order holds "
+            "everywhere, or sanction the edge in "
+            f"{ORDER_REGISTRY_NAME} with a written justification")
+        if f is not None:
+            findings.append(f)
+
+    for entry in sorted(justified):
+        a, _, b = entry.partition("->")
+        src, line = justified[entry]
+        if (a, b) not in analysis.edges:
+            f = finding(src, line, PASS_DEADLOCK,
+                        f"stale {ORDER_REGISTRY_NAME} entry '{entry}': "
+                        "the static graph has no such edge — delete it")
+            if f is not None:
+                findings.append(f)
+    return findings
+
+
+def _shortest_path(adj: Dict[str, Set[str]], src: str, dst: str
+                   ) -> Optional[List[str]]:
+    """BFS path src..dst (inclusive), deterministic (sorted
+    neighbors); None when unreachable."""
+    if src == dst:
+        return [src]
+    prev: Dict[str, str] = {}
+    frontier = [src]
+    seen = {src}
+    while frontier:
+        nxt: List[str] = []
+        for node in frontier:
+            for neigh in sorted(adj.get(node, ())):
+                if neigh in seen:
+                    continue
+                seen.add(neigh)
+                prev[neigh] = node
+                if neigh == dst:
+                    path = [dst]
+                    while path[-1] != src:
+                        path.append(prev[path[-1]])
+                    return list(reversed(path))
+                nxt.append(neigh)
+        frontier = nxt
+    return None
+
+
+# ----------------------------------------------------------------------
+# Pass: hold-discipline
+# ----------------------------------------------------------------------
+
+def check_hold_discipline(index: RepoIndex) -> List[Finding]:
+    """No blocking operation under a lock: gRPC calls, fsync, MILP
+    solves, time.sleep, timeout-less Condition/Event waits, subprocess
+    wait/communicate, blocking queue/socket ops — inline OR through any
+    resolvable call chain — are findings when a lock is held and the
+    code is reachable from a thread root. One finding per
+    (function, kind), matching `_HOLD_DISCIPLINE_JUSTIFIED` entries
+    "method:kind" (or "method:*"); stale entries are findings."""
+    analysis = lockflow_analysis(index)
+    graph = analysis.graph
+    findings: List[Finding] = []
+
+    # (function, kind) -> [(line, detail, sorted-held-tuple)]
+    sites: Dict[Tuple[FuncKey, str], List[Tuple[int, str, tuple]]] = {}
+
+    def add_site(key: FuncKey, kind: str, line: int, detail: str,
+                 held: Iterable[str]) -> None:
+        sites.setdefault((key, kind), []).append(
+            (line, detail, tuple(sorted(held))))
+
+    for key in sorted(analysis.facts, key=str):
+        if not analysis.func_roots.get(key):
+            continue  # unreached: dead code / construction helpers
+        f = analysis.facts[key]
+        for prim in f.prims:
+            problem = _problem_locks(prim.kind, prim.cv_lock, prim.held)
+            if problem:
+                add_site(key, prim.kind, prim.line, prim.detail, problem)
+        for site in f.calls:
+            if not site.held:
+                continue
+            for target in site.targets:
+                if _bare(target) in FACT_STOP_FUNCS:
+                    continue
+                for fact in sorted(
+                        analysis.blocks_summary.get(target, ()),
+                        key=lambda b: (b.kind, b.detail,
+                                       tuple(sorted(b.shed)),
+                                       tuple(sorted(b.blamed)))):
+                    eff = site.held - fact.shed
+                    problem = _problem_locks(fact.kind, fact.cv_lock, eff)
+                    new = problem - fact.blamed
+                    if not new:
+                        continue  # already reported deeper, or shed
+                    base = fact.detail.split(" via ")[0]
+                    add_site(key, fact.kind, site.line,
+                             f"{base} via {target}", new)
+
+    # Registry: harvested per declaring-class family, matched by the
+    # finding function's class family.
+    used: Set[Tuple[str, str]] = set()   # (cls-anchor, entry)
+    registry_memo: Dict[str, Dict[str, Tuple[SourceFile, int]]] = {}
+
+    def registry_for(cls: str) -> Dict[str, Tuple[SourceFile, int]]:
+        got = registry_memo.get(cls)
+        if got is None:
+            got = registry_memo[cls] = _harvest_registry(
+                graph, cls, HOLD_REGISTRY_NAME)
+        return got
+
+    for (key, kind) in sorted(sites, key=lambda t: (str(t[0]), t[1])):
+        entries = sites[(key, kind)]
+        entries.sort()
+        line, detail, held = entries[0]
+        fi = graph.funcs[key]
+        method = _bare(key)
+        if key.cls is not None:
+            reg = registry_for(key.cls)
+            hit = None
+            for candidate in (f"{method}:{kind}", f"{method}:*"):
+                if candidate in reg:
+                    hit = candidate
+                    break
+            if hit is not None:
+                used.add((key.cls, hit))
+                continue
+        f = finding(
+            fi.src, line, PASS_HOLD,
+            f"{KIND_BLURB.get(kind, kind)} ({detail}) reachable with "
+            f"lock(s) {', '.join(sorted(held))} held in {key} "
+            f"({len(entries)} site(s)): move the blocking work outside "
+            "the lock, or sanction it with "
+            f"{HOLD_REGISTRY_NAME} entry '{method}:{kind}' and a "
+            "written justification")
+        if f is not None:
+            findings.append(f)
+
+    # Stale registry entries: walk every declaration once.
+    seen_decl: Set[Tuple[str, int, str]] = set()
+    for cls in sorted(graph.classes):
+        reg = _harvest_registry(graph, cls, HOLD_REGISTRY_NAME)
+        for entry, (src, line) in reg.items():
+            decl = (src.rel, line, entry)
+            if decl in seen_decl:
+                continue
+            seen_decl.add(decl)
+            if any(entry == e and
+                   (cls == c or cls in _family(graph, c)
+                    or c in _family(graph, cls))
+                   for (c, e) in used):
+                continue
+            method, _, kind = entry.partition(":")
+            matched = any(
+                _bare(key) == method and (kind == "*" or k == kind)
+                and key.cls is not None
+                and (key.cls in _family(graph, cls)
+                     or cls in _family(graph, key.cls))
+                for (key, k) in sites)
+            if matched:
+                continue  # suppressed-by-registry but keyed elsewhere
+            f = finding(src, line, PASS_HOLD,
+                        f"stale {HOLD_REGISTRY_NAME} entry '{entry}': "
+                        "no such blocking-under-lock site remains — "
+                        "delete it")
+            if f is not None:
+                findings.append(f)
+    return findings

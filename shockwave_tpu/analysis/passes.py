@@ -26,6 +26,14 @@ a deliberately-broken module.
 |                    | (analysis/threads.py)                                 |
 | race-detector      | every cross-thread field holds a consistent lockset   |
 |                    | or a documented registry verdict (analysis/races.py)  |
+| deadlock           | the static lock-order graph (held-locks dataflow over |
+|                    | the call graph) is acyclic, or every cycle edge is    |
+|                    | sanctioned in ``_LOCK_ORDER_JUSTIFIED``               |
+|                    | (analysis/lockflow.py)                                |
+| hold-discipline    | no blocking op (RPC, fsync, solve, sleep, timeout-    |
+|                    | less wait, subprocess, queue/socket) reachable with a |
+|                    | lock held, or a ``_HOLD_DISCIPLINE_JUSTIFIED`` verdict|
+|                    | (analysis/lockflow.py)                                |
 | suppression-audit  | every inline ignore[] still matches a finding the     |
 |                    | named pass would otherwise report (runs last)         |
 """
@@ -683,6 +691,20 @@ def _check_race_detector(index: RepoIndex) -> List[Finding]:
     return check_race_detector(index)
 
 
+def _check_deadlock(index: RepoIndex) -> List[Finding]:
+    """Static lock-order acyclicity: a cycle in the held-locks order
+    graph reachable from multiple thread roots is a deadlock."""
+    from .lockflow import check_deadlock
+    return check_deadlock(index)
+
+
+def _check_hold_discipline(index: RepoIndex) -> List[Finding]:
+    """No blocking operation (RPC/fsync/solve/sleep/wait/subprocess/
+    queue/socket) statically reachable with a lock held."""
+    from .lockflow import check_hold_discipline
+    return check_hold_discipline(index)
+
+
 ALL_PASSES = {
     "lock-discipline": check_lock_discipline,
     "journal-coverage": check_journal_coverage,
@@ -692,4 +714,6 @@ ALL_PASSES = {
     "obs-discipline": check_obs_discipline,
     "thread-roots": _check_thread_roots,
     "race-detector": _check_race_detector,
+    "deadlock": _check_deadlock,
+    "hold-discipline": _check_hold_discipline,
 }

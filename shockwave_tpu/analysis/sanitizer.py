@@ -29,6 +29,19 @@ perturbation, so N seeds exercise N deterministic-by-seed
 interleavings of the same critical sections with all of the above
 checks evaluated on each.
 
+Two further knobs close the loop with the static lockflow analysis
+(analysis/lockflow.py):
+
+- ``SWTPU_SANITIZE_HOLD_MS=<ms>`` turns the hold-time telemetry into
+  advisory warnings: any outermost hold at or above the threshold is
+  recorded in ``report()["hold_warnings"]``. Unset (the default)
+  keeps today's behavior; a garbage value logs once and stays off.
+- ``SWTPU_SANITIZE_GRAPH_OUT=<path>`` dumps the cumulative observed
+  lock-order graph as JSON at exit, in the same shape as the static
+  ``static_lock_order_graph``. CI asserts the runtime edges are a
+  subset of the static ones (``--assert-contains``), so a lock order
+  the analyzer cannot see would fail the build rather than ship.
+
 The wrapper deliberately implements the private RLock hooks
 (``_is_owned`` / ``_release_save`` / ``_acquire_restore``) so a
 ``threading.Condition`` built on it — the scheduler's ``self._cv`` —
@@ -40,13 +53,47 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Set
+from typing import Dict, List, Optional, Set
 
 from . import explorer
 
 
 def enabled() -> bool:
     return os.environ.get("SWTPU_SANITIZE", "0") not in ("", "0")
+
+
+HOLD_MS_ENV_VAR = "SWTPU_SANITIZE_HOLD_MS"
+GRAPH_OUT_ENV_VAR = "SWTPU_SANITIZE_GRAPH_OUT"
+
+_hold_warn_ms_cached: Optional[float] = None
+_hold_env_checked = False
+
+
+def hold_warn_ms() -> Optional[float]:
+    """The configured hold-time warn threshold (ms), or None for
+    today's default behavior (max-hold telemetry only, no warnings).
+    A garbage value logs once and falls back to off, mirroring
+    ``SWTPU_SANITIZE_EXPLORE``."""
+    global _hold_warn_ms_cached, _hold_env_checked
+    if _hold_env_checked:
+        return _hold_warn_ms_cached
+    raw = os.environ.get(HOLD_MS_ENV_VAR)
+    if raw is None or raw == "":
+        _hold_warn_ms_cached = None
+    else:
+        try:
+            value = float(raw)
+            if value <= 0:
+                raise ValueError(raw)
+            _hold_warn_ms_cached = value
+        except ValueError:
+            import logging
+            logging.getLogger("shockwave_tpu.analysis").warning(
+                "%s=%r is not a positive number of milliseconds; "
+                "hold-time warnings stay off", HOLD_MS_ENV_VAR, raw)
+            _hold_warn_ms_cached = None
+    _hold_env_checked = True
+    return _hold_warn_ms_cached
 
 
 @dataclass
@@ -66,12 +113,24 @@ class _Monitor:
     discipline — which is exactly the invariant we want checked.
     """
 
+    #: Cap on retained hold-time warnings (the count keeps climbing).
+    MAX_HOLD_WARNINGS = 200
+
     def __init__(self):
         self._mu = threading.Lock()
         self._edges: Dict[str, Set[str]] = {}
         self._cycle_reported: Set[tuple] = set()
         self._violations: List[Violation] = []
         self._max_hold: Dict[str, float] = {}
+        #: Cumulative order graph: NOT cleared by reset(), so one
+        #: process accumulates the union of every run's observed edges
+        #: (the 20-seed explorer smoke resets per seed; the exported
+        #: graph must cover all of them for the runtime ⊆ static gate).
+        self._graph: Dict[str, Set[str]] = {}
+        #: Holds exceeding the SWTPU_SANITIZE_HOLD_MS threshold
+        #: (advisory telemetry, not violations — cleared by reset()).
+        self._hold_warnings: List[dict] = []
+        self._hold_warning_count = 0
         self._tls = threading.local()
 
     # -- per-thread held-lock stack ------------------------------------
@@ -97,6 +156,7 @@ class _Monitor:
                 if outer == name:
                     continue
                 self._edges.setdefault(outer, set()).add(name)
+                self._graph.setdefault(outer, set()).add(name)
                 if self._reaches(name, outer):
                     key = tuple(sorted((outer, name)))
                     if key not in self._cycle_reported:
@@ -117,9 +177,16 @@ class _Monitor:
             if held[i] == name:
                 del held[i]
                 break
+        warn_ms = hold_warn_ms()
         with self._mu:
             if held_s > self._max_hold.get(name, 0.0):
                 self._max_hold[name] = held_s
+            if warn_ms is not None and held_s * 1000.0 >= warn_ms:
+                self._hold_warning_count += 1
+                if len(self._hold_warnings) < self.MAX_HOLD_WARNINGS:
+                    self._hold_warnings.append(
+                        {"lock": name,
+                         "held_ms": round(held_s * 1000.0, 3)})
 
     def _reaches(self, src: str, dst: str) -> bool:
         """Whether dst is reachable from src in the order graph.
@@ -152,6 +219,9 @@ class _Monitor:
                 "max_hold_s": dict(self._max_hold),
                 "order_edges": {k: sorted(v)
                                 for k, v in self._edges.items()},
+                "hold_warn_ms": hold_warn_ms(),
+                "hold_warnings": list(self._hold_warnings),
+                "hold_warning_count": self._hold_warning_count,
             }
 
     def reset(self) -> None:
@@ -160,9 +230,36 @@ class _Monitor:
             self._cycle_reported.clear()
             self._violations.clear()
             self._max_hold.clear()
+            self._hold_warnings.clear()
+            self._hold_warning_count = 0
         # Per-thread held stacks are left alone on purpose: a daemon
         # thread mid-critical-section at reset time must still balance
-        # its own acquires/releases.
+        # its own acquires/releases. The cumulative `_graph` also
+        # survives on purpose — it is the union the graph export
+        # writes (see GRAPH_OUT_ENV_VAR).
+
+    def cumulative_graph(self) -> dict:
+        """The union of every observed (held -> acquired) edge since
+        process start, in the static graph's export shape (see
+        analysis/lockflow.py static_lock_order_graph)."""
+        with self._mu:
+            nodes: Set[str] = set()
+            edges: List[str] = []
+            for outer, inners in self._graph.items():
+                nodes.add(outer)
+                for inner in inners:
+                    nodes.add(inner)
+                    edges.append(f"{outer}->{inner}")
+            return {"nodes": sorted(nodes), "edges": sorted(edges)}
+
+    def export_graph(self, path: str) -> None:
+        """Write the cumulative order graph as JSON (the runtime half
+        of the runtime ⊆ static containment gate)."""
+        import json
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.cumulative_graph(), f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
 
 
 _monitor = _Monitor()
@@ -170,6 +267,21 @@ _monitor = _Monitor()
 
 def monitor() -> _Monitor:
     return _monitor
+
+
+def _install_graph_export() -> None:
+    """When SWTPU_SANITIZE_GRAPH_OUT names a path, dump the cumulative
+    observed order graph there at interpreter exit. CI's containment
+    gate feeds that file to ``python -m shockwave_tpu.analysis
+    --assert-contains`` to check runtime edges ⊆ static edges."""
+    path = os.environ.get(GRAPH_OUT_ENV_VAR)
+    if not path:
+        return
+    import atexit
+    atexit.register(_monitor.export_graph, path)
+
+
+_install_graph_export()
 
 
 class SanitizedLock:

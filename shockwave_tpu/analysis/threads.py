@@ -36,7 +36,7 @@ import ast
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from .core import Finding, RepoIndex, SourceFile, call_name, finding
+from .core import Finding, RepoIndex, SourceFile, call_name, const_str, finding
 
 #: Spawn call sites whose argument is a new thread's entry point.
 THREAD_SPAWN_CALLS = frozenset({"threading.Thread", "Thread"})
@@ -154,6 +154,12 @@ class CallGraph:
         #: Per-class lock aliasing: attr -> canonical lock attr (e.g.
         #: `_cv = threading.Condition(self._lock)` makes _cv ≡ _lock).
         self.lock_alias: Dict[Tuple[str, str], str] = {}
+        #: (class, attr) -> the sanitizer name literal from
+        #: `maybe_wrap(lock, "Class._attr")` — the lockflow passes use
+        #: these so static lock identities match the runtime sanitizer's
+        #: order-graph node names exactly (runtime ⊆ static containment
+        #: is then a plain string-set comparison).
+        self.lock_names: Dict[Tuple[str, str], str] = {}
         self._reach_memo: Dict[FuncKey, Set[FuncKey]] = {}
         self._callee_memo: Dict[FuncKey, Set[FuncKey]] = {}
         self._local_types_memo: Dict[FuncKey, Dict[str, Set[str]]] = {}
@@ -249,6 +255,11 @@ class CallGraph:
                                 and inner.value.id == "self"):
                             self.lock_alias[(info.name, attr)] = inner.attr
                     if name.rsplit(".", 1)[-1] == "maybe_wrap":
+                        if len(value.args) >= 2:
+                            label = const_str(value.args[1])
+                            if label is not None:
+                                self.lock_names.setdefault(
+                                    (info.name, attr), label)
                         continue  # wrapped lock: type stays "lock"
                     continue
                 # Constructor / classmethod-constructor type inference.

@@ -548,6 +548,18 @@ class DurabilityLayer:
     compacting snapshots. Thread-safe (RPC callbacks, watchdog timers and
     the round loop all emit)."""
 
+    #: Sanctioned blocking-under-lock sites (hold-discipline pass,
+    #: analysis/lockflow.py): write-ahead journaling IS fsync under
+    #: this layer's serialization lock — `record` must assign the
+    #: sequence number and reach disk atomically with respect to other
+    #: emitters (two racing appends with swapped seq/disk order would
+    #: corrupt the recovery chain), and `snapshot` must write the
+    #: compaction point that matches the sequence it claims. The
+    #: non-critical audit stream opts out via ``sync=False`` instead.
+    _HOLD_DISCIPLINE_JUSTIFIED = frozenset({
+        "record:fsync", "snapshot:fsync",
+    })
+
     def __init__(self, state_dir: str,
                  snapshot_interval_rounds: int = 10, obs=None,
                  epoch: Optional[int] = None,

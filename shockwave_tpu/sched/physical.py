@@ -114,6 +114,39 @@ class PhysicalScheduler(Scheduler):
     # check that never runs. The physical-side helpers touching them
     # are @requires_lock, which the sanitizer verifies at runtime.
 
+    #: Sanctioned blocking-under-lock sites (hold-discipline pass,
+    #: analysis/lockflow.py). Every entry is a deliberate design
+    #: decision, documented at its call site:
+    #:
+    #: - ``_try_dispatch_job:rpc`` / ``_kill_job:rpc`` /
+    #:   ``_fail_jobs_on_dead_workers:rpc`` /
+    #:   ``_quarantine_worker_host:rpc`` — single bounded-deadline
+    #:   best-effort RPCs (``deadline_s=worker_probe_deadline_s`` or the
+    #:   dispatch deadline). The round protocol REQUIRES the dispatch /
+    #:   kill decision and its assignment-map mutation to be atomic
+    #:   under the scheduler lock (a release window would let a Done
+    #:   callback observe a half-dispatched gang); the deadline bounds
+    #:   the stall, and a dead host is reaped by the probe loop, not by
+    #:   a retry budget here.
+    #: - ``_maybe_snapshot:fsync`` — write-ahead durability: the
+    #:   snapshot MUST capture scheduler state at a quiescent point
+    #:   under the lock, or recovery replays against a torn state. The
+    #:   round-cadence snapshot interval amortizes the fsync wall.
+    #: - ``run:solve`` — the startup-only inline MILP solve: no round
+    #:   is executing yet and no worker is waiting on the lock; the
+    #:   first dispatch needs a committed schedule. Every later solve
+    #:   runs on the _planner_solve_loop thread with the lock RELEASED.
+    #: - ``_mid_round:solve`` — static-path-only: round_schedule()'s
+    #:   inline-solve branch is the simulator path; PhysicalScheduler
+    #:   always constructs the planner with pipelined=True, where
+    #:   round_schedule serves the committed result or the deadline
+    #:   fallback and never solves inline (shockwave/planner.py).
+    _HOLD_DISCIPLINE_JUSTIFIED = frozenset({
+        "_try_dispatch_job:rpc", "_kill_job:rpc",
+        "_fail_jobs_on_dead_workers:rpc", "_quarantine_worker_host:rpc",
+        "_maybe_snapshot:fsync", "run:solve", "_mid_round:solve",
+    })
+
     def __init__(self, policy, throughputs_file=None, profiles=None,
                  config: Optional[SchedulerConfig] = None,
                  expected_num_workers: Optional[int] = None,

@@ -1,0 +1,21 @@
+"""Seeded violations for the hold-discipline pass: a gRPC stub call
+and a time.sleep, both inside the spawned thread's critical section —
+every other thread wanting the lock stalls behind the network/sleep.
+One finding per (function, kind), each anchored at its blocking line."""
+import threading
+import time
+
+
+class BlockyDispatcher:
+    def __init__(self, stub):
+        self._lock = threading.Lock()
+        self._stub = stub
+        self._sent = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._stub.run_job("job")  # SEEDED
+                time.sleep(0.1)  # SEEDED
+                self._sent += 1

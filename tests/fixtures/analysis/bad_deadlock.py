@@ -1,0 +1,24 @@
+"""Seeded violation for the deadlock pass: two spawned threads acquire
+the same two locks in opposite orders — a lock-order cycle reachable
+from two distinct thread roots, so an unlucky interleaving deadlocks.
+The finding anchors at the first (alphabetically) edge's acquire site:
+taking _lock_b while holding _lock_a."""
+import threading
+
+
+class Clash:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        threading.Thread(target=self._loop_ab, daemon=True).start()
+        threading.Thread(target=self._loop_ba, daemon=True).start()
+
+    def _loop_ab(self):
+        with self._lock_a:
+            with self._lock_b:  # SEEDED
+                pass
+
+    def _loop_ba(self):
+        with self._lock_b:
+            with self._lock_a:
+                pass

@@ -1,0 +1,102 @@
+"""Negative controls for the deadlock and hold-discipline passes: the
+same cross-thread shapes as bad_deadlock.py / bad_blocking.py, but with
+the exemptions that must all stay quiet — consistent nesting order, the
+@requires_lock entry contract plus the own-condition wait rule, and
+both documented-verdict registries (whose entries must also NOT be
+reported stale)."""
+import threading
+
+from shockwave_tpu.core.locking import requires_lock
+
+
+class OrderedNest:
+    """Two threads, two locks, ONE order everywhere: edges but no
+    cycle."""
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        threading.Thread(target=self._loop_one, daemon=True).start()
+        threading.Thread(target=self._loop_two, daemon=True).start()
+
+    def _loop_one(self):
+        with self._lock_a:
+            with self._lock_b:
+                pass
+
+    def _loop_two(self):
+        with self._lock_a:
+            with self._lock_b:
+                pass
+
+
+class Waiter:
+    """@requires_lock callee + own-cv wait: the helper enters with the
+    receiver's lock by contract, and its timeout-less wait on the
+    condition WRAPPING that same lock releases it while blocked — no
+    hold-discipline finding for caller or callee."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._ready = False
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._cv:
+            self._wait_ready()
+            self._ready = False
+
+    @requires_lock
+    def _wait_ready(self):
+        while not self._ready:
+            self._cv.wait()
+
+
+class JustifiedOrder:
+    """Registry verdict for an order inversion: the backward path runs
+    only during single-threaded construction in the real pattern this
+    models, so the edge is sanctioned with a written justification."""
+
+    #: Justified: _backward executes before the _forward thread is
+    #: spawned; the inversion cannot interleave with the forward order.
+    _LOCK_ORDER_JUSTIFIED = frozenset({
+        "JustifiedOrder._lock_a->JustifiedOrder._lock_b",
+    })
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        threading.Thread(target=self._forward, daemon=True).start()
+        threading.Thread(target=self._backward, daemon=True).start()
+
+    def _forward(self):
+        with self._lock_a:
+            with self._lock_b:
+                pass
+
+    def _backward(self):
+        with self._lock_b:
+            with self._lock_a:
+                pass
+
+
+class JustifiedHold:
+    """Registry verdict for blocking under a lock: a bounded-deadline
+    ping that the (modeled) lease protocol requires to be atomic with
+    the guarded state update."""
+
+    #: Justified: the ping carries a short deadline and must observe
+    #: the same lease epoch the guarded counter records.
+    _HOLD_DISCIPLINE_JUSTIFIED = frozenset({"_loop:rpc"})
+
+    def __init__(self, stub):
+        self._lock = threading.Lock()
+        self._stub = stub
+        self._pings = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self._stub.ping()
+            self._pings += 1

@@ -77,6 +77,7 @@ class TestRepoIsClean:
         for pass_id in ("lock-discipline", "journal-coverage",
                         "durability", "determinism", "exception-hygiene",
                         "obs-discipline", "thread-roots", "race-detector",
+                        "deadlock", "hold-discipline",
                         "suppression-audit"):
             assert pass_id in out.stdout
         # Per-pass wall reporting (the analyzer-performance satellite).
@@ -98,6 +99,66 @@ class TestRepoIsClean:
                 "suppression-audit"} <= pass_ids
         assert all("wall_s" in p and "findings" in p
                    for p in report["passes"])
+
+    def test_cli_sarif_report(self):
+        """--sarif: a valid SARIF 2.1.0 log with one rule per pass
+        (all 11) and zero results on the clean tree."""
+        import json
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.analysis",
+             "--root", REPO, "--sarif"],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        sarif = json.loads(out.stdout)
+        assert sarif["version"] == "2.1.0"
+        run = sarif["runs"][0]
+        assert run["tool"]["driver"]["name"] == "swtpu-check"
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {"deadlock", "hold-discipline", "race-detector",
+                "suppression-audit"} <= rule_ids
+        assert len(rule_ids) == 11
+        assert run["results"] == []
+
+    def test_sarif_results_carry_location_and_rule(self, tmp_path):
+        """A broken tree's SARIF results anchor ruleId + file:line."""
+        pkg = tmp_path / "shockwave_tpu"
+        pkg.mkdir()
+        shutil.copy(os.path.join(FIXTURES, "bad_exceptions.py"),
+                    pkg / "bad_exceptions.py")
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.analysis",
+             "--root", str(tmp_path), "--sarif"],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 1
+        import json
+        results = json.loads(out.stdout)["runs"][0]["results"]
+        assert results, "expected findings from the seeded fixture"
+        got = {(r["ruleId"],
+                r["locations"][0]["physicalLocation"]
+                ["artifactLocation"]["uri"],
+                r["locations"][0]["physicalLocation"]
+                ["region"]["startLine"])
+               for r in results}
+        for line in seeded_lines("bad_exceptions.py"):
+            assert ("exception-hygiene",
+                    "shockwave_tpu/bad_exceptions.py", line) in got
+
+    def test_cli_lock_graph_matches_library(self):
+        """--lock-graph prints the static order graph, non-vacuously:
+        the scheduler's lock orders over its service singletons must
+        be present (a vacuously empty graph would make the containment
+        gate pass trivially)."""
+        import json
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.analysis",
+             "--root", REPO, "--lock-graph"],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        graph = json.loads(out.stdout)
+        assert "PhysicalScheduler._lock->Tracer._lock" in graph["edges"]
+        assert ("PhysicalScheduler._lock->DurabilityLayer._lock"
+                in graph["edges"])
+        assert "PhysicalScheduler._lock" in graph["nodes"]
 
     def test_findings_output_is_deterministic(self):
         """The CI analysis-smoke gate: two runs over the same tree are
@@ -218,6 +279,78 @@ class TestNegativeFixtures:
         # Only the unknown-id finding survives (flagged regardless).
         assert [f.pass_id for f in findings] == ["suppression-audit"]
         assert "unknown pass id" in findings[0].message
+
+    def test_deadlock(self):
+        """A lock-order cycle across two spawned-thread roots is
+        reported once, anchored at the inverting acquire."""
+        from shockwave_tpu.analysis.lockflow import check_deadlock
+        findings = check_deadlock(fixture_index("bad_deadlock.py"))
+        assert_exactly_seeded(findings, "bad_deadlock.py", "deadlock")
+        assert "Clash._lock_a->Clash._lock_b" in findings[0].message
+        assert "2 thread root(s)" in findings[0].message
+
+    def test_hold_discipline(self):
+        """An RPC and a sleep inside a critical section: one finding
+        per (function, kind), each at its blocking line."""
+        from shockwave_tpu.analysis.lockflow import check_hold_discipline
+        findings = check_hold_discipline(fixture_index("bad_blocking.py"))
+        assert_exactly_seeded(findings, "bad_blocking.py",
+                              "hold-discipline")
+        kinds = {f.message.split("(")[0].strip() for f in findings}
+        assert "a gRPC call" in kinds
+        assert any("time.sleep" in f.message for f in findings)
+
+    def test_lockflow_clean_on_ordered_contracted_and_justified(self):
+        """Negative controls: consistent nesting order, the
+        @requires_lock entry contract + own-cv wait, and both
+        documented-verdict registries (whose live entries must not be
+        reported stale) all stay quiet."""
+        from shockwave_tpu.analysis.lockflow import (check_deadlock,
+                                                     check_hold_discipline)
+        index = fixture_index("good_lockflow.py")
+        assert [str(f) for f in check_deadlock(index)] == []
+        assert [str(f) for f in check_hold_discipline(index)] == []
+
+    def test_lockflow_suppression_and_select_coverage(self, tmp_path):
+        """The new pass ids ride the shared machinery: an inline
+        ignore[deadlock] suppresses the cycle finding (and the audit
+        knows the id — no unknown-pass-id finding), and --select
+        accepts both ids."""
+        from shockwave_tpu.analysis.core import RepoIndex, SourceFile
+        from shockwave_tpu.analysis.lockflow import check_deadlock
+        src = open(os.path.join(FIXTURES, "bad_deadlock.py")).read()
+        line = sorted(seeded_lines("bad_deadlock.py"))[0]
+        lines = src.splitlines()
+        lines[line - 1] += "  # swtpu-check: ignore[deadlock]"
+        patched = "\n".join(lines) + "\n"
+        idx = RepoIndex(
+            [SourceFile(str(tmp_path / "m.py"), "m.py", patched)],
+            str(tmp_path))
+        assert check_deadlock(idx) == []
+        audit = passes.check_suppression_audit(
+            idx, ran_pass_ids=["deadlock"])
+        assert audit == [], [str(f) for f in audit]
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.analysis",
+             "--root", REPO, "--select", "deadlock,hold-discipline"],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+
+    def test_deadlock_stale_registry_entry_is_a_finding(self, tmp_path):
+        """A _LOCK_ORDER_JUSTIFIED entry naming an edge the static
+        graph no longer has must be flagged at its declaration."""
+        from shockwave_tpu.analysis.core import RepoIndex, SourceFile
+        from shockwave_tpu.analysis.lockflow import check_deadlock
+        src = ("import threading\n"
+               "class Lone:\n"
+               "    _LOCK_ORDER_JUSTIFIED = frozenset({'A->B'})\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n")
+        idx = RepoIndex([SourceFile(str(tmp_path / "m.py"), "m.py", src)],
+                        str(tmp_path))
+        findings = check_deadlock(idx)
+        assert [f.line for f in findings] == [3]
+        assert "stale" in findings[0].message
 
     def test_cli_exits_one_on_violations(self, tmp_path):
         """End-to-end exit-1 proof: a copy of a broken fixture placed
@@ -386,6 +519,71 @@ class TestSanitizer:
 
         assert Thing().poke() == 41
         assert sanitizer.monitor().report()["violations"] == []
+
+    def _reset_hold_env(self, monkeypatch):
+        """Force hold_warn_ms() to re-read the env on next call, and
+        restore the cache after the test."""
+        monkeypatch.setattr(sanitizer, "_hold_env_checked", False)
+        monkeypatch.setattr(sanitizer, "_hold_warn_ms_cached", None)
+
+    def test_hold_warning_fires_at_threshold(self, monkeypatch):
+        import time
+        monkeypatch.setenv(sanitizer.HOLD_MS_ENV_VAR, "1")
+        self._reset_hold_env(monkeypatch)
+        a, _ = self._locks()
+        with a:
+            time.sleep(0.01)  # >= 1 ms threshold
+        report = sanitizer.monitor().report()
+        assert report["hold_warn_ms"] == 1.0
+        assert report["hold_warning_count"] >= 1
+        assert any(w["lock"] == "sanitytest.A"
+                   and w["held_ms"] >= 1.0
+                   for w in report["hold_warnings"])
+        # reset() clears the warnings (per-seed explorer hygiene).
+        sanitizer.monitor().reset()
+        report = sanitizer.monitor().report()
+        assert report["hold_warnings"] == []
+        assert report["hold_warning_count"] == 0
+
+    def test_hold_warning_default_off(self, monkeypatch):
+        import time
+        monkeypatch.delenv(sanitizer.HOLD_MS_ENV_VAR, raising=False)
+        self._reset_hold_env(monkeypatch)
+        a, _ = self._locks()
+        with a:
+            time.sleep(0.005)
+        report = sanitizer.monitor().report()
+        assert report["hold_warn_ms"] is None
+        assert report["hold_warnings"] == []
+        assert report["hold_warning_count"] == 0
+
+    def test_hold_warning_garbage_env_logs_and_stays_off(
+            self, monkeypatch, caplog):
+        import logging
+        for garbage in ("not-a-number", "-5", "0"):
+            monkeypatch.setenv(sanitizer.HOLD_MS_ENV_VAR, garbage)
+            self._reset_hold_env(monkeypatch)
+            with caplog.at_level(logging.WARNING,
+                                 logger="shockwave_tpu.analysis"):
+                assert sanitizer.hold_warn_ms() is None
+            assert sanitizer.HOLD_MS_ENV_VAR in caplog.text
+            caplog.clear()
+
+    def test_cumulative_graph_survives_reset_and_exports(self, tmp_path):
+        """The graph the containment gate consumes must union every
+        run in the process: the 20-seed smoke resets per seed."""
+        import json
+        a, b = self._locks()
+        with a:
+            with b:
+                pass
+        sanitizer.monitor().reset()  # per-seed reset in the smoke
+        graph = sanitizer.monitor().cumulative_graph()
+        assert "sanitytest.A->sanitytest.B" in graph["edges"]
+        assert {"sanitytest.A", "sanitytest.B"} <= set(graph["nodes"])
+        out = tmp_path / "graph.json"
+        sanitizer.monitor().export_graph(str(out))
+        assert json.loads(out.read_text()) == graph
 
     def test_physical_scheduler_lock_is_instrumented_when_enabled(
             self, monkeypatch, tmp_path):

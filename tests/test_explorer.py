@@ -119,6 +119,116 @@ class TestExplorerDeterminism:
         assert sanitizer.monitor().report()["violations"] == []
 
 
+class TestRuntimeStaticContainment:
+    """The deadlock pass's soundness audit: every lock-order edge the
+    sanitizer OBSERVES must be predicted by the static lockflow graph
+    (runtime ⊆ static). CI enforces the same containment over the
+    20-seed smoke's exported graph via ``--assert-contains``."""
+
+    def _static_graph(self):
+        from shockwave_tpu.analysis import __main__ as main_mod
+        from shockwave_tpu.analysis.core import cached_index
+        from shockwave_tpu.analysis.lockflow import static_lock_order_graph
+        index = cached_index(
+            REPO, include_dirs=main_mod.DEFAULT_INCLUDE_DIRS,
+            exclude_globs=main_mod.DEFAULT_EXCLUDE_GLOBS)
+        return static_lock_order_graph(index)
+
+    @staticmethod
+    def _real_edges(graph):
+        """Drop synthetic test-lock edges (sanitytest.*/explorertest.*
+        names the sanitizer unit tests create in this same process)."""
+        return [e for e in graph["edges"]
+                if "test." not in e]
+
+    def test_observed_edges_contained_in_static_graph(self):
+        """Drive a real-named nesting, then check every real-named
+        edge the process has EVER observed (the cumulative graph
+        survives reset) appears in the static graph."""
+        static = self._static_graph()
+        assert static["edges"], "static graph must not be vacuous"
+        # A real scheduler-order nesting so the check can never pass
+        # on an empty runtime graph.
+        a = sanitizer.SanitizedLock(threading.RLock(),
+                                    "PhysicalScheduler._lock")
+        b = sanitizer.SanitizedLock(threading.RLock(), "Tracer._lock")
+        with a:
+            with b:
+                pass
+        runtime = sanitizer.monitor().cumulative_graph()
+        real = self._real_edges(runtime)
+        assert "PhysicalScheduler._lock->Tracer._lock" in real
+        missing = sorted(set(real) - set(static["edges"]))
+        assert missing == [], (
+            f"runtime lock-order edges the static analyzer missed: "
+            f"{missing}")
+
+    def test_assert_contains_cli_gate(self, tmp_path):
+        """The CI gate end-to-end: a contained graph file exits 0, an
+        inverted edge exits 1 naming the uncovered edge."""
+        import json
+        import subprocess
+        import sys
+
+        a = sanitizer.SanitizedLock(threading.RLock(),
+                                    "PhysicalScheduler._lock")
+        b = sanitizer.SanitizedLock(threading.RLock(), "Tracer._lock")
+        with a:
+            with b:
+                pass
+        runtime = sanitizer.monitor().cumulative_graph()
+        good = tmp_path / "runtime.json"
+        good.write_text(json.dumps(
+            {"nodes": runtime["nodes"],
+             "edges": self._real_edges(runtime)}))
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.analysis",
+             "--root", REPO, "--assert-contains", str(good)],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "containment OK" in out.stdout
+
+        bad = tmp_path / "inverted.json"
+        bad.write_text(json.dumps(
+            {"nodes": [], "edges":
+             ["Tracer._lock->PhysicalScheduler._lock"]}))
+        out = subprocess.run(
+            [sys.executable, "-m", "shockwave_tpu.analysis",
+             "--root", REPO, "--assert-contains", str(bad)],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 1
+        assert "Tracer._lock->PhysicalScheduler._lock" in out.stderr
+
+    def test_graph_out_env_exports_at_exit(self, tmp_path):
+        """SWTPU_SANITIZE_GRAPH_OUT: a subprocess that nests two
+        instrumented locks dumps the cumulative graph at interpreter
+        exit, surviving an intervening reset()."""
+        import json
+        import subprocess
+        import sys
+
+        out_path = tmp_path / "graph.json"
+        env = dict(os.environ,
+                   SWTPU_SANITIZE="1",
+                   SWTPU_SANITIZE_GRAPH_OUT=str(out_path))
+        script = (
+            "import threading\n"
+            "from shockwave_tpu.analysis import sanitizer\n"
+            "a = sanitizer.maybe_wrap(threading.RLock(), 'ga')\n"
+            "b = sanitizer.maybe_wrap(threading.RLock(), 'gb')\n"
+            "with a:\n"
+            "    with b:\n"
+            "        pass\n"
+            "sanitizer.monitor().reset()\n")
+        out = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, cwd=REPO,
+                             env=env)
+        assert out.returncode == 0, out.stdout + out.stderr
+        graph = json.loads(out_path.read_text())
+        assert graph["edges"] == ["ga->gb"]
+        assert graph["nodes"] == ["ga", "gb"]
+
+
 def _shockwave_scheduler(port):
     from shockwave_tpu.core.job import Job
     from shockwave_tpu.core.oracle import read_throughputs
