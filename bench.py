@@ -195,6 +195,30 @@ def serving_phase():
             "serving_decode_backend": row["backend"]}
 
 
+def oracle_phase():
+    """Learned throughput oracle overhead: fit wall + predictions/s +
+    online updates/s (scripts/microbenchmarks/bench_oracle.py) — keeps
+    the cold-start estimator's cost visible beside the what-if and
+    tracing rows; the scheduler charges one predict per never-profiled
+    (job, worker type) and one observe per Done report."""
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts/microbenchmarks/bench_oracle.py")],
+            capture_output=True, text=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        return {"oracle_error": "bench_oracle timeout"}
+    if out.returncode != 0:
+        return {"oracle_error": out.stderr[-300:]}
+    try:
+        row = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001
+        return {"oracle_error": out.stdout[-300:]}
+    return {"oracle_mean_fit_s": row["mean_fit_s"],
+            "oracle_predictions_per_s": row["predictions_per_s"],
+            "oracle_observations_per_s": row["observations_per_s"]}
+
+
 def main():
     sim_start = time.monotonic()
     out = subprocess.run(
@@ -234,6 +258,7 @@ def main():
     line.update(whatif_phase())
     line.update(tracing_phase())
     line.update(serving_phase())
+    line.update(oracle_phase())
     line.update(tpu_phase())
     print(json.dumps(line))
 

@@ -181,7 +181,7 @@ def run_sim_schedule(seed, cfg):
     jobs, arrivals, events, plan = draw_sim_schedule(
         rng, jobs, arrivals, cluster_spec, cfg["knobs"])
     profiles = build_profiles(jobs, cfg["throughput_table"])
-    shockwave_config, serving_config, whatif_config = (
+    shockwave_config, serving_config, whatif_config, _ = (
         driver_common.load_configs(cfg["config"], cfg["policy"],
                                    cluster_spec, cfg["round_duration"]))
 
@@ -303,7 +303,7 @@ def run_twin_schedule(seed, cfg):
     capture_round = int(rng.randint(3, 12))
     plan["capture_round"] = capture_round
     profiles = build_profiles(jobs, cfg["throughput_table"])
-    shockwave_config, serving_config, _ = (
+    shockwave_config, serving_config, _, _ = (
         driver_common.load_configs(cfg["config"], cfg["policy"],
                                    cluster_spec, cfg["round_duration"]))
     sched = driver_common.build_scheduler(

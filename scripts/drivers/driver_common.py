@@ -55,28 +55,31 @@ def load_resumable_artifact(path: str, meta: dict,
 
 def load_configs(config_path: Optional[str], policy: str,
                  cluster_spec: dict, round_duration: float):
-    """(shockwave_config, serving_config, whatif_config) from a driver
-    --config file.
+    """(shockwave_config, serving_config, whatif_config, oracle_config)
+    from a driver --config file.
 
-    The serving tier and the what-if plane are policy-agnostic; their
-    blocks ride the same config file but separate SchedulerConfig
-    fields (the planner would reject the unknown keys). A shockwave
-    run without a config file gets the planner defaults.
+    The serving tier, the what-if plane and the learned throughput
+    oracle are policy-agnostic; their blocks ride the same config file
+    but separate SchedulerConfig fields (the planner would reject the
+    unknown keys). A shockwave run without a config file gets the
+    planner defaults.
     """
     shockwave_config = None
     serving_config = None
     whatif_config = None
+    oracle_config = None
     if config_path:
         with open(config_path) as f:
             shockwave_config = json.load(f)
         serving_config = shockwave_config.pop("serving", None)
         whatif_config = shockwave_config.pop("whatif", None)
+        oracle_config = shockwave_config.pop("oracle", None)
     if shockwave_config is None and policy == "shockwave":
         shockwave_config = {}  # planner defaults
     if shockwave_config is not None:
         shockwave_config["num_gpus"] = sum(cluster_spec.values())
         shockwave_config["time_per_iteration"] = round_duration
-    return shockwave_config, serving_config, whatif_config
+    return shockwave_config, serving_config, whatif_config, oracle_config
 
 
 def build_scheduler(policy_name: str, throughputs_file: str, profiles,
@@ -85,6 +88,7 @@ def build_scheduler(policy_name: str, throughputs_file: str, profiles,
                     shockwave_config: Optional[dict] = None,
                     serving_config: Optional[dict] = None,
                     whatif_config: Optional[dict] = None,
+                    oracle_config: Optional[dict] = None,
                     rate_override: Optional[dict] = None,
                     vectorized: bool = True) -> Scheduler:
     """One simulation-mode scheduler, configured the way every driver
@@ -97,7 +101,8 @@ def build_scheduler(policy_name: str, throughputs_file: str, profiles,
             time_per_iteration=round_duration, seed=seed,
             max_rounds=max_rounds, shockwave=shockwave_config,
             rate_override=rate_override, serving=serving_config,
-            whatif=whatif_config, vectorized_sim=vectorized))
+            whatif=whatif_config, oracle=oracle_config,
+            vectorized_sim=vectorized))
 
 
 def collect_metrics(sched: Scheduler, makespan: float,

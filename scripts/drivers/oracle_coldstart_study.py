@@ -1,0 +1,288 @@
+#!/usr/bin/env python3
+"""Cold-start scheduling study on a mixed two-generation cluster.
+
+The acceptance methodology for the learned throughput oracle
+(shockwave_tpu/oracle/, README "Learned throughput oracle"):
+
+1. **Derive a mixed-generation truth table.** The committed v5e
+   profile (data/v5e_throughputs.json) becomes the ``v5-lite`` rates;
+   the newer ``v5`` generation is derived analytically: SPEEDUP x the
+   single-chip rate, scaled by the v5-lite key's relative multi-chip
+   efficiency raised to COMM_EXPONENT < 1 — the newer interconnect
+   loses less to communication at the same scale factor (the
+   generation-specific comm-scaling term the oracle's feature vector
+   carries).
+2. **Fabricate a training history** (an obs/history.py payload):
+   noisy observations of every profiled family on both generations —
+   except the COLD family, which appears only at scale factor 1 on
+   ``v5-lite`` (the "one staging run" story). Train the model with
+   ``python -m shockwave_tpu.oracle.train``.
+3. **Phase A (baseline):** simulate the trace with the FULL truth
+   table as the profiled oracle, learned oracle disabled — every job's
+   rate is known exactly. Per-job JCTs are the reference.
+4. **Phase B (cold start):** the scheduler sees the truth table MINUS
+   every cold-family key; the oracle chain predicts the cold jobs'
+   rates (learned provenance), the sim executes them at the held-out
+   TRUTH rate (``truth_file``), and the planning view converges
+   online from observed completions.
+5. **Gate:** every cold job's phase-B JCT must land within
+   --envelope (default 15%) of its phase-A JCT.
+
+Everything is a pure function of --seed: the artifacts under
+--out (reproduce/oracle/) are byte-reproducible and cmp'd in CI.
+Exits nonzero when the envelope is violated.
+"""
+import argparse
+import copy
+import json
+import os
+import random
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.core.constants import DEFAULT_BS  # noqa: E402
+from shockwave_tpu.core.job import Job, JobIdPair  # noqa: E402
+from shockwave_tpu.core.oracle import (read_throughputs,  # noqa: E402
+                                       write_throughputs)
+from shockwave_tpu.obs import names as obs_names  # noqa: E402
+from shockwave_tpu.obs.logconfig import setup_logging  # noqa: E402
+from shockwave_tpu.oracle import train as oracle_train  # noqa: E402
+
+import driver_common  # noqa: E402
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+#: The two generations of the study cluster. v5-lite carries the
+#: committed v5e rates verbatim; v5 is derived (see module docstring).
+LITE, NEW = "v5-lite", "v5"
+SPEEDUP = 2.25        # v5 single-chip rate multiple of v5-lite
+COMM_EXPONENT = 0.6   # v5 keeps more of its scaling efficiency
+
+#: The never-profiled family: held out of the scheduler-visible table
+#: and of the training history except scale-factor-1 rows on v5-lite.
+COLD_FAMILY = "ResNet-50"
+
+
+def batch_size_of(job_type: str) -> int:
+    m = re.search(r"batch size (\d+)\)", job_type)
+    if m is not None:
+        return int(m.group(1))
+    return DEFAULT_BS[job_type.split(" ", 1)[0]]
+
+
+def derive_truth(lite_table: dict) -> dict:
+    """{worker_type: {(job_type, sf): {"null": rate}}} for both
+    generations. No __meta__ key: the study must not flip the
+    scheduler's deployment-faithful round mechanics."""
+    truth = {LITE: {}, NEW: {}}
+    for key in sorted(lite_table):
+        job_type, sf = key
+        rate = float(lite_table[key]["null"])
+        truth[LITE][key] = {"null": rate}
+        base = float(lite_table.get((job_type, 1), {}).get("null", 0.0))
+        if rate <= 0.0 or base <= 0.0:
+            truth[NEW][key] = {"null": 0.0}
+            continue
+        rel_eff = rate / (sf * base)
+        truth[NEW][key] = {
+            "null": round(SPEEDUP * base * sf * rel_eff ** COMM_EXPONENT, 4)}
+    return truth
+
+
+def fabricate_history(truth: dict, seed: int) -> dict:
+    """An obs/history.py payload whose observation rows cover every
+    warm family on both generations, and the cold family ONLY at scale
+    factor 1 on v5-lite."""
+    rng = random.Random(seed + 17)
+    rows = []
+    rnd = 0
+    for wt in (LITE, NEW):
+        for key in sorted(truth[wt]):
+            job_type, sf = key
+            rate = truth[wt][key]["null"]
+            if rate <= 0.0:
+                continue
+            cold = job_type.split(" ", 1)[0] == COLD_FAMILY
+            if cold and (wt != LITE or sf != 1):
+                continue
+            for _ in range(2):
+                rnd += 1
+                noisy = round(rate * rng.lognormvariate(0.0, 0.03), 6)
+                rows.append([rnd, job_type, batch_size_of(job_type),
+                             int(sf), wt, noisy])
+    return {"schema": 1, "observations_schema": 1, "rounds": [],
+            "observations": rows, "serving": [], "alerts": {}}
+
+
+def build_trace(truth: dict, seed: int, num_jobs: int,
+                cold_positions: tuple):
+    """Deterministic trace: `num_jobs` jobs, the cold-family ones at
+    `cold_positions` (mid-trace). Durations are the job's ISOLATED
+    v5-lite runtime (steps = duration x v5-lite rate), so phase-A JCTs
+    are queueing + contention on top of a known floor."""
+    rng = random.Random(seed)
+    warm = sorted(
+        key for key, entry in truth[LITE].items()
+        if entry["null"] > 0.0 and key[1] in (1, 2, 4)
+        and key[0].split(" ", 1)[0] != COLD_FAMILY)
+    cold = sorted(
+        key for key, entry in truth[LITE].items()
+        if entry["null"] > 0.0 and key[1] in (1, 2, 4)
+        and key[0].split(" ", 1)[0] == COLD_FAMILY)
+    jobs, arrivals, t = [], [], 0.0
+    for i in range(num_jobs):
+        job_type, sf = (rng.choice(cold) if i in cold_positions
+                        else rng.choice(warm))
+        duration = float(round(rng.uniform(1800.0, 7200.0)))
+        steps = int(duration * truth[LITE][(job_type, sf)]["null"])
+        assert steps > 0
+        jobs.append(Job(
+            job_id=None, job_type=job_type,
+            command=f"python train.py --model {job_type.split(' ', 1)[0]} "
+                    f"{batch_size_of(job_type)}",
+            total_steps=steps, duration=duration, scale_factor=sf,
+            mode="static"))
+        arrivals.append(round(t, 2))
+        t += rng.expovariate(1.0 / 240.0)
+    return jobs, arrivals
+
+
+def run_phase(jobs, arrivals, cluster_spec, throughputs_file, *,
+              policy: str, round_duration: float, seed: int,
+              oracle_config=None):
+    sched = driver_common.build_scheduler(
+        policy, throughputs_file, None, round_duration=round_duration,
+        seed=seed, oracle_config=oracle_config)
+    makespan = sched.simulate(dict(cluster_spec), list(arrivals),
+                              copy.deepcopy(jobs))
+    jcts = {}
+    for i in range(len(jobs)):
+        jcts[i] = sched.acct.completion_times.get(JobIdPair(i))
+    reg = sched._obs.registry
+    counters = {
+        "predictions_profiled": reg.value(
+            obs_names.ORACLE_PREDICTIONS_TOTAL, provenance="profiled"),
+        "predictions_learned": reg.value(
+            obs_names.ORACLE_PREDICTIONS_TOTAL, provenance="learned"),
+        "predictions_prior": reg.value(
+            obs_names.ORACLE_PREDICTIONS_TOTAL, provenance="prior"),
+        "online_updates": reg.value(
+            obs_names.ORACLE_ONLINE_UPDATES_TOTAL),
+    }
+    return makespan, jcts, counters
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default=os.path.join(REPO, "reproduce/oracle"))
+    p.add_argument("--throughputs",
+                   default=os.path.join(REPO, "data/v5e_throughputs.json"))
+    p.add_argument("--policy", default="max_min_fairness_perf")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--num_jobs", type=int, default=16)
+    p.add_argument("--round_duration", type=float, default=120.0)
+    p.add_argument("--lite_chips", type=int, default=8)
+    p.add_argument("--new_chips", type=int, default=8)
+    p.add_argument("--min_confidence", type=float, default=0.3)
+    p.add_argument("--envelope", type=float, default=0.15,
+                   help="max per-cold-job |JCT_B - JCT_A| / JCT_A")
+    args = p.parse_args(argv)
+    setup_logging("warning")
+    os.makedirs(args.out, exist_ok=True)
+
+    lite_table = read_throughputs(args.throughputs)["v5e"]
+    truth = derive_truth(lite_table)
+    truth_path = os.path.join(args.out, "truth_mixed.json")
+    write_throughputs(truth_path, truth)
+
+    visible = {
+        wt: {key: entry for key, entry in sorted(per_type.items())
+             if key[0].split(" ", 1)[0] != COLD_FAMILY}
+        for wt, per_type in truth.items()}
+    visible_path = os.path.join(args.out, "profiled_minus_cold.json")
+    write_throughputs(visible_path, visible)
+
+    history = fabricate_history(truth, args.seed)
+    history_path = os.path.join(args.out, "history_train.json")
+    with open(history_path, "w") as f:
+        json.dump(history, f, sort_keys=True, indent=2)
+        f.write("\n")
+
+    model_path = os.path.join(args.out, "model.json")
+    rc = oracle_train.main(["--history", history_path,
+                            "--out", model_path,
+                            "--seed", str(args.seed)])
+    if rc != 0:
+        return rc
+
+    cold_positions = (args.num_jobs // 2,
+                      args.num_jobs // 2 + 3,
+                      args.num_jobs - 2)
+    jobs, arrivals = build_trace(truth, args.seed, args.num_jobs,
+                                 cold_positions)
+    cluster_spec = {LITE: args.lite_chips, NEW: args.new_chips}
+
+    makespan_a, jct_a, _ = run_phase(
+        jobs, arrivals, cluster_spec, truth_path, policy=args.policy,
+        round_duration=args.round_duration, seed=args.seed)
+    makespan_b, jct_b, counters = run_phase(
+        jobs, arrivals, cluster_spec, visible_path, policy=args.policy,
+        round_duration=args.round_duration, seed=args.seed,
+        oracle_config={"model": model_path,
+                       "min_confidence": args.min_confidence,
+                       "truth_file": truth_path})
+
+    per_job, worst = [], 0.0
+    for i, job in enumerate(jobs):
+        a, b = jct_a[i], jct_b[i]
+        rel = (abs(b - a) / a if a and b else None)
+        cold = i in cold_positions
+        if cold and rel is not None:
+            worst = max(worst, rel)
+        per_job.append({
+            "id": i, "job_type": job.job_type,
+            "scale_factor": job.scale_factor,
+            "duration_s": job.duration,
+            "arrival_s": arrivals[i],
+            "cold": cold,
+            "jct_baseline_s": round(a, 2) if a else None,
+            "jct_coldstart_s": round(b, 2) if b else None,
+            "rel_delta": round(rel, 4) if rel is not None else None,
+        })
+    within = worst <= args.envelope
+    result = {
+        "meta": {
+            "seed": args.seed, "num_jobs": args.num_jobs,
+            "policy": args.policy,
+            "round_duration_s": args.round_duration,
+            "cluster_spec": cluster_spec,
+            "cold_family": COLD_FAMILY,
+            "cold_positions": list(cold_positions),
+            "v5_speedup": SPEEDUP, "comm_exponent": COMM_EXPONENT,
+            "min_confidence": args.min_confidence,
+            "envelope": args.envelope,
+        },
+        "makespan_baseline_s": round(makespan_a, 2),
+        "makespan_coldstart_s": round(makespan_b, 2),
+        "oracle_counters": counters,
+        "cold_start": {"max_rel_delta": round(worst, 4),
+                       "within_envelope": within},
+        "jobs": per_job,
+    }
+    result_path = os.path.join(args.out, "coldstart_mixed_study.json")
+    with open(result_path, "w") as f:
+        json.dump(result, f, sort_keys=True, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "makespan_baseline_s": result["makespan_baseline_s"],
+        "makespan_coldstart_s": result["makespan_coldstart_s"],
+        "max_cold_rel_delta": result["cold_start"]["max_rel_delta"],
+        "within_envelope": within,
+        "out": result_path}, sort_keys=True))
+    return 0 if within else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,7 +95,7 @@ def main():
                 f"--cluster_spec {wt}:{count} is not divisible by "
                 f"--chips_per_server {args.chips_per_server}")
 
-    shockwave_config, serving_config, whatif_config = (
+    shockwave_config, serving_config, whatif_config, oracle_config = (
         driver_common.load_configs(args.config, args.policy, cluster_spec,
                                    args.round_duration))
 
@@ -121,7 +121,8 @@ def main():
         round_duration=args.round_duration, seed=args.seed,
         max_rounds=args.max_rounds, shockwave_config=shockwave_config,
         serving_config=serving_config, whatif_config=whatif_config,
-        rate_override=rate_override, vectorized=not args.scalar_sim)
+        oracle_config=oracle_config, rate_override=rate_override,
+        vectorized=not args.scalar_sim)
 
     profiler = None
     if args.profile_out:

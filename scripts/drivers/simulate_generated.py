@@ -77,7 +77,7 @@ def main():
     profiles = build_profiles(jobs, throughputs,
                               worker_type=reference_worker_type)
 
-    shockwave_config, serving_config, whatif_config = (
+    shockwave_config, serving_config, whatif_config, oracle_config = (
         driver_common.load_configs(args.config, args.policy, cluster_spec,
                                    args.round_duration))
 
@@ -86,7 +86,7 @@ def main():
         round_duration=args.round_duration, seed=args.seed,
         max_rounds=args.max_rounds, shockwave_config=shockwave_config,
         serving_config=serving_config, whatif_config=whatif_config,
-        vectorized=not args.scalar_sim)
+        oracle_config=oracle_config, vectorized=not args.scalar_sim)
 
     makespan = sched.simulate(cluster_spec, arrival_times, jobs)
 

@@ -254,7 +254,7 @@ def run_state_scenario(seed_index, cfg):
     cluster_spec = parse_cluster_spec(cfg["cluster_spec"])
     throughputs = read_throughputs(cfg["throughputs"])
     profiles = build_profiles(jobs, throughputs)
-    shockwave_config, serving_config, _ = (
+    shockwave_config, serving_config, _, _ = (
         driver_common.load_configs(cfg["config"], cfg["policy"],
                                    cluster_spec, cfg["round_duration"]))
     config = SchedulerConfig(
@@ -300,7 +300,7 @@ def run_scenario(payload):
 
             throughputs = read_throughputs(cfg["throughputs"])
             profiles = build_profiles(jobs, throughputs)
-            shockwave_config, serving_config, whatif_config = (
+            shockwave_config, serving_config, whatif_config, _ = (
                 driver_common.load_configs(cfg["config"], cfg["policy"],
                                            cluster_spec,
                                            cfg["round_duration"]))

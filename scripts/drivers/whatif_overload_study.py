@@ -64,7 +64,7 @@ def run_leg(args, whatif_config):
     cluster_spec = parse_cluster_spec(args.cluster_spec)
     throughputs = read_throughputs(args.throughputs)
     profiles = build_profiles(jobs, throughputs)
-    shockwave_config, serving_config, _ = driver_common.load_configs(
+    shockwave_config, serving_config, _, _ = driver_common.load_configs(
         args.config, args.policy, cluster_spec, args.round_duration)
     sched = driver_common.build_scheduler(
         args.policy, args.throughputs, profiles,

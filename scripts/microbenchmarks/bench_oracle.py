@@ -1,0 +1,147 @@
+#!/usr/bin/env python3
+"""Learned throughput oracle microbenchmark: fit wall, predictions/s,
+online-update cost.
+
+Measures the three costs the oracle charges the control plane:
+
+- **fit** — `ThroughputModel.fit` over a seeded synthetic history
+  (the offline `oracle.train` path; closed-form ridge, so this is
+  the normal-equation assembly + solve wall),
+- **predict** — `predict()` throughput on the fitted model (the
+  per-job cold-start cost in `Scheduler._set_initial_throughput`;
+  one featurize + dot product + correction lookup),
+- **observe** — `observe()` online-correction cost (charged once per
+  Done report in `_update_throughput`).
+
+The synthetic history is a pure function of --seed (model families x
+batch sizes x scale factors x two worker generations, rates from a
+seeded log-normal around an analytic speedup surface), so repeated
+runs fit the identical model. Prints ONE JSON line; bench.py embeds
+it as the `oracle_phase` row. ``--smoke`` exits nonzero when fit wall
+exceeds --max_fit_s or prediction throughput falls below
+--min_predictions_per_s (CI floors: the oracle must stay far off the
+round-loop critical path).
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from shockwave_tpu.obs.logconfig import setup_logging  # noqa: E402
+from shockwave_tpu.oracle.model import ThroughputModel  # noqa: E402
+
+FAMILIES = ("LM", "ResNet-18", "ResNet-50", "Transformer",
+            "Recommendation", "CycleGAN", "A3C")
+BATCH_SIZES = (16, 32, 64, 128)
+SCALE_FACTORS = (1, 2, 4, 8)
+WORKER_TYPES = (("v5-lite", 1.0), ("v5", 2.25))
+
+
+def synthetic_rows(seed: int, copies: int):
+    """Seeded training rows: every (family, bs, sf, worker type) cell,
+    `copies` noisy observations each."""
+    rng = random.Random(seed)
+    rows = []
+    for _ in range(copies):
+        for fi, fam in enumerate(FAMILIES):
+            base = 2.0 * (fi + 1)
+            for bs in BATCH_SIZES:
+                for sf in SCALE_FACTORS:
+                    for wt, gain in WORKER_TYPES:
+                        rate = (base * gain * (bs / 16.0)
+                                * sf ** 0.85 * rng.lognormvariate(0.0, 0.05))
+                        rows.append((f"{fam} (batch size {bs})",
+                                     bs, sf, wt, rate))
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--copies", type=int, default=4,
+                   help="noisy observations per (family,bs,sf,type) cell")
+    p.add_argument("--fits", type=int, default=5)
+    p.add_argument("--predictions", type=int, default=20000)
+    p.add_argument("--observations", type=int, default=20000)
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--max_fit_s", type=float, default=2.0,
+                   help="--smoke: fail when one fit exceeds this")
+    p.add_argument("--min_predictions_per_s", type=float, default=2000.0,
+                   help="--smoke: fail below this prediction throughput")
+    p.add_argument("--output", default=None, help="also write the JSON")
+    args = p.parse_args()
+    setup_logging("warning")
+
+    rows = synthetic_rows(args.seed, args.copies)
+
+    t0 = time.monotonic()
+    for _ in range(args.fits):
+        model = ThroughputModel.fit(rows, seed=args.seed)
+    fit_wall = time.monotonic() - t0
+    mean_fit = fit_wall / max(args.fits, 1)
+
+    # Mixed query stream: in-vocabulary cells plus a never-seen family
+    # (the hash-bucket path every cold-start prediction takes).
+    queries = []
+    rng = random.Random(args.seed + 1)
+    for _ in range(args.predictions):
+        if rng.random() < 0.25:
+            queries.append(("Unseen (batch size 8)", 8, 2, "v5"))
+        else:
+            fam = rng.choice(FAMILIES)
+            bs = rng.choice(BATCH_SIZES)
+            queries.append((f"{fam} (batch size {bs})", bs,
+                            rng.choice(SCALE_FACTORS),
+                            rng.choice(WORKER_TYPES)[0]))
+    t0 = time.monotonic()
+    for jt, bs, sf, wt in queries:
+        model.predict(jt, bs, sf, wt)
+    predict_wall = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    for i in range(args.observations):
+        jt, bs, sf, wt = queries[i % len(queries)]
+        model.observe(jt, bs, sf, wt, 1.0 + (i % 7))
+    observe_wall = time.monotonic() - t0
+
+    predictions_per_s = (args.predictions / predict_wall
+                         if predict_wall > 0 else None)
+    line = {
+        "training_rows": len(rows),
+        "fits": args.fits,
+        "fit_wall_s": round(fit_wall, 3),
+        "mean_fit_s": round(mean_fit, 5),
+        "rmse": model.rmse,
+        "predictions": args.predictions,
+        "predict_wall_s": round(predict_wall, 3),
+        "predictions_per_s": round(predictions_per_s, 1)
+        if predictions_per_s is not None else None,
+        "observations": args.observations,
+        "observe_wall_s": round(observe_wall, 3),
+        "observations_per_s": round(args.observations / observe_wall, 1)
+        if observe_wall > 0 else None,
+    }
+    print(json.dumps(line))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(line, f)
+            f.write("\n")
+    if args.smoke:
+        if mean_fit > args.max_fit_s:
+            print(f"SMOKE FAIL: mean fit {mean_fit:.3f}s > "
+                  f"{args.max_fit_s}s", file=sys.stderr)
+            return 1
+        if predictions_per_s is not None and \
+                predictions_per_s < args.min_predictions_per_s:
+            print(f"SMOKE FAIL: {predictions_per_s:.0f} predictions/s < "
+                  f"{args.min_predictions_per_s:.0f}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -344,6 +344,13 @@ DETERMINISM_SCOPE_GLOBS = (
     "shockwave_tpu/serving/*.py",
     "shockwave_tpu/obs/quantiles.py",
     "scripts/drivers/serving_measured_calibration.py",
+    # The learned throughput oracle: model fits, featurization (hash
+    # buckets are md5-of-string, never Python hash()) and online
+    # corrections must be pure functions of (history rows, seed) —
+    # the trained model file and the mixed-generation cold-start
+    # study are byte-compared in CI.
+    "shockwave_tpu/oracle/*.py",
+    "scripts/drivers/oracle_coldstart_study.py",
 )
 #: Wall-clock measurement utilities (two-point marginal timing) are the
 #: sanctioned home for real clocks.

@@ -13,11 +13,18 @@ The matrix-completion step replaces the reference's external
 solver (`als_complete`) — fully vectorized numpy; the matrices involved
 are tiny (num_reference_types x num_reference_types*num_worker_types), so
 this runs in microseconds on the scheduler host.
+
+This module also hosts `OracleThroughputChain`: the strict fallback
+chain the scheduler consults for ISOLATED rates — profiled table ->
+learned model (`shockwave_tpu/oracle`) -> conservative prior — with
+every prediction tagged with provenance and a confidence that gates how
+much the planner trusts it (README "Learned throughput oracle").
 """
 from __future__ import annotations
 
 import random
-from typing import Dict, Sequence
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
@@ -189,5 +196,137 @@ class ThroughputEstimator:
         return out
 
 
+# ----------------------------------------------------------------------
+# Learned-oracle fallback chain (shockwave_tpu/oracle)
+# ----------------------------------------------------------------------
+
+PROVENANCE_PROFILED = "profiled"
+PROVENANCE_LEARNED = "learned"
+PROVENANCE_PRIOR = "prior"
+
+#: Matches sched.scheduler.DEFAULT_THROUGHPUT (not imported: core must
+#: not depend on sched) — the rate the learn-online path starts from.
+CONSERVATIVE_PRIOR_STEPS_PER_S = 1.0
+
+#: Default trust gate: a learned prediction below this confidence is
+#: demoted to the conservative prior.
+DEFAULT_MIN_CONFIDENCE = 0.3
+
+
+@dataclass(frozen=True)
+class ThroughputPrediction:
+    steps_per_s: float
+    provenance: str      # profiled | learned | prior
+    confidence: float
+
+
+class OracleThroughputChain:
+    """profiled table -> learned model -> conservative prior.
+
+    Constructed only when `SchedulerConfig.oracle` is set; with it unset
+    the scheduler never instantiates this class and every
+    profiled-table code path is byte-identical to the pre-oracle build.
+    `observe` feeds Done-report rates back into the learned model's
+    online residual corrections, so a cold-start prediction converges
+    toward the measured rate as micro-tasks complete.
+    """
+
+    def __init__(self, profiled: Optional[Dict[str, dict]] = None,
+                 model=None,
+                 min_confidence: float = DEFAULT_MIN_CONFIDENCE,
+                 online_alpha: Optional[float] = None):
+        #: Parsed oracle table ({worker_type: {(job_type, sf): {...}}},
+        #: core.oracle.read_oracle output) — may be None (no file).
+        self._profiled = profiled
+        self._model = model
+        self.min_confidence = float(min_confidence)
+        self._online_alpha = online_alpha
+
+    @classmethod
+    def from_config(cls, cfg: dict,
+                    profiled: Optional[Dict[str, dict]] = None
+                    ) -> "OracleThroughputChain":
+        """Build from a `SchedulerConfig.oracle` dict: ``model`` (path
+        to an oracle.train artifact), ``min_confidence``,
+        ``online_alpha``."""
+        model = None
+        model_path = (cfg or {}).get("model")
+        if model_path:
+            from ..oracle.model import ThroughputModel
+            model = ThroughputModel.load(model_path)
+        return cls(profiled=profiled, model=model,
+                   min_confidence=float(
+                       (cfg or {}).get("min_confidence",
+                                       DEFAULT_MIN_CONFIDENCE)),
+                   online_alpha=(cfg or {}).get("online_alpha"))
+
+    @property
+    def model(self):
+        return self._model
+
+    def _profiled_rate(self, job_type: str, scale_factor: int,
+                       worker_type: str) -> Optional[float]:
+        table = (self._profiled or {}).get(worker_type)
+        if not table:
+            return None
+        entry = table.get((job_type, int(scale_factor)))
+        if entry is None:
+            return None
+        rate = entry.get("null", 0.0)
+        return float(rate) if rate and rate > 0.0 else None
+
+    def predict(self, job_type: str, batch_size, scale_factor: int,
+                worker_type: str) -> ThroughputPrediction:
+        profiled = self._profiled_rate(job_type, scale_factor,
+                                       worker_type)
+        if profiled is not None:
+            return ThroughputPrediction(profiled, PROVENANCE_PROFILED,
+                                        1.0)
+        if self._model is not None:
+            rate, confidence = self._model.predict(
+                job_type, batch_size, scale_factor, worker_type)
+            if confidence >= self.min_confidence:
+                return ThroughputPrediction(rate, PROVENANCE_LEARNED,
+                                            confidence)
+        return ThroughputPrediction(CONSERVATIVE_PRIOR_STEPS_PER_S,
+                                    PROVENANCE_PRIOR, 0.0)
+
+    def observe(self, job_type: str, batch_size, scale_factor: int,
+                worker_type: str, steps_per_s: float) -> None:
+        """Online refinement from a completed micro-task's observed
+        rate (no-op without a model)."""
+        if self._model is None:
+            return
+        kwargs = {}
+        if self._online_alpha is not None:
+            kwargs["alpha"] = float(self._online_alpha)
+        self._model.observe(job_type, batch_size, scale_factor,
+                            worker_type, steps_per_s, **kwargs)
+
+    def serving_mu(self, job_type: str, batch_size,
+                   worker_types: Sequence[str]) -> Optional[float]:
+        """Learned decode-rate prior for a serving service (requests/s
+        per replica, scale factor 1), or None — the caller must fall
+        back to the exact configured rate, so a model with ZERO samples
+        for this family leaves canonical serving replays bit-identical.
+        Returns the best trusted prediction across the cluster's worker
+        types (replicas land on whatever type has chips free)."""
+        if (self._model is None
+                or self._model.family_samples(job_type) == 0):
+            return None
+        best: Optional[float] = None
+        for wt in worker_types:
+            pred = self.predict(job_type, batch_size, 1, wt)
+            if pred.provenance != PROVENANCE_LEARNED:
+                continue
+            if best is None or pred.steps_per_s > best:
+                best = pred.steps_per_s
+        return best
+
+
 __all__ = ["ThroughputEstimator", "als_complete", "cosine_distance",
-           "MATRIX_COMPLETION_RANK", "MATRIX_COMPLETION_MU"]
+           "MATRIX_COMPLETION_RANK", "MATRIX_COMPLETION_MU",
+           "OracleThroughputChain", "ThroughputPrediction",
+           "PROVENANCE_PROFILED", "PROVENANCE_LEARNED",
+           "PROVENANCE_PRIOR", "CONSERVATIVE_PRIOR_STEPS_PER_S",
+           "DEFAULT_MIN_CONFIDENCE"]

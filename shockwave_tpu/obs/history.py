@@ -55,6 +55,29 @@ DEFAULT_FLUSH_INTERVAL_ROUNDS = 8
 
 HISTORY_SCHEMA = 1
 
+#: Schema of the per-microtask observation rows — the learned oracle's
+#: training set (shockwave_tpu/oracle/train.py). Versioned separately
+#: from the payload envelope so the trainer can skip-and-warn on rows
+#: written by a different build instead of KeyError-ing mid-fit.
+#: Version 1: ``[round:int, job_type:str, batch_size:int|float,
+#: scale_factor:int, worker_type:str, steps_per_s:float]``.
+OBSERVATIONS_SCHEMA = 1
+
+
+def valid_observation(entry) -> bool:
+    """Whether one observation ring row matches OBSERVATIONS_SCHEMA 1.
+    Shared by the ring loader (crash recovery) and oracle.train (both
+    must agree on what a training row is)."""
+    return (isinstance(entry, list) and len(entry) == 6
+            and isinstance(entry[0], int)
+            and isinstance(entry[1], str)
+            and isinstance(entry[2], (int, float))
+            and not isinstance(entry[2], bool)
+            and isinstance(entry[3], int)
+            and isinstance(entry[4], str)
+            and isinstance(entry[5], (int, float))
+            and not isinstance(entry[5], bool))
+
 #: Check names of the swtpu_alert gauge.
 CHECK_ROUND_OVERRUN = "round_overrun"
 CHECK_DISPATCH_BURN = "dispatch_failure_burn"
@@ -163,9 +186,19 @@ class TelemetryHistory:
                     and isinstance(entry.get("t"), (int, float))
                     and isinstance(entry.get("metrics"), dict)):
                 self._rounds.append(entry)
-        for entry in payload.get("observations", []):
-            if isinstance(entry, list) and len(entry) == 6:
-                self._observations.append(entry)
+        obs_schema = payload.get("observations_schema")
+        if obs_schema in (None, OBSERVATIONS_SCHEMA):
+            # None is a pre-versioning flush: its rows still validate
+            # individually. A different version contributes nothing.
+            for entry in payload.get("observations", []):
+                if valid_observation(entry):
+                    self._observations.append(entry)
+        else:
+            import logging
+            logging.getLogger("shockwave_tpu.obs").warning(
+                "telemetry history %s has observations_schema %r (this "
+                "build writes %d); dropping its observation rows",
+                self.path, obs_schema, OBSERVATIONS_SCHEMA)
         for entry in payload.get("serving", []):
             if (isinstance(entry, dict) and "service" in entry
                     and "round" in entry):
@@ -305,6 +338,7 @@ class TelemetryHistory:
         with self._lock:
             return {
                 "schema": HISTORY_SCHEMA,
+                "observations_schema": OBSERVATIONS_SCHEMA,
                 "rounds": list(self._rounds),
                 "observations": [list(o) for o in self._observations],
                 "serving": [dict(s) for s in self._serving],

@@ -443,6 +443,28 @@ ALERT = _gauge(
     "what-if forecasts", ("check",))
 
 # ----------------------------------------------------------------------
+# Learned throughput oracle (shockwave_tpu/oracle +
+# core/throughput_estimator.OracleThroughputChain)
+# ----------------------------------------------------------------------
+
+ORACLE_PREDICTIONS_TOTAL = _counter(
+    "swtpu_oracle_predictions_total",
+    "Throughput predictions served by the oracle fallback chain, by "
+    "provenance (profiled: offline table hit; learned: model "
+    "prediction above the confidence gate; prior: conservative "
+    "default)", ("provenance",))
+ORACLE_ONLINE_UPDATES_TOTAL = _counter(
+    "swtpu_oracle_online_updates_total",
+    "Observed micro-task rates folded back into the learned model's "
+    "online residual corrections")
+ORACLE_PREDICTION_REL_ERROR = _histogram(
+    "swtpu_oracle_prediction_rel_error",
+    "Relative error |observed - predicted| / observed of the oracle's "
+    "current estimate at each online update (converges toward 0 as "
+    "corrections accumulate)",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 10.0))
+
+# ----------------------------------------------------------------------
 # Offline harnesses (scripts/microbenchmarks, scripts/profiling)
 # ----------------------------------------------------------------------
 

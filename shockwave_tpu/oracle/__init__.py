@@ -1,0 +1,30 @@
+"""Learned throughput oracle (ROADMAP item 2; PAPERS.md 2008.01040).
+
+A deterministic, seeded regression over the telemetry history's
+per-microtask observed-rate rows — ``(job_type, batch_size,
+scale_factor, worker_type) -> steps/s`` — with a comm-scaling term per
+worker *generation* (cf. EQuARX, 2506.17615: interconnect efficiency is
+a property of the generation, not the individual profile row), so a
+model trained on one generation's scale curves extrapolates another's.
+
+Train offline from ``/history.json`` rings::
+
+    python -m shockwave_tpu.oracle.train --history state/history.json \
+        --out model.json
+
+and serve predictions through the strict fallback chain in
+`core/throughput_estimator.py` (profiled table -> learned prediction ->
+conservative prior), which also feeds Done-report rates back into the
+model's online residual corrections.
+
+Pure numpy; no wall clocks, no unseeded RNG (the analyzer determinism
+pass covers this package), byte-stable JSON artifacts.
+"""
+from .features import (FAMILY_HASH_BUCKETS, GENERATIONS, family_of,
+                       generation_of)
+from .model import MODEL_SCHEMA, ThroughputModel
+
+__all__ = [
+    "FAMILY_HASH_BUCKETS", "GENERATIONS", "family_of", "generation_of",
+    "MODEL_SCHEMA", "ThroughputModel",
+]

@@ -1,0 +1,103 @@
+"""Featurization for the learned throughput model.
+
+One training row ``(job_type, batch_size, scale_factor, worker_type)``
+becomes a fixed-width vector:
+
+- a bias term;
+- a one-hot over the model *families* seen at fit time ("LM",
+  "ResNet-18", ...), with families unseen at fit time hashed into a
+  small bucket block (seeded md5, never Python's per-process ``hash``)
+  so a cold-start family still gets a deterministic — if low-confidence
+  — slot;
+- ``log2(batch_size)`` and ``log2(scale_factor)``;
+- a one-hot over worker types (per-type intercepts: a v5 is faster than
+  a v5-lite at every scale factor);
+- a **comm-scaling interaction** per worker *generation*:
+  ``log2(scale_factor)`` gated on the generation one-hot. Scaling
+  efficiency is a property of the interconnect generation (EQuARX,
+  PAPERS.md 2506.17615), so two worker types of the same generation
+  share a scale curve and a new type of a known generation inherits it.
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import List
+
+import numpy as np
+
+#: Worker type -> interconnect/compute generation. Types absent here
+#: are their own generation (a singleton curve, learned if trained on).
+GENERATIONS = {
+    "k80": "gpu_kepler",
+    "p100": "gpu_pascal",
+    "v100": "gpu_volta",
+    "cpu": "cpu",
+    "v5e": "tpu_v5lite",
+    "v5-lite": "tpu_v5lite",
+    "v5": "tpu_v5",
+}
+
+#: Hash-bucket block width for families unseen at fit time.
+FAMILY_HASH_BUCKETS = 4
+
+
+def family_of(job_type: str) -> str:
+    """Model family of an oracle job_type key ("LM (batch size 10)" ->
+    "LM"; suffix-less families like "A3C" are their own family)."""
+    return job_type.split(" (batch size", 1)[0]
+
+
+def generation_of(worker_type: str) -> str:
+    return GENERATIONS.get(worker_type, worker_type)
+
+
+def family_bucket(family: str, seed: int) -> int:
+    """Deterministic seeded bucket for an out-of-vocabulary family
+    (md5, not the interpreter's salted ``hash``)."""
+    digest = hashlib.md5(f"{seed}:{family}".encode("utf-8")).hexdigest()
+    return int(digest, 16) % FAMILY_HASH_BUCKETS
+
+
+def _log2(value, floor: float = 1.0) -> float:
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        v = floor
+    return math.log2(max(v, floor))
+
+
+def feature_dim(families: List[str], worker_types: List[str],
+                generations: List[str]) -> int:
+    return (1 + len(families) + FAMILY_HASH_BUCKETS + 2
+            + len(worker_types) + len(generations))
+
+
+def featurize(job_type: str, batch_size, scale_factor: int,
+              worker_type: str, families: List[str],
+              worker_types: List[str], generations: List[str],
+              seed: int) -> np.ndarray:
+    """The feature vector; vocab lists are the model's (fit-time,
+    sorted) vocabularies."""
+    fam = family_of(job_type)
+    gen = generation_of(worker_type)
+    x = np.zeros(feature_dim(families, worker_types, generations),
+                 dtype=np.float64)
+    x[0] = 1.0
+    off = 1
+    if fam in families:
+        x[off + families.index(fam)] = 1.0
+    off += len(families)
+    if fam not in families:
+        x[off + family_bucket(fam, seed)] = 1.0
+    off += FAMILY_HASH_BUCKETS
+    x[off] = _log2(batch_size)
+    x[off + 1] = _log2(scale_factor)
+    log_sf = x[off + 1]
+    off += 2
+    if worker_type in worker_types:
+        x[off + worker_types.index(worker_type)] = 1.0
+    off += len(worker_types)
+    if gen in generations:
+        x[off + generations.index(gen)] = log_sf
+    return x

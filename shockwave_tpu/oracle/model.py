@@ -1,0 +1,244 @@
+"""The learned throughput model: seeded ridge regression in log space
+plus online residual corrections.
+
+Offline fit (`ThroughputModel.fit`): closed-form normal equations over
+the featurized history rows (`features.featurize`), predicting
+``log(steps/s)`` — pure numpy float64, no iterative solver, so two fits
+of the same rows are bit-identical and the saved JSON artifact is
+byte-stable (sorted keys, 12-significant-digit floats).
+
+Online refinement (`observe`): as Done reports stream in, the residual
+``log(observed) - log(fit)`` is EMA-tracked per exact
+``(family, batch_size, scale_factor, worker_type)`` key and applied
+multiplicatively on top of the fit — the planner's view converges to
+the measured rate without refitting mid-run.
+
+Every prediction carries a confidence in [0, 1) from the evidence
+behind it: online-corrected exact keys count most, fit-time rows for
+the same (family, worker_type) next, same-family rows on *other* worker
+types least (those predictions lean on the per-type intercept and the
+per-generation comm-scaling term — the heterogeneous-cluster
+extrapolation path). The chain in `core/throughput_estimator.py` gates
+planner trust on it.
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .features import (family_of, feature_dim, featurize, generation_of)
+
+MODEL_SCHEMA = 1
+
+#: Default L2 regularizer for the normal equations.
+DEFAULT_RIDGE = 1e-3
+
+#: Default EMA weight for online residual corrections.
+DEFAULT_ONLINE_ALPHA = 0.5
+
+#: Residual clamp: one wild measurement (a stalled gang, a clock skew)
+#: must not swing a correction by more than e^3 ~ 20x.
+RESIDUAL_CLAMP = 3.0
+
+#: Confidence evidence weights: exact online observations / fit rows on
+#: the same (family, worker_type) / same-family rows elsewhere (the
+#: cross-generation extrapolation path).
+_W_EXACT, _W_TYPE, _W_FAMILY = 4.0, 1.0, 0.5
+_CONF_HALF = 4.0
+
+_RATE_FLOOR, _RATE_CEIL = 1e-6, 1e9
+
+
+def _round12(x: float) -> float:
+    """Round to 12 significant digits: stable under JSON round-trip,
+    far above any physical measurement precision."""
+    return float(f"{float(x):.12g}")
+
+
+def _corr_key(family: str, batch_size, scale_factor: int,
+              worker_type: str) -> str:
+    return f"{family}|{batch_size}|{int(scale_factor)}|{worker_type}"
+
+
+class ThroughputModel:
+    """Featurized log-throughput regression with online corrections."""
+
+    def __init__(self, seed: int = 0, ridge: float = DEFAULT_RIDGE,
+                 families: Optional[List[str]] = None,
+                 worker_types: Optional[List[str]] = None,
+                 generations: Optional[List[str]] = None,
+                 weights: Optional[Sequence[float]] = None,
+                 rmse: float = 0.0, n_rows: int = 0,
+                 support: Optional[Dict[str, Dict[str, int]]] = None,
+                 corrections: Optional[Dict[str, List[float]]] = None):
+        self.seed = int(seed)
+        self.ridge = float(ridge)
+        self.families = list(families or [])
+        self.worker_types = list(worker_types or [])
+        self.generations = list(generations or [])
+        dim = feature_dim(self.families, self.worker_types,
+                          self.generations)
+        self.weights = (np.asarray(weights, dtype=np.float64)
+                        if weights is not None
+                        else np.zeros(dim, dtype=np.float64))
+        if self.weights.shape != (dim,):
+            raise ValueError(
+                f"weight vector has dim {self.weights.shape}, vocab "
+                f"implies {dim}")
+        self.rmse = float(rmse)
+        self.n_rows = int(n_rows)
+        #: family -> worker_type -> fit-row count.
+        self.support: Dict[str, Dict[str, int]] = {
+            f: dict(by_wt) for f, by_wt in (support or {}).items()}
+        #: exact-key -> [log-residual EMA, observation count].
+        self.corrections: Dict[str, List[float]] = {
+            k: [float(v[0]), int(v[1])]
+            for k, v in (corrections or {}).items()}
+
+    # -- fitting --------------------------------------------------------
+
+    @classmethod
+    def fit(cls, rows: Sequence[tuple], seed: int = 0,
+            ridge: float = DEFAULT_RIDGE) -> "ThroughputModel":
+        """Fit from ``(job_type, batch_size, scale_factor, worker_type,
+        steps_per_s)`` rows (rates <= 0 are dropped)."""
+        clean = [r for r in rows if float(r[4]) > 0.0]
+        if not clean:
+            raise ValueError("no positive-rate training rows")
+        families = sorted({family_of(str(r[0])) for r in clean})
+        worker_types = sorted({str(r[3]) for r in clean})
+        generations = sorted({generation_of(wt) for wt in worker_types})
+        dim = feature_dim(families, worker_types, generations)
+        X = np.empty((len(clean), dim), dtype=np.float64)
+        y = np.empty(len(clean), dtype=np.float64)
+        support: Dict[str, Dict[str, int]] = {}
+        for i, (job_type, bs, sf, wt, rate) in enumerate(clean):
+            X[i] = featurize(str(job_type), bs, int(sf), str(wt),
+                             families, worker_types, generations, seed)
+            y[i] = math.log(float(rate))
+            fam = family_of(str(job_type))
+            by_wt = support.setdefault(fam, {})
+            by_wt[str(wt)] = by_wt.get(str(wt), 0) + 1
+        A = X.T @ X + float(ridge) * np.eye(dim)
+        w = np.linalg.solve(A, X.T @ y)
+        # Round the solved weights once so save/load and a fresh fit
+        # agree bitwise (linalg noise below 1e-12 relative is dropped).
+        w = np.array([_round12(v) for v in w], dtype=np.float64)
+        rmse = _round12(math.sqrt(float(np.mean((X @ w - y) ** 2))))
+        return cls(seed=seed, ridge=ridge, families=families,
+                   worker_types=worker_types, generations=generations,
+                   weights=w, rmse=rmse, n_rows=len(clean),
+                   support=support)
+
+    # -- prediction -----------------------------------------------------
+
+    def _base(self, job_type: str, batch_size, scale_factor: int,
+              worker_type: str) -> float:
+        x = featurize(job_type, batch_size, int(scale_factor),
+                      worker_type, self.families, self.worker_types,
+                      self.generations, self.seed)
+        return float(np.clip(math.exp(float(x @ self.weights)),
+                             _RATE_FLOOR, _RATE_CEIL))
+
+    def predict(self, job_type: str, batch_size, scale_factor: int,
+                worker_type: str) -> Tuple[float, float]:
+        """(steps_per_s, confidence)."""
+        fam = family_of(job_type)
+        key = _corr_key(fam, batch_size, scale_factor, worker_type)
+        rate = self._base(job_type, batch_size, scale_factor,
+                          worker_type)
+        corr = self.corrections.get(key)
+        n_exact = 0
+        if corr is not None:
+            rate = float(np.clip(rate * math.exp(corr[0]),
+                                 _RATE_FLOOR, _RATE_CEIL))
+            n_exact = int(corr[1])
+        by_wt = self.support.get(fam, {})
+        n_type = by_wt.get(worker_type, 0)
+        n_family = sum(by_wt.values())
+        evidence = _W_EXACT * n_exact
+        if fam in self.families:
+            evidence += (_W_TYPE * n_type
+                         + _W_FAMILY * max(n_family - n_type, 0))
+        confidence = round(evidence / (evidence + _CONF_HALF), 6)
+        return rate, confidence
+
+    def family_samples(self, job_type: str) -> int:
+        """Total evidence rows behind this family: fit rows plus online
+        observations (the serving mu prior's zero-sample gate)."""
+        fam = family_of(job_type)
+        fit_rows = sum(self.support.get(fam, {}).values())
+        online = sum(int(v[1]) for k, v in self.corrections.items()
+                     if k.split("|", 1)[0] == fam)
+        return fit_rows + online
+
+    # -- online refinement ----------------------------------------------
+
+    def observe(self, job_type: str, batch_size, scale_factor: int,
+                worker_type: str, steps_per_s: float,
+                alpha: float = DEFAULT_ONLINE_ALPHA) -> None:
+        """Fold one observed rate into the exact-key residual EMA."""
+        rate = float(steps_per_s)
+        if rate <= 0.0:
+            return
+        base = self._base(job_type, batch_size, scale_factor,
+                          worker_type)
+        residual = max(-RESIDUAL_CLAMP,
+                       min(RESIDUAL_CLAMP, math.log(rate / base)))
+        fam = family_of(job_type)
+        key = _corr_key(fam, batch_size, scale_factor, worker_type)
+        prev = self.corrections.get(key)
+        if prev is None:
+            self.corrections[key] = [residual, 1]
+        else:
+            prev[0] = (1.0 - alpha) * prev[0] + alpha * residual
+            prev[1] = int(prev[1]) + 1
+
+    # -- serialization --------------------------------------------------
+
+    def to_payload(self) -> dict:
+        return {
+            "schema": MODEL_SCHEMA,
+            "seed": self.seed,
+            "ridge": _round12(self.ridge),
+            "families": list(self.families),
+            "worker_types": list(self.worker_types),
+            "generations": list(self.generations),
+            "weights": [_round12(v) for v in self.weights],
+            "rmse": _round12(self.rmse),
+            "n_rows": self.n_rows,
+            "support": {f: {wt: int(n) for wt, n in by_wt.items()}
+                        for f, by_wt in self.support.items()},
+            "corrections": {k: [_round12(v[0]), int(v[1])]
+                            for k, v in self.corrections.items()},
+        }
+
+    def save(self, path: str) -> None:
+        text = json.dumps(self.to_payload(), sort_keys=True, indent=2)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+
+    @classmethod
+    def from_payload(cls, payload: dict) -> "ThroughputModel":
+        if payload.get("schema") != MODEL_SCHEMA:
+            raise ValueError(
+                f"model schema {payload.get('schema')!r} unsupported "
+                f"(this build reads {MODEL_SCHEMA})")
+        return cls(seed=payload.get("seed", 0),
+                   ridge=payload.get("ridge", DEFAULT_RIDGE),
+                   families=payload.get("families", []),
+                   worker_types=payload.get("worker_types", []),
+                   generations=payload.get("generations", []),
+                   weights=payload.get("weights"),
+                   rmse=payload.get("rmse", 0.0),
+                   n_rows=payload.get("n_rows", 0),
+                   support=payload.get("support", {}),
+                   corrections=payload.get("corrections", {}))
+
+    @classmethod
+    def load(cls, path: str) -> "ThroughputModel":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_payload(json.load(f))

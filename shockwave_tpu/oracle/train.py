@@ -1,0 +1,111 @@
+"""Offline trainer: ``python -m shockwave_tpu.oracle.train``.
+
+Reads one or more telemetry-history rings (``/history.json`` payloads,
+obs/history.py) and fits a `ThroughputModel` from their per-microtask
+observation rows. Foreign, legacy or malformed rows are **skipped with
+a warning**, never a KeyError: the history file is an operational
+artifact that outlives schema changes, and a trainer that dies on one
+stale row cannot be run from cron.
+
+Emits one JSON summary line on stdout (row counts, vocab sizes, fit
+RMSE, output path) so drivers and CI can assert on the result.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import List, Tuple
+
+from ..obs.history import (HISTORY_SCHEMA, OBSERVATIONS_SCHEMA,
+                           valid_observation)
+from .model import DEFAULT_RIDGE, ThroughputModel
+
+logger = logging.getLogger("shockwave_tpu.oracle")
+
+
+def load_training_rows(paths: List[str]) -> Tuple[List[tuple], int]:
+    """(training rows, skipped count) from history payload files.
+
+    A row trains iff it passes `obs.history.valid_observation` AND its
+    rate is positive; everything else — foreign file schemas, a future
+    observations_schema, malformed or non-positive rows — is counted
+    and warned about once per file, not raised.
+    """
+    rows: List[tuple] = []
+    skipped = 0
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as exc:
+            logger.warning("skipping history %s: %s", path, exc)
+            continue
+        if not isinstance(payload, dict):
+            logger.warning("skipping history %s: not an object", path)
+            continue
+        if payload.get("schema") != HISTORY_SCHEMA:
+            logger.warning(
+                "skipping history %s: schema %r (this build reads %d)",
+                path, payload.get("schema"), HISTORY_SCHEMA)
+            continue
+        obs_schema = payload.get("observations_schema")
+        if obs_schema not in (None, OBSERVATIONS_SCHEMA):
+            # None is a pre-versioning ring: its rows still validate
+            # individually below. A *different* version does not.
+            logger.warning(
+                "skipping observations of %s: observations_schema %r "
+                "(this build reads %d)", path, obs_schema,
+                OBSERVATIONS_SCHEMA)
+            continue
+        bad = 0
+        for entry in payload.get("observations", []):
+            if not valid_observation(entry) or float(entry[5]) <= 0.0:
+                bad += 1
+                continue
+            _round, job_type, bs, sf, wt, rate = entry
+            rows.append((job_type, bs, int(sf), wt, float(rate)))
+        if bad:
+            logger.warning(
+                "skipped %d foreign/legacy/malformed observation rows "
+                "in %s", bad, path)
+            skipped += bad
+    return rows, skipped
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Fit the learned throughput model from telemetry "
+                    "history rings")
+    parser.add_argument("--history", nargs="+", required=True,
+                        help="history.json payload file(s)")
+    parser.add_argument("--out", required=True,
+                        help="model JSON output path")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--ridge", type=float, default=DEFAULT_RIDGE)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(levelname)s %(name)s: %(message)s")
+
+    rows, skipped = load_training_rows(args.history)
+    if not rows:
+        print(json.dumps({"error": "no usable training rows",
+                          "skipped_rows": skipped}))
+        return 1
+    model = ThroughputModel.fit(rows, seed=args.seed, ridge=args.ridge)
+    model.save(args.out)
+    print(json.dumps({
+        "rows": len(rows),
+        "skipped_rows": skipped,
+        "families": len(model.families),
+        "worker_types": len(model.worker_types),
+        "generations": len(model.generations),
+        "rmse": model.rmse,
+        "out": args.out,
+    }, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

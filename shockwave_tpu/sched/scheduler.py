@@ -243,6 +243,19 @@ class SchedulerConfig:
     # it. Requires state_dir. None (the default) constructs nothing —
     # canonical replays and non-HA physical runs are bit-identical.
     ha: Optional[dict] = None
+    # ---- learned throughput oracle (both modes; see README "Learned
+    # throughput oracle" and shockwave_tpu/oracle/) ----
+    # Keys: "model" (path to a `python -m shockwave_tpu.oracle.train`
+    # artifact), "min_confidence" (trust gate below which a learned
+    # prediction is demoted to the conservative prior),
+    # "online_alpha" (residual EMA weight), and — simulation only —
+    # "truth_file" (an oracle-format json of TRUE rates: jobs whose
+    # initial rate came from the chain execute at the truth rate while
+    # the planner's view converges online — the cold-start acceptance
+    # methodology, reproduce/oracle/). None (the default) constructs
+    # no chain at all: missing profiled entries raise/learn exactly as
+    # before and every canonical replay is bit-identical.
+    oracle: Optional[dict] = None
 
 
 class Scheduler:
@@ -272,6 +285,12 @@ class Scheduler:
         "_throughputs", "_priorities", "_deficits", "_last_reset_time",
         "_scheduled_jobs_in_prev_round", "_scheduled_jobs_in_current_round",
         "_rounds_since_reopt", "_shockwave_job_completed",
+        # Oracle-managed throughput bookkeeping: written by
+        # _set_initial_throughput and read by _update_throughput /
+        # _oracle_step_throughput — the same add_job / Done-report /
+        # round-loop paths as the maps above, so the same external
+        # synchronization (physical lock / single-threaded sim loop).
+        "_oracle_predicted",
     })
 
     def __init__(self, policy, simulate: bool = False,
@@ -508,6 +527,26 @@ class Scheduler:
             from ..whatif.plane import WhatIfPlane
             self._whatif = WhatIfPlane(self, self._config.whatif)
 
+        # Learned throughput oracle (shockwave_tpu/oracle/): the
+        # profiled-table -> learned-model -> conservative-prior chain
+        # behind core/throughput_estimator.py. None means not even the
+        # hook sites execute — the canonical replay path is untouched.
+        # _oracle_predicted maps (int job id, worker_type) of every
+        # entry the chain seeded (vs. the profiled table) to its
+        # provenance: those entries are "oracle-managed" — in
+        # simulation they execute at the truth-file rate while the
+        # planning view EMA-converges from observed completions.
+        self._oracle = None
+        self._oracle_truth = None
+        self._oracle_predicted: Dict[Tuple[int, str], str] = {}
+        if self._config.oracle is not None:
+            from ..core.throughput_estimator import OracleThroughputChain
+            self._oracle = OracleThroughputChain.from_config(
+                self._config.oracle, self._oracle_throughputs)
+            truth_file = self._config.oracle.get("truth_file")
+            if truth_file:
+                self._oracle_truth, _ = read_oracle(truth_file)
+
     # ------------------------------------------------------------------
     # Time
     # ------------------------------------------------------------------
@@ -555,6 +594,7 @@ class Scheduler:
         "_worker_type_shuffler", "_run_meta", "_profile_map",
         "_whatif_knob_values",
         "_serving_tier", "_serving_job_ids", "_serving_replica_id_counter",
+        "_oracle_predicted",
     )
     _PLANNER_SNAPSHOT_FIELDS = (
         "metadata", "completed", "schedules", "round_ptr", "share_series",
@@ -890,6 +930,21 @@ class Scheduler:
             return None
         return self._serving_tier.summary()
 
+    def oracle_serving_mu(self, job: Job) -> Optional[float]:
+        """Learned decode-rate prior for a serving service's per-replica
+        mu (requests/s), or None — None means "use the exact configured
+        rate", and the chain returns None whenever the learned model
+        has ZERO samples for this family, so canonical serving replays
+        stay bit-identical (the tier calls this at registration)."""
+        if self._oracle is None:
+            return None
+        try:
+            batch_size = job.batch_size
+        except ValueError:
+            batch_size = 1
+        return self._oracle.serving_mu(
+            job.job_type, batch_size, sorted(self.workers.worker_types))
+
     def _admit_serving_service(self, job: Job, timestamp: Optional[float],
                                params: dict) -> JobIdPair:
         """Admit a serving SERVICE (the trace anchor). The service never
@@ -1218,6 +1273,9 @@ class Scheduler:
         if (oracle is not None and key in oracle
                 and oracle[key]["null"] > 0.0):
             self._throughputs[job_id][worker_type] = oracle[key]["null"]
+            if self._oracle is not None:
+                self._obs.inc(obs_names.ORACLE_PREDICTIONS_TOTAL,
+                              provenance="profiled")
         elif oracle is not None and key in oracle:
             # A zeroed oracle entry (the reference ships 0.0 for A3C /
             # CycleGAN) would starve the job in every throughput-driven
@@ -1230,6 +1288,24 @@ class Scheduler:
                            "%.4f steps/s from expected duration", key,
                            worker_type, nominal)
             self._throughputs[job_id][worker_type] = nominal
+        elif self._oracle is not None:
+            # Learned-oracle chain (core/throughput_estimator.py): no
+            # profiled entry, so consult the learned model, else the
+            # conservative prior. The provenance record marks this
+            # entry oracle-managed: in simulation it executes at the
+            # truth-file rate (_oracle_step_throughput) while this
+            # planning view converges online (_update_throughput).
+            pred = self._oracle.predict(job.job_type, job.batch_size,
+                                        job.scale_factor, worker_type)
+            self._throughputs[job_id][worker_type] = pred.steps_per_s
+            self._oracle_predicted[
+                (job_id.integer_job_id(), worker_type)] = pred.provenance
+            self._obs.inc(obs_names.ORACLE_PREDICTIONS_TOTAL,
+                          provenance=pred.provenance)
+            self.log.info(
+                "oracle %s throughput for %s on %s: %.4f steps/s "
+                "(confidence %.2f)", pred.provenance, key, worker_type,
+                pred.steps_per_s, pred.confidence)
         elif (self._simulate and not self._replaying
                 and self._oracle_throughputs is not None):
             # Simulation has no measured path to recover from a missing
@@ -1295,6 +1371,41 @@ class Scheduler:
                     if old != INFINITY:
                         tput = EMA_ALPHA * tput + (1 - EMA_ALPHA) * old
                     self._throughputs[job_id][worker_type] = tput
+                if (self._oracle is not None and not job_id.is_pair()
+                        and tput > 0):
+                    # Physical mode feeds every measured rate to the
+                    # learned model's online corrections too (the EMA
+                    # above is per-job state; the model generalizes).
+                    self._oracle.observe(
+                        self.acct.jobs[m].job_type,
+                        self.acct.jobs[m].batch_size,
+                        self.acct.jobs[m].scale_factor, worker_type,
+                        all_num_steps[i] / exec_time)
+                    self._obs.inc(obs_names.ORACLE_ONLINE_UPDATES_TOTAL)
+            elif (self._simulate and exec_time > 0
+                    and not job_id.is_pair()
+                    and self._oracle is not None
+                    and (m.integer_job_id(), worker_type)
+                    in self._oracle_predicted):
+                # Oracle-managed entry in simulation: the micro-task
+                # executed at the truth-file rate, so the observed
+                # steps/s is a genuine measurement — EMA the planning
+                # view toward it and feed the residual learner, exactly
+                # as physical mode does for measured rates. Entries
+                # seeded from the profiled table never take this path,
+                # keeping oracle-off replays' rates untouched.
+                old = self._throughputs[job_id][worker_type]
+                if old != INFINITY and tput > 0:
+                    self._obs.observe(
+                        obs_names.ORACLE_PREDICTION_REL_ERROR,
+                        abs(tput - old) / tput)
+                    self._throughputs[job_id][worker_type] = (
+                        EMA_ALPHA * tput + (1 - EMA_ALPHA) * old)
+                    job = self.acct.jobs[m]
+                    self._oracle.observe(job.job_type, job.batch_size,
+                                         job.scale_factor, worker_type,
+                                         tput)
+                    self._obs.inc(obs_names.ORACLE_ONLINE_UPDATES_TOTAL)
 
     # ------------------------------------------------------------------
     # Priorities / deficits (Gavel machinery)
@@ -1510,6 +1621,13 @@ class Scheduler:
         the remainder."""
         reserved = reserved or {}
         if self._policy.name == "shockwave":
+            # Keep the planner's per-type capacity rows current (mixed
+            # clusters only: a single row keeps the scalar backfill
+            # path and its bit-identical canonical replays).
+            self._shockwave_planner.capacity_rows = (
+                {wt: self.workers.cluster_spec[wt] - reserved.get(wt, 0)
+                 for wt in worker_types}
+                if len(worker_types) > 1 else None)
             job_ids = self._shockwave_planner.round_schedule()
             self._scheduled_jobs_in_prev_round = self._scheduled_jobs_in_current_round
             self._scheduled_jobs_in_current_round = job_ids
@@ -1524,7 +1642,19 @@ class Scheduler:
                     self.log.warning("job %s in round schedule but completed", int_id)
                     continue
                 sf = self.acct.jobs[job_id].scale_factor
-                for wt in worker_types:
+                order = worker_types
+                if self._oracle is not None and len(worker_types) > 1:
+                    # Heterogeneous placement: try the worker type the
+                    # oracle's current estimate ranks fastest for THIS
+                    # job first (stable sort: rate ties keep the
+                    # round's type order). Gated on the chain so
+                    # oracle-off mixed-cluster runs keep first-fit.
+                    rates = self._throughputs.get(job_id, {})
+                    order = sorted(
+                        worker_types,
+                        key=lambda wt: (-float(rates.get(wt, 0.0)),
+                                        worker_types.index(wt)))
+                for wt in order:
                     if capacity[wt] >= sf:
                         scheduled[wt].append((job_id, sf))
                         capacity[wt] -= sf
@@ -2785,6 +2915,21 @@ class Scheduler:
         return all_num_steps, max_finish
 
     def _oracle_step_throughput(self, job_id, worker_type, member):
+        if (self._oracle_truth is not None and not job_id.is_pair()
+                and (member.integer_job_id(), worker_type)
+                in self._oracle_predicted):
+            # Oracle-managed entry (learned/prior-seeded, never
+            # profiled): execute the micro-task at the TRUE rate from
+            # the held-out truth table while _throughputs keeps the
+            # planner's converging estimate — the cold-start acceptance
+            # methodology (reproduce/oracle/). Absent a truth row the
+            # estimate itself drives execution, as before.
+            job = self.acct.jobs.get(member)
+            if job is not None:
+                entry = self._oracle_truth.get(worker_type, {}).get(
+                    (job.job_type, job.scale_factor))
+                if entry is not None and entry.get("null", 0.0) > 0.0:
+                    return entry["null"]
         # Both pair and single entries are kept in sync with the oracle (and
         # refreshed on batch-size rescale), so read the scheduler's view.
         if job_id.is_pair():

@@ -18,6 +18,15 @@ integer bookkeeping is exact. The regression suite
 (tests/test_sim_vectorized.py) pins scalar-vs-vectorized equality for
 every policy in reproduce/pickles plus the serving mixed trace, and the
 canonical 120-job replays are pinned against the committed pickles.
+
+Heterogeneous clusters: every pass here iterates
+``sched.workers.worker_types`` and keys its per-type state
+(priorities, allocations, worker-type time, completion staging) by
+worker type, so a mixed multi-generation ``cluster_spec`` (e.g.
+``{"v5-lite": 16, "v5": 8}``) runs through the same code paths as a
+single-generation one — there is no single-type fast path to diverge
+from the scalar reference. tests/test_oracle.py pins scalar-vs-
+vectorized parity on a mixed two-generation spec.
 """
 from __future__ import annotations
 

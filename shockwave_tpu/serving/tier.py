@@ -74,7 +74,8 @@ class ServingService:
     """One registered serving service and its autoscaling state."""
 
     def __init__(self, int_id: int, job: Job, params: dict,
-                 arrival_ts: float, autoscaler_config: AutoscalerConfig):
+                 arrival_ts: float, autoscaler_config: AutoscalerConfig,
+                 mu_prior: Optional[float] = None):
         self.int_id = int_id
         self.job = job                      # anchor (never in acct.jobs)
         self.params = dict(params)
@@ -82,14 +83,23 @@ class ServingService:
         self.lifetime_s = float(job._duration)
         self.slo_p99_s = float(job.SLO) if job.SLO is not None else 1.0
         #: Declared (trace) per-replica service rate — the analytic
-        #: prior. `mu` is the live effective value: identical to the
-        #: prior until measured samples refine it (never in sim).
+        #: prior. `mu` is the live effective value: it starts from the
+        #: learned oracle's decode-rate prediction when one exists
+        #: (`mu_prior`, scheduler.oracle_serving_mu) and from the
+        #: declared rate otherwise (None — the zero-sample fallback
+        #: that keeps canonical replays bit-identical); measured
+        #: samples then refine it (never in sim).
         self.mu_analytic = serving_service_rate(job.command)
-        self.mu = self.mu_analytic
+        self.mu_oracle_prior = mu_prior
+        self.mu = mu_prior if mu_prior is not None else self.mu_analytic
         self.tokens_per_request = int(params.get("tokens_per_request", 1)
                                       or 1)
+        # The online mu re-estimator blends measured rates against this
+        # same prior with mu_prior_weight pseudo-samples, so an
+        # oracle-seeded service converges from the oracle's estimate
+        # rather than snapping back to the declared one.
         self.measured = ServiceMeasuredState(
-            self.mu_analytic, self.tokens_per_request,
+            self.mu, self.tokens_per_request,
             mu_prior_weight=autoscaler_config.mu_prior_weight)
         #: Per-replica (round, seq) high-water of ingested deltas:
         #: reports ride BOTH the renewal heartbeat and the Done log
@@ -205,8 +215,15 @@ class ServingTier:
 
     def register_service(self, int_id: int, job: Job, params: dict,
                          arrival_ts: float) -> ServingService:
+        # Oracle mu prior (scheduler.oracle_serving_mu): None unless
+        # the learned chain is configured AND has samples for this
+        # family — the exact-config fallback is the common case.
+        mu_prior = None
+        hook = getattr(self._sched, "oracle_serving_mu", None)
+        if hook is not None:
+            mu_prior = hook(job)
         svc = ServingService(int_id, job, params, arrival_ts,
-                             self.autoscaler_config)
+                             self.autoscaler_config, mu_prior=mu_prior)
         self.services[int_id] = svc
         self._obs().set_gauge(obs_names.SERVING_SERVICES,
                               len(self._live_services()))

@@ -79,6 +79,12 @@ class PlanRequest:
     #: serving jobs exist, keeping training-only replays bit-identical).
     #: -1 = unset (hand-built request): solve with the full cluster.
     ngpus: int = -1
+    #: Per-worker-type capacity rows for heterogeneous clusters
+    #: ({worker_type: chips}, net of serving reservations). None on
+    #: single-generation clusters — and on requests predating the
+    #: field (old pickles), which solve_prepared reads via getattr —
+    #: keeping the scalar backfill arithmetic bit-identical.
+    capacity_rows: Optional[Dict[str, int]] = None
 
 
 @dataclass
@@ -103,7 +109,8 @@ class ShockwavePlanner:
     _EXTERNALLY_SYNCHRONIZED = frozenset({
         "metadata", "completed", "schedules", "round_ptr", "_resolve",
         "_resolve_gen", "_reestimate_share", "share_series",
-        "solve_stats", "reserved_gpus", "pipelined", "journal", "obs",
+        "solve_stats", "reserved_gpus", "capacity_rows", "pipelined",
+        "journal", "obs",
     })
 
     def __init__(self, ngpus: int, future_nrounds: int, round_duration: float,
@@ -119,6 +126,13 @@ class ShockwavePlanner:
         # solve and fallback sees is ngpus - reserved_gpus. Stays 0 for
         # training-only traces.
         self.reserved_gpus = 0
+
+        # Per-worker-type capacity rows ({worker_type: chips}, net of
+        # serving reservations), refreshed by the owning scheduler
+        # every round on heterogeneous clusters. None (single
+        # generation) keeps every code path on the historical scalar
+        # arithmetic, so canonical replays stay bit-identical.
+        self.capacity_rows: Optional[Dict[str, int]] = None
 
         self.metadata: "OrderedDict[int, JobMetadata]" = OrderedDict()
         self.completed: "OrderedDict[int, JobMetadata]" = OrderedDict()
@@ -272,7 +286,9 @@ class ShockwavePlanner:
             jobs=[_JobView(m) for m in self.metadata.values()],
             share_series=[list(self.share_series[j]) for j in job_ids],
             generation=self._resolve_gen,
-            ngpus=max(self.ngpus - self.reserved_gpus, 0))
+            ngpus=max(self.ngpus - self.reserved_gpus, 0),
+            capacity_rows=(dict(self.capacity_rows)
+                           if self.capacity_rows else None))
 
     def solve_prepared(self, request: PlanRequest,
                        pipelined: bool = False) -> PlanResult:
@@ -300,10 +316,10 @@ class ShockwavePlanner:
                               self.future_nrounds, self.round_duration,
                               ngpus, request.share_series, self.opts,
                               stats_out=stats, pipelined=pipelined)
-        schedules = self._construct_schedules(x, request.job_ids,
-                                              request.jobs,
-                                              request.round_ptr,
-                                              ngpus=ngpus)
+        schedules = self._construct_schedules(
+            x, request.job_ids, request.jobs, request.round_ptr,
+            ngpus=ngpus,
+            capacity_rows=getattr(request, "capacity_rows", None))
         return PlanResult(round_ptr=request.round_ptr, schedules=schedules,
                           stats=stats, generation=request.generation)
 
@@ -372,32 +388,64 @@ class ShockwavePlanner:
                        "cached schedule covers it; serving backfill-only "
                        "schedule", self.round_ptr)
         selected: List[int] = []
-        idle = max(self.ngpus - self.reserved_gpus, 0)
         by_remaining = sorted(
             self.metadata.items(),
             key=lambda kv: kv[1].dirichlet_posterior_remaining_runtime(),
             reverse=True)
-        for job_id, meta in by_remaining:
-            if meta.nworkers <= idle:
-                selected.append(job_id)
-                idle -= meta.nworkers
-            if idle <= 0:
-                break
+        if self.capacity_rows and len(self.capacity_rows) > 1:
+            idle_rows = {wt: max(int(cap), 0)
+                         for wt, cap in self.capacity_rows.items()}
+            for job_id, meta in by_remaining:
+                if self._fit_row(idle_rows, meta.nworkers) is not None:
+                    selected.append(job_id)
+                if all(cap <= 0 for cap in idle_rows.values()):
+                    break
+        else:
+            idle = max(self.ngpus - self.reserved_gpus, 0)
+            for job_id, meta in by_remaining:
+                if meta.nworkers <= idle:
+                    selected.append(job_id)
+                    idle -= meta.nworkers
+                if idle <= 0:
+                    break
         # Pin the fallback for the round so repeated queries within the
         # same round stay consistent.
         self.schedules[self.round_ptr] = selected
         return selected
 
+    @staticmethod
+    def _fit_row(idle_rows: Dict[str, int], need: int) -> Optional[str]:
+        """Place a job needing `need` chips of a single generation into
+        the per-type idle rows: picks the worker type with the most idle
+        chips that still fits (type name as deterministic tie-break),
+        deducts in place, and returns it — or None when no single
+        generation can host the job this round."""
+        fit = [wt for wt, cap in idle_rows.items() if cap >= need]
+        if not fit:
+            return None
+        wt = sorted(fit, key=lambda w: (-idle_rows[w], w))[0]
+        idle_rows[wt] -= need
+        return wt
+
     def _construct_schedules(self, x, job_ids, jobs, base_round: int,
                              ngpus: Optional[int] = None,
+                             capacity_rows: Optional[Dict[str, int]] = None,
                              ) -> "OrderedDict[int, List[int]]":
         """Solution matrix -> per-round job lists, with work-conserving
         backfill of idle chips by longest remaining runtime
         (reference: shockwave.py:213-285). Operates purely on the
         request snapshot (job_ids + views) so it can run off-lock.
-        `ngpus` is the request's (serving-shrunk) capacity row."""
+        `ngpus` is the request's (serving-shrunk) capacity row.
+
+        On heterogeneous clusters (`capacity_rows` with >1 worker type)
+        a training job occupies chips of exactly one generation, so
+        MILP selections and backfill candidates are first-fit packed
+        into the per-type rows instead of against the cluster total; a
+        selected job that fits no single generation is deferred to a
+        later round rather than oversubscribing a row."""
         if ngpus is None:
             ngpus = self.ngpus
+        hetero = capacity_rows is not None and len(capacity_rows) > 1
         schedules: "OrderedDict[int, List[int]]" = OrderedDict()
         for r in range(self.future_nrounds):
             round_index = base_round + r
@@ -405,6 +453,24 @@ class ShockwavePlanner:
             selected = [job_ids[j] for j in sel]
             if not selected:
                 logger.warning("no jobs scheduled in round %d", round_index)
+            if hetero:
+                idle_rows = {wt: max(int(cap), 0)
+                             for wt, cap in sorted(capacity_rows.items())}
+                kept: List[int] = []
+                for j in sel:
+                    if self._fit_row(idle_rows, jobs[j].nworkers) is not None:
+                        kept.append(job_ids[j])
+                others = [j for j in range(len(job_ids))
+                          if job_ids[j] not in kept]
+                others.sort(key=lambda j: jobs[j].dirichlet_posterior_remaining_runtime(),
+                            reverse=True)
+                for j in others:
+                    if all(cap <= 0 for cap in idle_rows.values()):
+                        break
+                    if self._fit_row(idle_rows, jobs[j].nworkers) is not None:
+                        kept.append(job_ids[j])
+                schedules[round_index] = kept
+                continue
             used = sum(jobs[j].nworkers for j in sel)
             idle = ngpus - used
             if idle > 0:

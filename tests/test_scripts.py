@@ -146,6 +146,34 @@ class TestTracingBench:
         assert "SMOKE FAIL" in out.stderr
 
 
+class TestOracleBench:
+    def test_smoke_gate_and_row_shape(self):
+        """bench_oracle honors --smoke and emits the bench.py row
+        fields (fit-wall ceiling + predictions/s floor)."""
+        out = run_script(["scripts/microbenchmarks/bench_oracle.py",
+                          "--smoke", "--fits", "2", "--copies", "2",
+                          "--predictions", "2000",
+                          "--observations", "2000",
+                          "--min_predictions_per_s", "500"])
+        row = json.loads(out.strip().splitlines()[-1])
+        for key in ("mean_fit_s", "rmse", "predictions_per_s",
+                    "observations_per_s"):
+            assert key in row
+        assert row["predictions_per_s"] > 500
+        assert row["rmse"] < 0.2  # log-space fit of a log-linear surface
+
+    def test_smoke_fails_below_floor(self):
+        out = subprocess.run(
+            [sys.executable,
+             "scripts/microbenchmarks/bench_oracle.py", "--smoke",
+             "--fits", "1", "--copies", "1", "--predictions", "500",
+             "--observations", "500",
+             "--min_predictions_per_s", "1e12"],
+            capture_output=True, text=True, cwd=REPO)
+        assert out.returncode == 1
+        assert "SMOKE FAIL" in out.stderr
+
+
 class TestPlotting:
     def test_all_plot_kinds(self, tmp_path):
         from shockwave_tpu import plotting
